@@ -1,0 +1,58 @@
+"""Test configuration.
+
+Tests run on CPU with 8 virtual XLA devices so multi-chip sharding is exercised
+on a fake mesh (SURVEY.md §4), with float64 enabled for tight NumPy-oracle
+comparisons.
+
+This container routes JAX to a single real TPU through the `axon` PJRT plugin:
+a sitecustomize hook registers the plugin in every python process (when
+``PALLAS_AXON_POOL_IPS`` is set) and pins ``JAX_PLATFORMS=axon``.  Initializing
+that backend dials the TPU tunnel, which serializes/hangs pytest.  Backend init
+is lazy, so before any JAX computation we (a) point ``jax_platforms`` at cpu,
+(b) deregister the axon factory, and (c) request 8 virtual CPU devices.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")  # sitecustomize already captured env
+jax.config.update("jax_enable_x64", True)
+
+from jax._src import xla_bridge as _xb
+
+_xb._backend_factories.pop("axon", None)
+
+import numpy as np
+import pytest
+
+assert jax.devices()[0].platform == "cpu"
+assert len(jax.devices()) == 8, "expected the 8-device virtual CPU mesh"
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+MATURITIES = (3.0, 6.0, 9.0, 12.0, 15.0, 18.0, 21.0, 24.0, 30.0, 36.0,
+              48.0, 60.0, 72.0, 84.0, 96.0, 108.0, 120.0, 180.0, 240.0, 360.0)
+
+
+@pytest.fixture
+def maturities():
+    # Liu–Wu style monthly-maturity grid, in months/12 = years
+    return np.asarray(MATURITIES) / 12.0
+
+
+@pytest.fixture
+def yields_panel(rng, maturities):
+    """Synthetic DNS-generated panel (N, T) in float64."""
+    from tests.oracle import simulate_dns_panel
+
+    return simulate_dns_panel(rng, maturities, T=80)

@@ -1,0 +1,292 @@
+"""Independent NumPy oracle of the reference semantics (SURVEY.md §4 test
+strategy): straight per-step float64 loops, written directly from the formulas
+in /root/reference/src — used as golden values for the lax.scan kernels.
+
+The score-driven oracle's inner gradient uses a *hand-derived analytic*
+gradient for the λ model and finite differences cross-checked against it, so
+the oracle shares no AD machinery with the library for that model.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+LAMBDA_FLOOR = 1e-2
+LOG_2PI = np.log(2.0 * np.pi)
+
+
+# ---------------------------------------------------------------------------
+# loadings
+# ---------------------------------------------------------------------------
+
+def dns_loadings(gamma_scalar, maturities):
+    lam = LAMBDA_FLOOR + np.exp(gamma_scalar)
+    tau = lam * maturities
+    z = np.exp(-tau)
+    Z = np.ones((len(maturities), 3))
+    Z[:, 1] = (1 - z) / tau
+    Z[:, 2] = Z[:, 1] - z
+    return Z
+
+
+def mlp_curve(p9, maturities):
+    w1, b1, w2 = p9[0:3], p9[3:6], p9[6:9]
+    out = np.zeros(len(maturities))
+    for n, tau in enumerate(maturities):
+        h = np.tanh(w1 * tau + b1)
+        out[n] = float(w2 @ h)
+    return out
+
+
+def transform_net_1(raw, transformed):
+    n = len(raw)
+    dest = raw.copy()
+    if transformed:
+        raw_first, raw_last = dest[0], dest[n - 2]
+        inv_first = 1.0 / (raw_first - raw_last + 1e-7)
+        for i in range(1, n - 2):
+            t = (dest[i] - raw_last) * inv_first
+            dest[i] = t * t
+    else:
+        for i in range(1, n - 2):
+            dest[i] = dest[i] * dest[i]
+    dest[0] = 1.0
+    dest[n - 2] = 0.0
+    dest[n - 1] = 0.0
+    return dest
+
+
+def transform_net_2(raw, maturities, transformed, scale=0.9610):
+    n = len(raw)
+    dest = raw.copy()
+    if transformed:
+        x1, xN = maturities[0], maturities[n - 1]
+        raw1, rawN = dest[0], dest[n - 1]
+        slope = (rawN - raw1) / (xN - x1)
+        intercept = raw1 - slope * x1
+        sum_sq = 0.0
+        for i in range(1, n - 1):
+            r = dest[i] - (slope * maturities[i] - intercept)
+            r2 = r * r
+            dest[i] = r2
+            sum_sq += r2 * r2
+        dest[0] = 0.0
+        dest[n - 1] = 0.0
+        denom = np.sqrt(sum_sq) / scale + 1e-7
+        dest /= denom
+    else:
+        dest[0] = 0.0
+        dest[n - 1] = 0.0
+        sum_sq = 0.0
+        for i in range(1, n - 1):
+            dest[i] = dest[i] * dest[i]
+            sum_sq += dest[i] * dest[i]
+        denom_inv = scale / np.sqrt(sum_sq) + 1e-7
+        for i in range(1, n - 1):
+            dest[i] *= denom_inv
+    return dest
+
+
+def neural_loadings(gamma18, maturities, transform_bool):
+    Z = np.ones((len(maturities), 3))
+    Z[:, 1] = transform_net_1(mlp_curve(gamma18[0:9], maturities), transform_bool)
+    Z[:, 2] = transform_net_2(mlp_curve(gamma18[9:18], maturities), maturities, transform_bool)
+    return Z
+
+
+# ---------------------------------------------------------------------------
+# Kalman oracle (kalman/filter.jl:125-209, predicted-state form, explicit inv)
+# ---------------------------------------------------------------------------
+
+def kalman_init(Phi, delta, Omega_state):
+    Ms = Phi.shape[0]
+    beta = np.linalg.solve(np.eye(Ms) - Phi, delta)
+    P = np.linalg.solve(np.eye(Ms * Ms) - np.kron(Phi, Phi), Omega_state.reshape(-1)).reshape(Ms, Ms)
+    return beta, P
+
+
+def kalman_filter_loglik(Z, Phi, delta, Omega_state, obs_var, data):
+    N, T = data.shape
+    Ms = Phi.shape[0]
+    Omega_obs = obs_var * np.eye(N)
+    beta, P = kalman_init(Phi, delta, Omega_state)
+    loglik = 0.0
+    preds = []
+    for t in range(T - 1):
+        y = data[:, t]
+        y_pred = Z @ beta
+        preds.append(y_pred)
+        if np.any(np.isnan(y)):
+            beta = delta + Phi @ beta
+            P = Phi @ P @ Phi.T + Omega_state
+            continue
+        v = y - y_pred
+        F = Z @ P @ Z.T + Omega_obs
+        F_inv = np.linalg.inv(F)
+        K = P @ Z.T @ F_inv
+        beta = delta + Phi @ (beta + K @ v)
+        P = Phi @ ((np.eye(Ms) - K @ Z) @ P) @ Phi.T + Omega_state
+        if t > 0:  # reference skips t == 1 (1-based)
+            sign, logdet = np.linalg.slogdet(F)
+            loglik -= 0.5 * (logdet + v @ F_inv @ v + N * LOG_2PI)
+    return loglik
+
+
+def ekf_tvl_loglik(Phi, delta, Omega_state, obs_var, maturities, data,
+                   exact_jacobian=False):
+    """EKF for TVλ (kalman/filter.jl:12-80), loglik accumulation (:182-209)."""
+    N, T = data.shape
+    Ms = Phi.shape[0]  # 4
+    Omega_obs = obs_var * np.eye(N)
+    beta, P = kalman_init(Phi, delta, Omega_state)
+    loglik = 0.0
+    for t in range(T - 1):
+        y = data[:, t]
+        lam = LAMBDA_FLOOR + np.exp(beta[3])
+        tau = lam * maturities
+        z = np.exp(-tau)
+        z2 = (1 - z) / tau
+        z3 = z2 - z
+        y_pred = beta[0] + z2 * beta[1] + z3 * beta[2]
+        if np.any(np.isnan(y)):
+            beta = delta + Phi @ beta
+            P = Phi @ P @ Phi.T + Omega_state
+            continue
+        v = y - y_pred
+        dlam = lam - LAMBDA_FLOOR
+        if exact_jacobian:
+            dz2 = z / lam - (1 - z) / (lam * lam * maturities)
+        else:
+            dz2 = z / lam - z / (lam * lam * maturities)
+        extra = maturities * z
+        jac = ((beta[1] + beta[2]) * dz2 + beta[2] * extra) * dlam
+        Zd = np.column_stack([np.ones(N), z2, z3, jac])
+        F = Zd @ P @ Zd.T + Omega_obs
+        F_inv = np.linalg.inv(F)
+        K = P @ Zd.T @ F_inv
+        beta = delta + Phi @ (beta + K @ v)
+        P = Phi @ ((np.eye(Ms) - K @ Zd) @ P) @ Phi.T + Omega_state
+        if t > 0:
+            sign, logdet = np.linalg.slogdet(F)
+            loglik -= 0.5 * (logdet + v @ F_inv @ v + N * LOG_2PI)
+    return loglik
+
+
+# ---------------------------------------------------------------------------
+# score-driven oracle (models/filter.jl:52-91, λ model with analytic score)
+# ---------------------------------------------------------------------------
+
+def _ols(Z, y):
+    G = Z.T @ Z
+    try:
+        L = np.linalg.cholesky(G)
+    except np.linalg.LinAlgError:
+        L = np.linalg.cholesky(G + 1e-3 * np.eye(G.shape[0]))
+    x = np.linalg.solve(L, Z.T @ y)
+    return np.linalg.solve(L.T, x)
+
+
+def _dns_score(gamma, beta, y, maturities):
+    """Analytic ∇_γ −‖y − Z(γ)β‖² for the λ model (β detached)."""
+    lam = LAMBDA_FLOOR + np.exp(gamma[0])
+    tau = lam * maturities
+    z = np.exp(-tau)
+    z2 = (1 - z) / tau
+    z3 = z2 - z
+    resid = y - (beta[0] + z2 * beta[1] + z3 * beta[2])
+    # dZ2/dλ and dZ3/dλ (true derivatives; the inner score is exact AD)
+    dz2 = z / lam - (1 - z) / (lam * lam * maturities)
+    dz3 = dz2 + maturities * z
+    dlam_dg = np.exp(gamma[0])
+    dresid_dg = -(beta[1] * dz2 + beta[2] * dz3) * dlam_dg
+    return np.array([-2.0 * np.dot(resid, dresid_dg)])
+
+
+def msed_lambda_filter(params_struct, maturities, data, scale_grad=False,
+                       forget_factor=0.98, dtype_eps=np.finfo(np.float64).eps):
+    """params_struct: dict with A (L,), B (L,) or None, omega, delta, Phi."""
+    A = params_struct["A"]
+    B = params_struct["B"]
+    omega = params_struct["omega"]
+    delta = params_struct["delta"]
+    Phi = params_struct["Phi"]
+    mu = (np.eye(3) - Phi) @ delta
+    nu = np.zeros_like(omega) if B is None else (1 - B) * omega
+
+    gamma = omega.copy()
+    beta = delta.copy()
+    ewma = np.zeros_like(gamma)
+    count = 0
+
+    N, T = data.shape
+    preds = np.zeros((N, T))
+    for t in range(T):
+        y = data[:, t]
+        if np.isnan(y[0]):
+            if B is not None:
+                gamma = nu + B * gamma
+            beta = mu + Phi @ beta
+            Z = dns_loadings(gamma[0], maturities)
+            preds[:, t] = Z @ beta
+            continue
+        Z = dns_loadings(gamma[0], maturities)
+        beta = _ols(Z, y)
+        g = _dns_score(gamma, beta, y, maturities)
+        if scale_grad:
+            ewma = forget_factor * ewma + (1 - forget_factor) * g * g
+            count += 1
+            denom = 1 - forget_factor ** count
+            g = g / (np.sqrt(ewma / denom) + dtype_eps)
+        gamma = gamma + g * A
+        Z = dns_loadings(gamma[0], maturities)
+        beta = _ols(Z, y)
+        if B is not None:
+            gamma = nu + B * gamma
+            Z = dns_loadings(gamma[0], maturities)
+        beta = mu + Phi @ beta
+        preds[:, t] = Z @ beta
+    return preds
+
+
+def msed_loss_from_preds(preds, data):
+    N, T = data.shape
+    mse = 0.0
+    for t in range(T - 1):
+        v = data[:, t + 1] - preds[:, t]
+        mse -= v @ v
+    return mse / N / T
+
+
+def static_filter(gamma_Z, delta, Phi, data):
+    """models/filter.jl:93-110 with fixed Z."""
+    Z = gamma_Z
+    mu = (np.eye(3) - Phi) @ delta
+    beta = delta.copy()
+    N, T = data.shape
+    preds = np.zeros((N, T))
+    for t in range(T):
+        y = data[:, t]
+        if np.isnan(y[0]):
+            beta = mu + Phi @ beta
+        else:
+            beta = mu + Phi @ _ols(Z, y)
+        preds[:, t] = Z @ beta
+    return preds
+
+
+# ---------------------------------------------------------------------------
+# synthetic data
+# ---------------------------------------------------------------------------
+
+def simulate_dns_panel(rng, maturities, T=80, lam=0.5):
+    """Yields from a stationary 3-factor DNS DGP + small noise."""
+    N = len(maturities)
+    Z = dns_loadings(np.log(lam - LAMBDA_FLOOR), maturities)
+    Phi = np.diag([0.95, 0.9, 0.85])
+    delta = np.array([0.3, -0.1, 0.05])
+    beta = np.linalg.solve(np.eye(3) - Phi, delta)
+    data = np.zeros((N, T))
+    for t in range(T):
+        beta = delta + Phi @ beta + 0.1 * rng.standard_normal(3)
+        data[:, t] = Z @ beta + 0.02 * rng.standard_normal(N)
+    return data + 5.0

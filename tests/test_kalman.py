@@ -1,0 +1,115 @@
+"""Kalman/EKF scan-kernel golden tests vs the NumPy oracle."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from tests import oracle
+from yieldfactormodels_jl_tpu import create_model, get_loss, get_loss_array, predict
+from yieldfactormodels_jl_tpu.models import kalman as K
+from yieldfactormodels_jl_tpu.models.params import unpack_kalman
+
+
+def _dns_params(M=3):
+    """Constrained flat vector [γ, σ², chol(6), δ, Φ_rowmajor] + its pieces."""
+    p = np.zeros(20)
+    p[0] = np.log(0.5)
+    p[1] = 4e-4
+    p[2], p[4], p[7] = 0.10, 0.08, 0.12   # chol diag
+    p[3], p[5], p[6] = 0.01, -0.02, 0.005  # chol off-diag
+    p[8:11] = [0.3, -0.1, 0.05]
+    Phi = np.array([[0.95, 0.02, 0.0], [0.01, 0.9, 0.03], [0.0, 0.02, 0.85]])
+    p[11:20] = Phi.reshape(-1)
+    C = np.array([[0.10, 0.01, -0.02], [0, 0.08, 0.005], [0, 0, 0.12]])
+    return p, Phi, p[8:11].copy(), C.T @ C, 4e-4
+
+
+def test_unpack_kalman_layout(maturities):
+    spec, _ = create_model("1C", tuple(maturities), float_type="float64")
+    p, Phi, delta, Omega, obs_var = _dns_params()
+    kp = unpack_kalman(spec, jnp.asarray(p))
+    np.testing.assert_allclose(kp.Phi, Phi, rtol=1e-12)
+    np.testing.assert_allclose(kp.delta, delta, rtol=1e-12)
+    np.testing.assert_allclose(kp.Omega_state, Omega, rtol=1e-12)
+    assert float(kp.obs_var) == obs_var
+
+
+def test_kalman_loglik_matches_oracle(maturities, yields_panel):
+    spec, _ = create_model("1C", tuple(maturities), float_type="float64")
+    p, Phi, delta, Omega, obs_var = _dns_params()
+    Z = oracle.dns_loadings(p[0], maturities)
+    want = oracle.kalman_filter_loglik(Z, Phi, delta, Omega, obs_var, yields_panel)
+    got = float(get_loss(spec, jnp.asarray(p), jnp.asarray(yields_panel)))
+    np.testing.assert_allclose(got, want, rtol=1e-8)
+
+
+def test_kalman_masked_prefix_equals_truncation(maturities, yields_panel):
+    """Leading-NaN masking == truncation (the rolling-window vmap lever)."""
+    spec, _ = create_model("1C", tuple(maturities), float_type="float64")
+    p, *_ = _dns_params()
+    T = yields_panel.shape[1]
+    full = jnp.asarray(yields_panel)
+    lo, hi = 10, 60
+    masked = float(K.get_loss(spec, jnp.asarray(p), full, start=lo, end=hi))
+    trunc = float(K.get_loss(spec, jnp.asarray(p), full[:, lo:hi]))
+    np.testing.assert_allclose(masked, trunc, rtol=1e-9)
+
+
+def test_kalman_nonstationary_gives_neg_inf(maturities, yields_panel):
+    spec, _ = create_model("1C", tuple(maturities), float_type="float64")
+    p, *_ = _dns_params()
+    p[11] = 1.5  # explosive Phi[0,0] ⇒ invalid unconditional covariance
+    got = float(get_loss(spec, jnp.asarray(p), jnp.asarray(yields_panel)))
+    assert got == -np.inf
+
+
+def test_ekf_tvl_matches_oracle(maturities, yields_panel):
+    spec, _ = create_model("TVλ", tuple(maturities), float_type="float64")
+    assert spec.n_params == 31  # SURVEY.md §2.13
+    Ms = 4
+    p = np.zeros(31)
+    p[0] = 4e-4
+    # chol: diag entries at column-wise positions
+    chol_diag_pos = [1, 3, 6, 10]
+    C = np.zeros((Ms, Ms))
+    k = 1
+    for j in range(Ms):
+        for i in range(j + 1):
+            val = 0.09 + 0.01 * i if i == j else 0.004 * (i + j)
+            C[i, j] = val
+            p[k] = val
+            k += 1
+    delta = np.array([0.3, -0.1, 0.05, np.log(0.5) * 0.05])
+    p[11:15] = delta
+    Phi = np.diag([0.95, 0.9, 0.85, 0.95])
+    Phi[0, 1] = 0.01
+    p[15:31] = Phi.reshape(-1)
+    want = oracle.ekf_tvl_loglik(Phi, delta, C.T @ C, 4e-4, maturities, yields_panel)
+    got = float(get_loss(spec, jnp.asarray(p), jnp.asarray(yields_panel)))
+    np.testing.assert_allclose(got, want, rtol=1e-7)
+
+
+def test_kalman_predict_shapes_and_alignment(maturities, yields_panel):
+    spec, _ = create_model("1C", tuple(maturities), float_type="float64")
+    p, *_ = _dns_params()
+    h = 6
+    ext = np.concatenate([yields_panel, np.full((len(maturities), h - 1), np.nan)], axis=1)
+    res = predict(spec, jnp.asarray(p), jnp.asarray(ext))
+    N, T = ext.shape
+    assert res["preds"].shape == (N, T)
+    assert res["factors"].shape == (3, T)
+    assert res["states"].shape == (1, T)
+    assert np.all(np.isfinite(np.asarray(res["preds"])))
+    # trailing forecast columns are pure transitions of the last filtered state
+    tail = np.asarray(res["preds"][:, -(h - 1):])
+    assert np.all(np.isfinite(tail))
+
+
+def test_kalman_loss_array_K_replay(maturities, yields_panel):
+    spec, _ = create_model("1C", tuple(maturities), float_type="float64")
+    p, *_ = _dns_params()
+    a1 = np.asarray(get_loss_array(spec, jnp.asarray(p), jnp.asarray(yields_panel), K=1))
+    a2 = np.asarray(get_loss_array(spec, jnp.asarray(p), jnp.asarray(yields_panel), K=2))
+    assert a1.shape == (yields_panel.shape[1] - 1,)
+    # pass 2 continues from the end state, so K=2 is NOT just a rescaled K=1
+    assert not np.allclose(a2, a1 / 2.0)
+    assert not np.allclose(a2, a1)

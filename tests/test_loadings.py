@@ -1,0 +1,49 @@
+"""Loading-matrix golden tests vs closed form / NumPy oracle."""
+
+import numpy as np
+
+from tests import oracle
+from yieldfactormodels_jl_tpu.models import loadings as L
+from yieldfactormodels_jl_tpu.utils.nn_transform import transform_net_1, transform_net_2
+
+
+def test_dns_loadings_closed_form(maturities):
+    gamma = np.log(0.55)
+    Z = np.asarray(L.dns_loadings(gamma, maturities))
+    lam = 1e-2 + 0.55
+    tau = lam * maturities
+    np.testing.assert_allclose(Z[:, 0], 1.0)
+    np.testing.assert_allclose(Z[:, 1], (1 - np.exp(-tau)) / tau, rtol=1e-10)
+    np.testing.assert_allclose(Z[:, 2], (1 - np.exp(-tau)) / tau - np.exp(-tau), rtol=1e-10)
+
+
+def test_mlp_curve_matches_oracle(rng, maturities):
+    p9 = rng.standard_normal(9)
+    got = np.asarray(L.mlp_curve(p9, maturities))
+    np.testing.assert_allclose(got, oracle.mlp_curve(p9, maturities), rtol=1e-10)
+
+
+def test_shape_transforms_match_oracle(rng, maturities):
+    for transformed in (True, False):
+        raw = rng.standard_normal(len(maturities))
+        got1 = np.asarray(transform_net_1(raw, maturities, transformed))
+        np.testing.assert_allclose(got1, oracle.transform_net_1(raw, transformed), rtol=1e-9)
+        raw2 = rng.standard_normal(len(maturities))
+        got2 = np.asarray(transform_net_2(raw2, maturities, transformed))
+        np.testing.assert_allclose(
+            got2, oracle.transform_net_2(raw2, maturities, transformed), rtol=1e-9
+        )
+
+
+def test_neural_loadings_shape_properties(rng, maturities):
+    gamma = rng.standard_normal(18) / 10
+    for tb in (True, False):
+        Z = np.asarray(L.neural_loadings(gamma, maturities, tb))
+        np.testing.assert_allclose(Z[:, 0], 1.0)
+        assert Z[0, 1] == 1.0          # slope curve pinned to 1 at short end
+        assert Z[-2, 1] == 0.0 and Z[-1, 1] == 0.0
+        assert Z[0, 2] == 0.0 and Z[-1, 2] == 0.0   # hump pinned to 0 at ends
+        assert np.all(Z[1:-1, 2] >= 0)  # squared ⇒ nonneg
+        np.testing.assert_allclose(
+            Z, oracle.neural_loadings(gamma, maturities, tb), rtol=1e-9
+        )

@@ -1,0 +1,76 @@
+"""Model-dictionary parity: all 34 codes, aliases, param counts, groups."""
+
+import numpy as np
+import pytest
+
+from yieldfactormodels_jl_tpu import create_model, get_param_groups, get_static_model_type
+
+MATS = tuple(np.arange(1, 13) / 2.0)
+
+
+def test_alias_equivalence():
+    pairs = [("1C", "0"), ("TVλ", "1"), ("NS", "2"), ("NNS", "3"),
+             ("SD-NS", "4"), ("RWSD-NS", "5"), ("SSD-NS", "6"), ("SRWSD-NS", "7"),
+             ("1SD-NNS", "8"), ("3SRWSD-NNS", "19"), ("NNS-Anchored", "20"),
+             ("1SD-NNS-Anchored", "21"), ("3SRWSD-NNS-Anchored", "32"), ("RW", "-1")]
+    for name, alias in pairs:
+        s1, c1 = create_model(name, MATS)
+        s2, c2 = create_model(alias, MATS)
+        assert c1 == c2 == name
+        assert s1.family == s2.family
+        assert s1.n_params == s2.n_params
+        assert s1.random_walk == s2.random_walk
+        assert s1.scale_grad == s2.scale_grad
+        assert s1.transform_bool == s2.transform_bool
+
+
+def test_param_counts_match_survey():
+    # SURVEY.md §2.13 parameter-count reference
+    expect = {
+        "1C": 20, "TVλ": 31, "NS": 13, "NNS": 30, "RW": 13,
+        "SD-NS": 15, "RWSD-NS": 14,
+        "1SD-NNS": 34,   # u=2: A2+B2+ω18+δ3+Φ9
+        "1RWSD-NNS": 32,
+        "2SD-NNS": 42,   # u=6
+        "3SD-NNS": 66,   # u=18
+        "3RWSD-NNS": 48,
+    }
+    for code, n in expect.items():
+        spec, _ = create_model(code, MATS)
+        assert spec.n_params == n, (code, spec.n_params, n)
+
+
+def test_placeholders_and_errors():
+    spec, canon = create_model("pC", MATS)
+    assert spec is None and canon == "pC"
+    spec, canon = create_model("a", MATS)
+    assert spec is None and canon == "vanillaNN"
+    with pytest.raises(ValueError):
+        create_model("bogus", MATS)
+
+
+def test_param_groups_defaults():
+    spec, _ = create_model("1C", MATS)
+    assert get_param_groups(spec) == ("1",) * 20
+    spec, _ = create_model("SD-NS", MATS)
+    g = get_param_groups(spec)
+    assert g[-12:] == ("2",) * 12 and g[:-12] == ("1",) * 3
+    # matching-length override accepted, wrong length rejected
+    assert get_param_groups(spec, ["3"] * 15) == ("3",) * 15
+    assert get_param_groups(spec, ["3"] * 4) == g
+
+
+def test_static_model_type_cascade():
+    assert get_static_model_type(create_model("1C", MATS)[0]) == "DNS"
+    assert get_static_model_type(create_model("TVλ", MATS)[0]) == "1C"
+    assert get_static_model_type(create_model("SD-NS", MATS)[0]) == "NS"
+    assert get_static_model_type(create_model("1SD-NNS", MATS)[0]) == "NNS"
+    assert get_static_model_type(create_model("1SD-NNS-Anchored", MATS)[0]) == "NNS-Anchored"
+    assert get_static_model_type(create_model("RW", MATS)[0]) == ""
+
+
+def test_duplicator_shapes():
+    for code, u in [("1SD-NNS", 2), ("2SD-NNS", 6), ("3SD-NNS", 18)]:
+        spec, _ = create_model(code, MATS)
+        assert spec.n_unique == u
+        assert len(spec.duplicator) == 18

@@ -1,0 +1,136 @@
+"""Score-driven filter golden tests vs the NumPy oracle (analytic inner score)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tests import oracle
+from yieldfactormodels_jl_tpu import create_model, get_loss, predict
+from yieldfactormodels_jl_tpu.models import score_driven as SD
+from yieldfactormodels_jl_tpu.models.params import unpack_msed
+
+
+def _lambda_params(spec, random_walk=False):
+    """[A(1), B(1)?, ω(1), δ(3), Φ col-major(9)] constrained."""
+    vals = [1e-3]
+    if not random_walk:
+        vals.append(0.97)
+    vals.append(np.log(0.5))          # omega = gamma fixed point
+    vals.extend([0.3, -0.1, 0.05])    # delta
+    Phi = np.array([[0.95, 0.02, 0.0], [0.01, 0.9, 0.03], [0.0, 0.02, 0.85]])
+    vals.extend(Phi.T.reshape(-1))    # column-major vec
+    p = np.asarray(vals)
+    assert p.shape[0] == spec.n_params
+    return p, Phi
+
+
+def _struct(p, random_walk):
+    if random_walk:
+        return {"A": np.array([p[0]]), "B": None, "omega": np.array([p[1]]),
+                "delta": p[2:5], "Phi": p[5:14].reshape(3, 3).T}
+    return {"A": np.array([p[0]]), "B": np.array([p[1]]), "omega": np.array([p[2]]),
+            "delta": p[3:6], "Phi": p[6:15].reshape(3, 3).T}
+
+
+def test_unpack_msed_layout(maturities):
+    spec, _ = create_model("SD-NS", tuple(maturities), float_type="float64")
+    p, Phi = _lambda_params(spec)
+    mp = unpack_msed(spec, jnp.asarray(p))
+    np.testing.assert_allclose(mp.Phi, Phi, rtol=1e-12)
+    np.testing.assert_allclose(mp.mu, (np.eye(3) - Phi) @ p[3:6], rtol=1e-12)
+    np.testing.assert_allclose(mp.nu, (1 - p[1]) * p[2], rtol=1e-12)
+
+
+def test_inner_score_matches_analytic(maturities, rng):
+    """jax.grad of the inner objective == hand-derived gradient (λ model)."""
+    spec, _ = create_model("SD-NS", tuple(maturities), float_type="float64")
+    gamma = jnp.asarray([np.log(0.4)])
+    beta = jnp.asarray([5.0, -1.0, 0.5])
+    y = jnp.asarray(rng.standard_normal(len(maturities)) + 5.0)
+    got = np.asarray(SD._score(spec, gamma, beta, y))
+    want = oracle._dns_score(np.asarray(gamma), np.asarray(beta), np.asarray(y), maturities)
+    np.testing.assert_allclose(got, want, rtol=1e-9)
+
+
+def _filter_parity(maturities, yields_panel, code, random_walk, scale_grad):
+    spec, _ = create_model(code, tuple(maturities), float_type="float64")
+    p, _ = _lambda_params(spec, random_walk)
+    res = predict(spec, jnp.asarray(p), jnp.asarray(yields_panel))
+    want_preds = oracle.msed_lambda_filter(
+        _struct(p, random_walk), maturities, yields_panel,
+        scale_grad=scale_grad, forget_factor=spec.forget_factor,
+    )
+    np.testing.assert_allclose(np.asarray(res["preds"]), want_preds, rtol=1e-6, atol=1e-9)
+    want_loss = oracle.msed_loss_from_preds(want_preds, yields_panel)
+    got_loss = float(get_loss(spec, jnp.asarray(p), jnp.asarray(yields_panel)))
+    np.testing.assert_allclose(got_loss, want_loss, rtol=1e-6)
+
+
+def test_msed_lambda_filter_parity(maturities, yields_panel):
+    _filter_parity(maturities, yields_panel, "SD-NS", False, False)
+
+
+def test_msed_lambda_rw_parity(maturities, yields_panel):
+    _filter_parity(maturities, yields_panel, "RWSD-NS", True, False)
+
+
+def test_msed_lambda_scaled_parity(maturities, yields_panel):
+    _filter_parity(maturities, yields_panel, "SSD-NS", False, True)
+
+
+def test_masked_prefix_equals_truncation(maturities, yields_panel):
+    spec, _ = create_model("1SSD-NNS", tuple(maturities), float_type="float64")
+    rng = np.random.default_rng(3)
+    p = np.zeros(spec.n_params)
+    p[0:2] = 1e-4            # A unique (scalar dynamics: 2 uniques)
+    p[2:4] = 0.98            # B unique
+    p[4:22] = rng.standard_normal(18) / 10   # omega (net params)
+    p[22:25] = [0.3, -0.1, 0.05]
+    Phi = np.diag([0.95, 0.9, 0.85])
+    p[25:34] = Phi.T.reshape(-1)
+    full = jnp.asarray(yields_panel)
+    lo, hi = 12, 55
+    masked = float(SD.get_loss(spec, jnp.asarray(p), full, start=lo, end=hi))
+    trunc = float(SD.get_loss(spec, jnp.asarray(p), full[:, lo:hi]))
+    np.testing.assert_allclose(masked, trunc, rtol=1e-8)
+
+
+def test_partial_nan_observed_column_poisons_loss(maturities, yields_panel):
+    spec, _ = create_model("SD-NS", tuple(maturities), float_type="float64")
+    p, _ = _lambda_params(spec)
+    bad = yields_panel.copy()
+    bad[5, 10] = np.nan  # first maturity finite ⇒ still "observed"
+    got = float(get_loss(spec, jnp.asarray(p), jnp.asarray(bad)))
+    assert got == -np.inf
+
+
+def test_outer_gradient_through_inner_score(maturities, yields_panel):
+    """Second-order AD: outer grad of the loss through the per-step inner grad.
+
+    With ``detach_inner_beta=False`` the gradient is exact AD of the loss and
+    must match finite differences.  With the default (reference parity,
+    filter.jl:175 detaches β) it must differ — that drop of β's sensitivity is
+    intentional reference behavior, not an AD bug.
+    """
+    import dataclasses
+
+    spec, _ = create_model("SSD-NS", tuple(maturities), float_type="float64")
+    p, _ = _lambda_params(spec)
+    spec_exact = dataclasses.replace(spec, detach_inner_beta=False)
+
+    def loss_exact(pv):
+        return SD.get_loss(spec_exact, pv, jnp.asarray(yields_panel))
+
+    def loss_ref(pv):
+        return SD.get_loss(spec, pv, jnp.asarray(yields_panel))
+
+    g_exact = np.asarray(jax.grad(loss_exact)(jnp.asarray(p)))
+    g_ref = np.asarray(jax.grad(loss_ref)(jnp.asarray(p)))
+    assert np.all(np.isfinite(g_exact)) and np.all(np.isfinite(g_ref))
+    for i in (0, 2, 5):
+        e = np.zeros_like(p)
+        e[i] = 1e-6
+        fd = (float(loss_exact(jnp.asarray(p + e))) - float(loss_exact(jnp.asarray(p - e)))) / 2e-6
+        np.testing.assert_allclose(g_exact[i], fd, rtol=2e-3, atol=1e-8)
+    # reference-parity gradient intentionally differs from exact AD
+    assert not np.allclose(g_ref, g_exact, rtol=1e-3)

@@ -1,0 +1,67 @@
+"""Static family + random-walk golden tests."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from tests import oracle
+from yieldfactormodels_jl_tpu import create_model, get_loss, predict
+
+
+def _static_params(spec):
+    p = np.zeros(spec.n_params)
+    p[0] = np.log(0.5)
+    p[spec.L:spec.L + 3] = [0.3, -0.1, 0.05]
+    Phi = np.array([[0.95, 0.02, 0.0], [0.01, 0.9, 0.03], [0.0, 0.02, 0.85]])
+    p[spec.L + 3:] = Phi.T.reshape(-1)
+    return p, Phi
+
+
+def test_static_lambda_parity(maturities, yields_panel):
+    spec, _ = create_model("NS", tuple(maturities), float_type="float64")
+    assert spec.n_params == 13  # SURVEY.md §2.13
+    p, Phi = _static_params(spec)
+    Z = oracle.dns_loadings(p[0], maturities)
+    want = oracle.static_filter(Z, p[1:4], Phi, yields_panel)
+    res = predict(spec, jnp.asarray(p), jnp.asarray(yields_panel))
+    np.testing.assert_allclose(np.asarray(res["preds"]), want, rtol=1e-9)
+    want_loss = oracle.msed_loss_from_preds(want, yields_panel)
+    got_loss = float(get_loss(spec, jnp.asarray(p), jnp.asarray(yields_panel)))
+    np.testing.assert_allclose(got_loss, want_loss, rtol=1e-9)
+
+
+def test_static_neural_param_count(maturities):
+    spec, _ = create_model("NNS", tuple(maturities), float_type="float64")
+    assert spec.n_params == 30  # 18 + 3 + 9 (SURVEY.md §2.13)
+
+
+def test_random_walk_predicts_last_observation(maturities, yields_panel):
+    spec, _ = create_model("RW", tuple(maturities), float_type="float64")
+    p = np.zeros(spec.n_params)
+    h = 4
+    ext = np.concatenate([yields_panel, np.full((len(maturities), h), np.nan)], axis=1)
+    res = predict(spec, jnp.asarray(p), jnp.asarray(ext))
+    preds = np.asarray(res["preds"])
+    # observed step t emits y_t; NaN steps keep emitting the last observation
+    np.testing.assert_allclose(preds[:, 10], yields_panel[:, 10])
+    for k in range(1, h + 1):
+        np.testing.assert_allclose(preds[:, -k], yields_panel[:, -1])
+
+
+def test_nan_forecast_extension_is_pure_transition(maturities, yields_panel):
+    """forecasting.jl:141 trick: NaN columns ⇒ h-step-ahead forecasts."""
+    spec, _ = create_model("NS", tuple(maturities), float_type="float64")
+    p, Phi = _static_params(spec)
+    h = 5
+    ext = np.concatenate([yields_panel, np.full((len(maturities), h), np.nan)], axis=1)
+    res = predict(spec, jnp.asarray(p), jnp.asarray(ext))
+    # manual h-step transition: the last observed step already emits
+    # ŷ = Z(μ + Φ·OLS(y_T)); each NaN step applies one more μ + Φβ
+    Z = oracle.dns_loadings(p[0], maturities)
+    delta = p[1:4]
+    mu = (np.eye(3) - Phi) @ delta
+    beta = mu + Phi @ oracle._ols(Z, yields_panel[:, -1])
+    for k in range(h):
+        beta = mu + Phi @ beta
+        np.testing.assert_allclose(
+            np.asarray(res["preds"][:, yields_panel.shape[1] + k]), Z @ beta, rtol=1e-9
+        )

@@ -1,0 +1,48 @@
+"""Bijection parity + round-trip property tests (SURVEY.md §2.7)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from yieldfactormodels_jl_tpu.utils import transformations as tr
+
+
+def test_scalar_bijections_match_reference_formulas():
+    x = np.linspace(-3, 3, 31)
+    np.testing.assert_allclose(tr.from_R_to_pos(x), np.exp(x), rtol=1e-12)
+    np.testing.assert_allclose(
+        tr.from_R_to_11(x), 2 * np.exp(x) / (1 + np.exp(x)) - 1, rtol=1e-12
+    )
+    np.testing.assert_allclose(tr.from_R_to_01(x), 1 / (1 + np.exp(-x)), rtol=1e-12)
+
+
+def test_roundtrips():
+    x = np.linspace(-4, 4, 41)
+    np.testing.assert_allclose(tr.from_pos_to_R(tr.from_R_to_pos(x)), x, atol=1e-10)
+    np.testing.assert_allclose(tr.from_11_to_R(tr.from_R_to_11(x)), x, atol=1e-9)
+    np.testing.assert_allclose(tr.from_01_to_R(tr.from_R_to_01(x)), x, atol=1e-9)
+
+
+def test_coded_vector_apply():
+    params = jnp.asarray([0.5, -1.0, 2.0, 0.3])
+    codes = jnp.asarray([tr.IDENTITY, tr.R_TO_POS, tr.R_TO_11, tr.R_TO_01])
+    out = tr.apply_transforms(params, codes)
+    np.testing.assert_allclose(
+        out,
+        [0.5, np.exp(-1.0), np.tanh(1.0), 1 / (1 + np.exp(-0.3))],
+        rtol=1e-7,
+    )
+    back = tr.apply_untransforms(out, codes)
+    np.testing.assert_allclose(back, params, atol=1e-7)
+
+
+def test_transform_gradients_finite_under_extremes():
+    """The double-where idiom must not leak NaN grads from inactive branches."""
+    params = jnp.asarray([500.0, -500.0, 3.0])  # identity slots would overflow exp
+    codes = jnp.asarray([tr.IDENTITY, tr.IDENTITY, tr.R_TO_POS])
+
+    def s(p):
+        return jnp.sum(tr.apply_transforms(p, codes))
+
+    g = jax.grad(s)(params)
+    assert np.all(np.isfinite(np.asarray(g)))

@@ -1,0 +1,96 @@
+"""yieldfactormodels_jl_tpu — a TPU-native (JAX/XLA/pjit/Pallas) yield-factor-model framework.
+
+A ground-up re-design of the capabilities of Sicco123/YieldFactorModels.jl
+(reference layer map in SURVEY.md §1) for TPU hardware:
+
+- immutable model *specs* + flat parameter vectors (pytrees) instead of mutable
+  structs with in-place ``set_params!`` (reference: src/models/*/
+  paramteroperations.jl),
+- every filter recursion is a single ``lax.scan`` kernel under ``jit``
+  (reference: per-timestep Julia loops in src/models/filter.jl:225,
+  src/models/kalman/filter.jl:190),
+- NaN observations become masked, branchless predict-only steps so multi-step
+  forecasting falls out of the same kernel (reference trick:
+  src/forecasting.jl:141),
+- multi-start estimation, initialization grids, rolling windows and bootstrap
+  resamples are ``vmap``/``shard_map`` batch axes on a device mesh instead of a
+  process farm (reference: src/forecasting.jl:86-136).
+
+The reference contains zero native (C++/CUDA) components (SURVEY.md §2); the
+native layer of this framework is XLA itself plus optional Pallas kernels.
+"""
+
+from .config import default_dtype, set_default_dtype
+from .models.specs import ModelSpec
+from .models.registry import create_model, MODEL_CODES
+from .models import api as model_api
+from .models.api import (
+    get_params,
+    n_params,
+    get_param_groups,
+    get_static_model_type,
+    init_state,
+    get_loss,
+    get_loss_array,
+    predict,
+    update_factor_loadings,
+    random_initial_params,
+)
+from .models.params import (
+    transform_params,
+    untransform_params,
+    expand_params,
+    get_unique_params,
+    get_new_initial_params,
+    initialize_with_static_params,
+)
+from .utils.data_management import load_data
+
+__all__ = [
+    "ModelSpec",
+    "create_model",
+    "MODEL_CODES",
+    "model_api",
+    "get_params",
+    "n_params",
+    "get_param_groups",
+    "get_static_model_type",
+    "init_state",
+    "get_loss",
+    "get_loss_array",
+    "predict",
+    "update_factor_loadings",
+    "random_initial_params",
+    "transform_params",
+    "untransform_params",
+    "expand_params",
+    "get_unique_params",
+    "get_new_initial_params",
+    "initialize_with_static_params",
+    "load_data",
+    "default_dtype",
+    "set_default_dtype",
+]
+
+__version__ = "0.1.0"
+
+# Estimation / forecasting / persistence layers are imported lazily so the
+# core model zoo stays importable in minimal environments.
+def __getattr__(name):
+    if name in ("compute_loss", "estimate", "estimate_steps", "try_initializations"):
+        from .estimation import optimize as _opt
+
+        return getattr(_opt, name)
+    if name == "run_rolling_forecasts":
+        from .forecasting import run_rolling_forecasts
+
+        return run_rolling_forecasts
+    if name == "run":
+        from .run import run
+
+        return run
+    if name == "save_results":
+        from .persistence.io import save_results
+
+        return save_results
+    raise AttributeError(name)

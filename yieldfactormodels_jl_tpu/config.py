@@ -1,0 +1,23 @@
+"""Global configuration.
+
+The reference threads a ``float_type`` through every constructor
+(/root/reference/src/YieldFactorModels.jl:227 ``float_type::Type=Float32``).
+Here dtype lives on the :class:`~yieldfactormodels_jl_tpu.models.specs.ModelSpec`
+and this module only provides the process-wide default (f32 — the TPU-native
+precision; f64 is available for CPU oracle runs via ``jax_enable_x64``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_DEFAULT_DTYPE = jnp.float32
+
+
+def default_dtype():
+    return _DEFAULT_DTYPE
+
+
+def set_default_dtype(dtype) -> None:
+    global _DEFAULT_DTYPE
+    _DEFAULT_DTYPE = jnp.dtype(dtype)

@@ -1,0 +1,12 @@
+from . import specs, loadings, params, registry, api, kalman, score_driven, static_model
+
+__all__ = [
+    "specs",
+    "loadings",
+    "params",
+    "registry",
+    "api",
+    "kalman",
+    "score_driven",
+    "static_model",
+]

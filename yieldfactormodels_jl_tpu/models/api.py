@@ -1,0 +1,99 @@
+"""Family-dispatch façade: one callable surface over the three filter engines.
+
+Mirrors the multiple-dispatch seams of the reference (`get_loss`, `predict`,
+`get_loss_array`, `update_factor_loadings!` dispatch on the model's abstract
+type).  All functions take (spec, constrained-params, data) and are pure — jit
+and vmap them freely.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import kalman, score_driven, static_model
+from .loadings import dns_loadings
+from .params import unpack
+from .specs import ModelSpec
+
+
+def _engine(spec: ModelSpec):
+    if spec.is_kalman:
+        return kalman
+    if spec.is_msed:
+        return score_driven
+    return static_model
+
+
+def get_loss(spec: ModelSpec, params, data, start=0, end=None, K: int = 1):
+    if spec.is_kalman:
+        return kalman.get_loss(spec, params, data, start, end)
+    return _engine(spec).get_loss(spec, params, data, start, end, K)
+
+
+def get_loss_array(spec: ModelSpec, params, data, start=0, end=None, K: int = 1):
+    return _engine(spec).get_loss_array(spec, params, data, start, end, K)
+
+
+def predict(spec: ModelSpec, params, data):
+    return _engine(spec).predict(spec, params, data)
+
+
+def init_state(spec: ModelSpec, params):
+    """The scan carry the filter starts from (β₀/γ₀/P₀...)."""
+    up = unpack(spec, params)
+    if spec.is_kalman:
+        return kalman.init_state(spec, up)
+    if spec.is_msed:
+        return score_driven.init_state(spec, up)
+    return up.delta
+
+
+def update_factor_loadings(spec: ModelSpec, gamma):
+    """Z(γ) for any family (reference: per-family update_factor_loadings!)."""
+    if spec.is_kalman:
+        if spec.family == "kalman_tvl":
+            # TVλ builds Z from the 4th state at filter time
+            raise ValueError("kalman_tvl loadings are state-dependent; see kalman._tvl_measurement")
+        return dns_loadings(gamma, spec.maturities_array)
+    if spec.is_msed:
+        return score_driven.loadings_fn(spec, gamma)
+    return static_model.loadings_fn(spec, gamma)
+
+
+def n_params(spec: ModelSpec) -> int:
+    return spec.n_params
+
+
+def get_params(spec: ModelSpec, params):
+    """Identity view — the flat vector *is* the parameter representation."""
+    return jnp.asarray(params)
+
+
+def get_param_groups(spec: ModelSpec, param_groups=None):
+    """kalmanbasemodel.jl:150-159 etc.: accept a caller-provided grouping only
+    if its length matches; otherwise assign the family default."""
+    if param_groups is not None and len(param_groups) == spec.n_params:
+        return tuple(param_groups)
+    return spec.default_param_groups()
+
+
+def get_static_model_type(spec: ModelSpec) -> str:
+    """Warm-start source model code (dns.jl:46-48, tvλdns.jl:48-50,
+    mselambda.jl:58-60, mseneural.jl:118-123, staticneural.jl:80-85)."""
+    if spec.family == "kalman_dns":
+        return "DNS"
+    if spec.family == "kalman_tvl":
+        return "1C"
+    if spec.family == "msed_lambda" or spec.family == "static_lambda":
+        return "NS"
+    if spec.family in ("msed_neural", "static_neural"):
+        return "NNS" if spec.transform_bool else "NNS-Anchored"
+    return ""  # random walk
+
+
+def random_initial_params(spec: ModelSpec, seed: int = 0):
+    """U(0,1) draw like load_initial_parameters! fallback
+    (YieldFactorModels.jl:145-153)."""
+    rng = np.random.default_rng(seed)
+    return rng.uniform(size=spec.n_params).astype(spec.dtype_name)

@@ -1,0 +1,31 @@
+"""Shared loss-window helpers for the prediction-error engines."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def window_contributions(preds, data, start, end):
+    """−‖y_{t+1} − ŷ_{t+1|t}‖² for contributing steps t (filter.jl:225-234).
+
+    ``preds`` is (T, N) scan output; raw ``data`` (N, T) is used for the target
+    so a NaN inside the window poisons the sum into the reference's −Inf
+    sentinel.  Contributions run t = start .. end−2 (0-based).
+    """
+    T = data.shape[1]
+    t_idx = jnp.arange(T - 1)
+    contrib = (t_idx >= start) & (t_idx <= end - 2)
+    v = data[:, 1:].T - preds[:-1]
+    return jnp.where(contrib, -jnp.sum(v * v, axis=-1), 0.0)
+
+
+def partial_nan_poison(y, obs):
+    """Reference parity for partially-NaN observed columns.
+
+    The score-driven/static engines treat a column as observed iff its *first*
+    entry is finite (filter.jl:53,95); a NaN at any other maturity then flows
+    through OLS and poisons β (and the loss → −Inf).  Returns a multiplicative
+    scalar: 1.0 normally, NaN when an observed column is partially NaN.
+    """
+    bad = obs & ~jnp.all(jnp.isfinite(y))
+    return jnp.where(bad, jnp.nan, 1.0)

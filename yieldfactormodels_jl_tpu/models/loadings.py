@@ -1,0 +1,69 @@
+"""Factor-loading builders: Z(γ) for every model family.
+
+DNS formula parity with /root/reference/src/models/kalman/dns.jl:51-65 (and the
+identical copies in mselambda.jl:63-76, staticlambda.jl:46-60):
+
+    λ = 1e-2 + exp(γ);  Z1 = 1;  Z2 = (1 - e^{-λτ})/(λτ);  Z3 = Z2 - e^{-λτ}
+
+Neural loadings parity with /root/reference/src/models/msedriven/mseneural.jl:
+two tiny MLPs maturity -> loading, ``Chain(Dense(1=>3, tanh), Dense(3=>1; no
+bias))`` (:63-64), parameters packed as γ[0:9] / γ[9:18] in the layout of
+``shapeγ`` (:120-133): W1 = γ[0:3] (3×1), b1 = γ[3:6], W2 = γ[6:9] (1×3).
+Curves are then pinned to NS shape by the transforms in utils/nn_transform.py.
+
+Everything is a pure function of (γ, maturities) returning a fresh (N, M)
+loading matrix — the reference mutates a preallocated Z in place.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..utils.nn_transform import transform_net_1, transform_net_2
+
+LAMBDA_FLOOR = 1e-2
+
+
+def dns_lambda(gamma_scalar):
+    """λ = 1e-2 + exp(γ) (dns.jl:55)."""
+    return LAMBDA_FLOOR + jnp.exp(gamma_scalar)
+
+
+def dns_slope_curvature(lam, maturities):
+    """Columns 2 and 3 of the DNS loading matrix for decay rate(s) ``lam``."""
+    tau = lam * maturities
+    z = jnp.exp(-tau)
+    z2 = (1.0 - z) / tau
+    z3 = z2 - z
+    return z2, z3
+
+
+def dns_loadings(gamma, maturities):
+    """(N, 3) DNS loading matrix from the scalar driver γ (level/slope/curv)."""
+    lam = dns_lambda(jnp.reshape(gamma, ())[None])  # (1,)
+    z2, z3 = dns_slope_curvature(lam, maturities)
+    ones = jnp.ones_like(z2)
+    return jnp.stack([ones, z2, z3], axis=-1)
+
+
+def mlp_curve(p9, maturities):
+    """Evaluate the 1->3(tanh)->1(no bias) loading net at each maturity.
+
+    out[n] = Σ_j W2[j] * tanh(W1[j] * τ_n + b1[j]);  p9 packed as shapeγ
+    (mseneural.jl:120-133).
+    """
+    w1 = p9[..., 0:3]
+    b1 = p9[..., 3:6]
+    w2 = p9[..., 6:9]
+    h = jnp.tanh(maturities[..., :, None] * w1[..., None, :] + b1[..., None, :])
+    return jnp.einsum("...nj,...j->...n", h, w2)
+
+
+def neural_loadings(gamma18, maturities, transform_bool: bool):
+    """(N, 3) neural NS loading matrix from the 18-dim γ state."""
+    raw2 = mlp_curve(gamma18[..., 0:9], maturities)
+    raw3 = mlp_curve(gamma18[..., 9:18], maturities)
+    z2 = transform_net_1(raw2, maturities, transform_bool)
+    z3 = transform_net_2(raw3, maturities, transform_bool)
+    ones = jnp.ones_like(z2)
+    return jnp.stack([ones, z2, z3], axis=-1)

@@ -1,0 +1,228 @@
+"""Flat-parameter pack/unpack, sharing, transforms and initialization.
+
+Pure-functional counterpart of the reference's mutating parameter operations
+(/root/reference/src/models/{kalman/paramoperations.jl,
+msedriven/paramteroperations.jl, static/paramteroperations.jl,
+parameteroperations.jl}).  ``unpack`` builds the structured state-space
+ingredients from a flat *constrained* parameter vector; nothing is mutated.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.transformations import apply_transforms, apply_untransforms
+from .specs import ModelSpec
+
+
+# ---------------------------------------------------------------------------
+# sharing utilities (parameteroperations.jl:4-18)
+# ---------------------------------------------------------------------------
+
+def expand_params(unique_params, duplicator):
+    """unique (u,) -> full (L,) via 0-based duplicator index."""
+    idx = jnp.asarray(duplicator, dtype=jnp.int32)
+    return jnp.take(unique_params, idx, axis=-1)
+
+
+def get_unique_params(full_params, duplicator):
+    """full (L,) -> unique (u,), taking the first occurrence of each index."""
+    dup = np.asarray(duplicator)
+    n_unique = int(dup.max()) + 1
+    first = np.asarray([int(np.argmax(dup == i)) for i in range(n_unique)])
+    return jnp.take(full_params, jnp.asarray(first), axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# transforms (parameteroperations.jl:22-60)
+# ---------------------------------------------------------------------------
+
+def transform_params(spec: ModelSpec, params):
+    return apply_transforms(params, spec.transform_codes_array)
+
+
+def untransform_params(spec: ModelSpec, params):
+    return apply_untransforms(params, spec.transform_codes_array)
+
+
+# ---------------------------------------------------------------------------
+# structured views
+# ---------------------------------------------------------------------------
+
+class MSEDParams(NamedTuple):
+    A: jnp.ndarray        # (L,) expanded step sizes
+    B: Optional[jnp.ndarray]  # (L,) expanded persistence, None if random walk
+    omega: jnp.ndarray    # (L,)
+    delta: jnp.ndarray    # (M,)
+    Phi: jnp.ndarray      # (M, M)
+    mu: jnp.ndarray       # (M,)  = (I - Phi) δ
+    nu: jnp.ndarray       # (L,)  = (1 - B) ⊙ ω  (0 if random walk)
+
+
+class StaticParams(NamedTuple):
+    gamma: jnp.ndarray    # (L,)
+    delta: jnp.ndarray    # (M,)
+    Phi: jnp.ndarray      # (M, M)
+    mu: jnp.ndarray       # (M,)
+
+
+class KalmanParams(NamedTuple):
+    gamma: Optional[jnp.ndarray]  # (1,) λ driver (DNS only)
+    obs_var: jnp.ndarray          # scalar measurement variance
+    Omega_state: jnp.ndarray      # (Ms, Ms) = CᵀC
+    delta: jnp.ndarray            # (Ms,)
+    Phi: jnp.ndarray              # (Ms, Ms)
+
+
+def unpack_msed(spec: ModelSpec, params) -> MSEDParams:
+    """msedriven/paramteroperations.jl:25-65 semantics: β₀=δ, γ₀=ω, μ=(I−Φ)δ,
+    ν=(1−B)⊙ω; Φ filled column-major."""
+    M = spec.M
+    A = expand_params(spec.slice(params, "A"), spec.duplicator)
+    if spec.random_walk:
+        B = None
+    else:
+        B = expand_params(spec.slice(params, "B"), spec.duplicator)
+    omega = spec.slice(params, "omega")
+    delta = spec.slice(params, "delta")
+    Phi = spec.slice(params, "phi").reshape(params.shape[:-1] + (M, M))
+    Phi = jnp.swapaxes(Phi, -1, -2)  # column-major vec -> matrix
+    mu = delta - Phi @ delta
+    nu = jnp.zeros_like(omega) if B is None else (1.0 - B) * omega
+    return MSEDParams(A, B, omega, delta, Phi, mu, nu)
+
+
+def unpack_static(spec: ModelSpec, params) -> StaticParams:
+    M = spec.M
+    gamma = spec.slice(params, "gamma")
+    delta = spec.slice(params, "delta")
+    Phi = spec.slice(params, "phi").reshape(params.shape[:-1] + (M, M))
+    Phi = jnp.swapaxes(Phi, -1, -2)
+    mu = delta - Phi @ delta
+    return StaticParams(gamma, delta, Phi, mu)
+
+
+def unpack_kalman(spec: ModelSpec, params) -> KalmanParams:
+    """kalman/paramoperations.jl:6-58: Ω_obs = σ²I; Ω_state = CᵀC with C the
+    upper-triangular factor filled column-by-column; Φ filled row-major."""
+    Ms = spec.state_dim
+    gamma = spec.slice(params, "gamma") if spec.family == "kalman_dns" else None
+    obs_var = spec.slice(params, "obs_var")[..., 0]
+    chol_flat = spec.slice(params, "chol")
+    rows, cols = spec.chol_indices
+    C = jnp.zeros(params.shape[:-1] + (Ms, Ms), dtype=params.dtype)
+    C = C.at[..., rows, cols].set(chol_flat)
+    Omega_state = jnp.swapaxes(C, -1, -2) @ C
+    delta = spec.slice(params, "delta")
+    Phi = spec.slice(params, "phi").reshape(params.shape[:-1] + (Ms, Ms))
+    return KalmanParams(gamma, obs_var, Omega_state, delta, Phi)
+
+
+def unpack(spec: ModelSpec, params):
+    if spec.is_kalman:
+        return unpack_kalman(spec, params)
+    if spec.is_msed:
+        return unpack_msed(spec, params)
+    return unpack_static(spec, params)
+
+
+# ---------------------------------------------------------------------------
+# initialization (get_new_initial_params / initialize_with_static_params)
+# ---------------------------------------------------------------------------
+
+def get_new_initial_params(spec: ModelSpec, params, trial: int, rng: np.random.Generator | None = None):
+    """Trial-indexed initial parameter proposals.
+
+    - MSED: enumerate the A×B guess grid (msedriven/paramteroperations.jl:132-187);
+      returns None once the grid is exhausted.
+    - static λ: jitter non-(δ,Φ) by U(-0.05, 0.05) (static/paramteroperations.jl:89-97)
+    - static neural: structured randn/10 layer init (:99-114)
+    - kalman: standard normal redraw (kalman/paramoperations.jl:92-97)
+
+    ``trial`` is 1-based (Julia convention; the grid walk below depends on it).
+    """
+    if trial < 1:
+        raise ValueError(f"trial is 1-based; got {trial}")
+    params = np.asarray(params, dtype=np.float64).copy()
+    if rng is None:
+        rng = np.random.default_rng(trial)
+
+    if spec.is_msed:
+        num_A = len(spec.A_guesses)
+        num_B = 0 if spec.random_walk else len(spec.B_guesses)
+        u = spec.n_unique
+        has_B = num_B > 0
+        if u == 1:
+            total = num_A * num_B if has_B else num_A
+        else:
+            total = (num_A ** 2) * (num_B ** 2) if has_B else num_A ** 2
+        if trial > total:
+            return None
+        t = trial - 1
+        if u == 1:
+            if has_B:
+                params[0] = spec.A_guesses[t // num_B]
+                params[1] = spec.B_guesses[t % num_B]
+            else:
+                params[0] = spec.A_guesses[t]
+        else:
+            half = u // 2
+            if has_B:
+                a1 = t // (num_A * num_B ** 2)
+                rem = t % (num_A * num_B ** 2)
+                a2 = rem // (num_B ** 2)
+                rem = rem % (num_B ** 2)
+                b1 = rem // num_B
+                b2 = rem % num_B
+                params[0:half] = spec.A_guesses[a1]
+                params[half:u] = spec.A_guesses[a2]
+                params[u:u + half] = spec.B_guesses[b1]
+                params[u + half:2 * u] = spec.B_guesses[b2]
+            else:
+                params[0:half] = spec.A_guesses[t // num_A]
+                params[half:u] = spec.A_guesses[t % num_A]
+        return params
+
+    if spec.family == "static_neural":
+        params[0:3] = rng.standard_normal(3) / 10
+        params[3:6] = 0.0
+        params[6:9] = rng.standard_normal(3) / 10
+        params[9:12] = rng.standard_normal(3) / 10
+        params[12:15] = 0.0
+        params[15:18] = rng.standard_normal(3) / 10
+        return params
+
+    if spec.is_static:
+        tail = spec.M * (spec.M + 1)
+        head = params.shape[0] - tail
+        params[:head] += rng.uniform(size=head) * 0.1 - 0.05
+        return params
+
+    # kalman
+    return rng.standard_normal(params.shape[0])
+
+
+def initialize_with_static_params(spec: ModelSpec, params, static_params):
+    """Warm start from a simpler (static) model's fitted parameters.
+
+    - MSED: overwrite the [ω; δ; Φ] tail (msedriven/paramteroperations.jl:124-128)
+    - TVλ: index map from the "1C" fit (kalman/paramoperations.jl:78-89)
+    - others: no-op
+    """
+    params = np.asarray(params, dtype=np.float64).copy()
+    sp = np.asarray(static_params, dtype=np.float64).reshape(-1)
+    if spec.is_msed:
+        params[len(params) - len(sp):] = sp
+        return params
+    if spec.family == "kalman_tvl":
+        params[0:1] = sp[1:2]
+        params[1:7] = sp[-18:-12]
+        params[11:14] = sp[-12:-9]
+        params[15:18] = sp[-9:-6]
+        params[19:22] = sp[-6:-3]
+        params[23:26] = sp[-3:]
+        return params
+    return params

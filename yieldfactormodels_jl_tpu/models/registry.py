@@ -1,0 +1,114 @@
+"""String-code → ModelSpec registry — the plugin boundary.
+
+Full parity with /root/reference/src/model_dictionary.jl:7-128: all 34 model
+codes and their numeric aliases, including the "-Anchored" variants
+(transform_bool=False), the `pC`/`vanillaNN` placeholders (return None) and the
+random-walk benchmark.  Unknown codes raise ValueError (:124).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from .specs import ModelSpec, make_duplicator
+
+_NET_SIZE = 3
+_NEURAL_L = 3 * _NET_SIZE * 2  # 18 (mseneural.jl:30)
+
+
+def _msed_lambda(rw: bool, sg: bool):
+    return dict(
+        family="msed_lambda", L=1, duplicator=(0,), random_walk=rw,
+        scale_grad=sg, forget_factor=0.98,  # mselambda.jl:15
+    )
+
+
+def _msed_neural(dynamics: str, rw: bool, sg: bool, anchored: bool):
+    return dict(
+        family="msed_neural", L=_NEURAL_L,
+        duplicator=make_duplicator(dynamics, _NEURAL_L, _NET_SIZE),
+        dynamics=dynamics, random_walk=rw, scale_grad=sg,
+        forget_factor=0.9,  # mseneural.jl:28
+        transform_bool=not anchored,
+    )
+
+
+def _build_table():
+    t = {}
+
+    def add(code, alias, **kw):
+        t[code] = (code, kw)
+        t[alias] = (code, kw)
+
+    add("1C", "0", family="kalman_dns", L=1)
+    add("TVλ", "1", family="kalman_tvl", L=1)
+    add("NS", "2", family="static_lambda", L=1)
+    add("NNS", "3", family="static_neural", L=_NEURAL_L)
+
+    add("SD-NS", "4", **_msed_lambda(False, False))
+    add("RWSD-NS", "5", **_msed_lambda(True, False))
+    add("SSD-NS", "6", **_msed_lambda(False, True))
+    add("SRWSD-NS", "7", **_msed_lambda(True, True))
+
+    dyn = {"1": "scalar", "2": "block_diag", "3": "diag"}
+    num = 8
+    for sg in (False, True):
+        for d in ("1", "2", "3"):
+            for rw in (False, True):
+                code = f"{d}{'S' if sg else ''}{'RW' if rw else ''}SD-NNS"
+                add(code, str(num), **_msed_neural(dyn[d], rw, sg, anchored=False))
+                num += 1
+    assert num == 20
+
+    add("NNS-Anchored", "20", family="static_neural", L=_NEURAL_L, transform_bool=False)
+    num = 21
+    for sg in (False, True):
+        for d in ("1", "2", "3"):
+            for rw in (False, True):
+                code = f"{d}{'S' if sg else ''}{'RW' if rw else ''}SD-NNS-Anchored"
+                add(code, str(num), **_msed_neural(dyn[d], rw, sg, anchored=True))
+                num += 1
+    assert num == 33
+
+    t["pC"] = ("pC", None)
+    t["1100"] = ("pC", None)
+    t["vanillaNN"] = ("vanillaNN", None)
+    t["a"] = ("vanillaNN", None)
+    add("RW", "-1", family="random_walk", L=1)
+    return t
+
+
+_TABLE = _build_table()
+MODEL_CODES = sorted({canon for canon, _ in _TABLE.values()})
+
+
+def create_model(
+    model_type: str,
+    maturities,
+    N: Optional[int] = None,
+    M: int = 3,
+    float_type="float32",
+    results_location: str = "results/",
+) -> Tuple[Optional[ModelSpec], str]:
+    """model_dictionary.jl:7 equivalent.  Returns (spec | None, canonical code)."""
+    if model_type not in _TABLE:
+        raise ValueError(f"Invalid model type: {model_type}")
+    canon, kw = _TABLE[model_type]
+    if kw is None:  # pC / vanillaNN placeholders (model_dictionary.jl:114-119)
+        return None, canon
+    mats = tuple(float(m) for m in maturities)
+    if N is not None and N != len(mats):
+        raise ValueError(f"N={N} does not match len(maturities)={len(mats)}")
+    import numpy as _np
+
+    dtype_name = _np.dtype(float_type).name
+    spec = ModelSpec(
+        model_code=canon,
+        maturities=mats,
+        M=M,
+        dtype_name=dtype_name,
+        model_string=model_type,
+        results_location=results_location,
+        **kw,
+    )
+    return spec, canon

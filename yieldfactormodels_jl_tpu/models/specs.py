@@ -1,0 +1,265 @@
+"""Immutable model specifications.
+
+The reference represents a model as a mutable struct bundling dims, parameter
+buffers, scratch arrays and transform function vectors
+(/root/reference/src/models/kalman/kalmanbasemodel.jl:6-41,
+ msedriven/msebasemodel.jl:8-104, static/staticbasemodel.jl:8-83).
+
+TPU-native design: a model is a hashable, frozen :class:`ModelSpec` (static
+under ``jit``) plus a flat parameter *vector* (a traced array).  All state the
+reference mutates (β, γ, P, EWMA...) lives in the scan carry of the filter
+kernels instead.
+
+The flat parameter layout is byte-for-byte the reference's ``get_params``
+ordering so parameter files and warm starts are interchangeable:
+
+- kalman_dns   [γ_λ | σ²_obs | chol(Ω_state) | δ | vec_rowmajor(Φ)]   (20 for M=3)
+  (kalman/paramoperations.jl:44-58 + :6-41)
+- kalman_tvl   [σ²_obs | chol(Ω_state) | δ | vec_rowmajor(Φ)]          (31, Ms=M+1)
+  (kalman/paramoperations.jl:61-68; tvλdns.jl:24)
+- msed_*       [uniq A | uniq B (unless RW) | ω | δ | vec_colmajor(Φ)]
+  (msedriven/paramteroperations.jl:3-22)
+- static_* / random_walk  [γ | δ | vec_colmajor(Φ)]
+  (static/paramteroperations.jl:3-16)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import cached_property
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import transformations as tr
+
+KALMAN_FAMILIES = ("kalman_dns", "kalman_tvl")
+MSED_FAMILIES = ("msed_lambda", "msed_neural")
+STATIC_FAMILIES = ("static_lambda", "static_neural", "random_walk")
+ALL_FAMILIES = KALMAN_FAMILIES + MSED_FAMILIES + STATIC_FAMILIES
+
+
+@jax.tree_util.register_static
+@dataclasses.dataclass(frozen=True)
+class ModelSpec:
+    """Static description of a yield-factor model (hashable; safe under jit)."""
+
+    family: str
+    model_code: str
+    maturities: Tuple[float, ...]
+    M: int = 3
+    L: int = 1
+    dtype_name: str = "float32"
+
+    # score-driven family options (msebasemodel.jl:73, :95-104)
+    random_walk: bool = False
+    scale_grad: bool = False
+    forget_factor: float = 0.9
+    dynamics: Optional[str] = None  # 'scalar' | 'block_diag' | 'diag'
+    duplicator: Tuple[int, ...] = ()  # 0-based unique-parameter index per state
+
+    # neural loading option: False = "-Anchored" codes (model_dictionary.jl:74-112)
+    transform_bool: bool = True
+
+    # EKF Jacobian: reference analytic formula (kalman/filter.jl:43) has a
+    # quirk vs the true derivative; False reproduces the reference.
+    exact_jacobian: bool = False
+
+    # Score-driven inner score: the reference detaches β inside the inner
+    # gradient (ForwardDiff.value., filter.jl:175), which also drops β's
+    # sensitivity from the *outer* MLE gradient.  True reproduces that; False
+    # gives the exact AD gradient of the loss (matches finite differences).
+    detach_inner_beta: bool = True
+
+    # persistence context (kalmanbasemodel.jl init_folder/results_folder)
+    model_string: str = ""
+    results_location: str = "results/"
+
+    # ---- basic derived facts -------------------------------------------------
+
+    def __post_init__(self):
+        if self.family not in ALL_FAMILIES:
+            raise ValueError(f"unknown family {self.family!r}")
+        if not self.model_string:
+            object.__setattr__(self, "model_string", self.model_code)
+
+    @property
+    def N(self) -> int:
+        return len(self.maturities)
+
+    @property
+    def dtype(self):
+        return jnp.dtype(self.dtype_name)
+
+    @property
+    def state_dim(self) -> int:
+        """Kalman state dimension (M+1 for TVλ — tvλdns.jl:24)."""
+        return self.M + 1 if self.family == "kalman_tvl" else self.M
+
+    @property
+    def n_unique(self) -> int:
+        return (max(self.duplicator) + 1) if self.duplicator else self.L
+
+    @property
+    def maturities_array(self) -> jnp.ndarray:
+        return jnp.asarray(self.maturities, dtype=self.dtype)
+
+    @property
+    def is_kalman(self) -> bool:
+        return self.family in KALMAN_FAMILIES
+
+    @property
+    def is_msed(self) -> bool:
+        return self.family in MSED_FAMILIES
+
+    @property
+    def is_static(self) -> bool:
+        return self.family in STATIC_FAMILIES
+
+    # ---- flat parameter layout ----------------------------------------------
+
+    @cached_property
+    def layout(self) -> dict:
+        """name -> (start, stop) slices into the flat parameter vector."""
+        M, L, u = self.M, self.L, self.n_unique
+        pos = 0
+        lay = {}
+
+        def put(name, size):
+            nonlocal pos
+            lay[name] = (pos, pos + size)
+            pos += size
+
+        if self.is_kalman:
+            Ms = self.state_dim
+            if self.family == "kalman_dns":
+                put("gamma", 1)
+            put("obs_var", 1)
+            put("chol", Ms * (Ms + 1) // 2)
+            put("delta", Ms)
+            put("phi", Ms * Ms)
+        elif self.is_msed:
+            put("A", u)
+            if not self.random_walk:
+                put("B", u)
+            put("omega", L)
+            put("delta", M)
+            put("phi", M * M)
+        else:
+            put("gamma", L)
+            put("delta", M)
+            put("phi", M * M)
+        lay["__total__"] = (0, pos)
+        return lay
+
+    @property
+    def n_params(self) -> int:
+        return self.layout["__total__"][1]
+
+    def slice(self, params, name):
+        a, b = self.layout[name]
+        return params[..., a:b]
+
+    # ---- transform codes -----------------------------------------------------
+
+    @cached_property
+    def transform_codes(self) -> Tuple[int, ...]:
+        """Per-parameter bijection codes, ordered like the flat layout.
+
+        Kalman list construction: kalmanbasemodel.jl:74-120; MSED/static:
+        msebasemodel.jl:79-92 / staticbasemodel.jl:47-60; model-specific heads:
+        dns.jl:21-22, mselambda.jl:17-24, mseneural.jl:33-51.
+        """
+        codes: list[int] = []
+        M = self.M
+        if self.is_kalman:
+            Ms = self.state_dim
+            if self.family == "kalman_dns":
+                codes.append(tr.IDENTITY)  # λ driver γ
+            codes.append(tr.R_TO_POS)  # observation variance
+            for j in range(Ms):  # chol, column-by-column; diag positive
+                for i in range(j + 1):
+                    codes.append(tr.R_TO_POS if i == j else tr.IDENTITY)
+            codes.extend([tr.IDENTITY] * Ms)  # delta
+            for i in range(Ms):  # Phi row-major, diag in (-1,1)
+                for j in range(Ms):
+                    codes.append(tr.R_TO_11 if i == j else tr.IDENTITY)
+        elif self.is_msed:
+            u = self.n_unique
+            codes.extend([tr.R_TO_POS] * u)  # step sizes A > 0
+            if not self.random_walk:
+                codes.extend([tr.R_TO_01] * u)  # persistence B in (0,1)
+            codes.extend([tr.IDENTITY] * self.L)  # omega
+            codes.extend([tr.IDENTITY] * M)  # delta
+            for k in range(M * M):  # Phi col-major, diag in (-1,1)
+                codes.append(tr.R_TO_11 if k % (M + 1) == 0 else tr.IDENTITY)
+        else:
+            codes.extend([tr.IDENTITY] * self.L)  # gamma
+            codes.extend([tr.IDENTITY] * M)  # delta
+            for k in range(M * M):
+                codes.append(tr.R_TO_11 if k % (M + 1) == 0 else tr.IDENTITY)
+        assert len(codes) == self.n_params
+        return tuple(codes)
+
+    @property
+    def transform_codes_array(self) -> jnp.ndarray:
+        return jnp.asarray(self.transform_codes, dtype=jnp.int32)
+
+    # ---- default parameter groups (block-coordinate estimation) -------------
+
+    def default_param_groups(self) -> Tuple[str, ...]:
+        """kalman: all "1" (kalmanbasemodel.jl:150-159); msed/static: head "1",
+        (δ, Φ) block "2" (msebasemodel.jl:153-162, staticbasemodel.jl:103-112)."""
+        n = self.n_params
+        if self.is_kalman:
+            return tuple(["1"] * n)
+        tail = self.M * (self.M + 1)
+        return tuple(["1"] * (n - tail) + ["2"] * tail)
+
+    # ---- initialization grids (mselambda.jl:26-27, mseneural.jl:53-54) ------
+
+    @property
+    def A_guesses(self) -> Tuple[float, ...]:
+        if self.family == "msed_lambda":
+            return (1e-6, 1e-5, 1e-4, 1e-3, 1e-2, 1e-1)
+        if self.family == "msed_neural":
+            return (1e-6, 1e-5, 1e-4, 1e-3)
+        return ()
+
+    @property
+    def B_guesses(self) -> Tuple[float, ...]:
+        if self.random_walk:
+            return ()
+        if self.family == "msed_lambda":
+            return (0.9, 0.95, 0.98, 0.99, 0.999)
+        if self.family == "msed_neural":
+            return (0.97, 0.98, 0.99, 0.999)
+        return ()
+
+    # ---- chol index helpers --------------------------------------------------
+
+    @cached_property
+    def chol_indices(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(rows, cols) positions of the upper-triangular Cholesky-like factor
+        in flat fill order (kalman/paramoperations.jl:17-33: column by column)."""
+        Ms = self.state_dim
+        rows, cols = [], []
+        for j in range(Ms):
+            for i in range(j + 1):
+                rows.append(i)
+                cols.append(j)
+        return np.asarray(rows), np.asarray(cols)
+
+
+def make_duplicator(dynamics: str, L: int, net_size: int = 3) -> Tuple[int, ...]:
+    """Parameter-sharing index (0-based) per γ-state (mseneural.jl:33-51)."""
+    if dynamics == "scalar":
+        half = L // 2
+        return tuple([0] * half + [1] * half)
+    if dynamics == "block_diag":
+        return tuple(i // net_size for i in range(L))
+    if dynamics == "diag":
+        return tuple(range(L))
+    raise ValueError("dynamics must be 'scalar', 'block_diag' or 'diag'")

@@ -1,0 +1,127 @@
+"""Static (fixed-loading) filters: OLS cross-sections and the random-walk
+benchmark, as `lax.scan` kernels.
+
+Parity targets: /root/reference/src/models/filter.jl:93-110 (static OLS +
+transition), :112-120 (random walk), with the same get_loss/get_loss_array/
+predict conventions as the score-driven family (:209-306).
+
+Because γ is a *static* parameter here, Z is computed once outside the scan —
+the reference recomputes nothing either (update_factor_loadings! only runs in
+set_params!, static/paramteroperations.jl:42).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.linalg import ols_solve
+from .common import partial_nan_poison, window_contributions
+from .loadings import dns_loadings, neural_loadings
+from .params import StaticParams, unpack_static
+from .specs import ModelSpec
+
+
+def loadings_fn(spec: ModelSpec, gamma):
+    mats = spec.maturities_array
+    if spec.family == "static_lambda":
+        return dns_loadings(gamma, mats)
+    if spec.family == "static_neural":
+        return neural_loadings(gamma, mats, spec.transform_bool)
+    # random walk: loadings are the untouched all-ones Z (randomwalk.jl:46-49)
+    return jnp.ones((spec.N, spec.M), dtype=gamma.dtype)
+
+
+def _static_scan(spec: ModelSpec, sp: StaticParams, Z, data, start, end):
+    T = data.shape[1]
+    t_idx = jnp.arange(T)
+    observed_mask = (t_idx >= start) & (t_idx < end)
+
+    def body(beta, inp):
+        y, obs_t = inp
+        obs = obs_t & jnp.isfinite(y[0])
+        ysafe = jnp.where(jnp.isfinite(y), y, 0.0)
+        beta_ols = ols_solve(Z, ysafe)
+        # partially-NaN observed column ⇒ NaN β, loss −Inf (reference parity)
+        beta_obs = jnp.where(obs, beta_ols, beta) * partial_nan_poison(y, obs)
+        beta_next = sp.mu + sp.Phi @ beta_obs
+        pred = Z @ beta_next
+        return beta_next, {"pred": pred, "beta": beta_next}
+
+    beta0 = sp.delta  # set_params!: β = δ (static/paramteroperations.jl:40)
+    _, outs = lax.scan(body, beta0, (data.T, observed_mask))
+    return outs
+
+
+def _rw_scan(spec: ModelSpec, data, start, end):
+    T = data.shape[1]
+    t_idx = jnp.arange(T)
+    observed_mask = (t_idx >= start) & (t_idx < end)
+
+    def body(last_y, inp):
+        y, obs_t = inp
+        obs = obs_t & jnp.isfinite(y[0])
+        new_last = jnp.where(obs, jnp.where(jnp.isfinite(y), y, last_y), last_y)
+        return new_last, {"pred": new_last}
+
+    last0 = jnp.zeros((spec.N,), dtype=data.dtype)
+    _, outs = lax.scan(body, last0, (data.T, observed_mask))
+    return outs
+
+
+def _run(spec: ModelSpec, params, data, start, end):
+    if spec.family == "random_walk":
+        return None, None, _rw_scan(spec, data, start, end)
+    sp = unpack_static(spec, params)
+    Z = loadings_fn(spec, sp.gamma)
+    return sp, Z, _static_scan(spec, sp, Z, data, start, end)
+
+
+def get_loss(spec: ModelSpec, params, data, start=0, end=None, K: int = 1):
+    T = data.shape[1]
+    if end is None:
+        end = T
+    nobs = end - start
+    total = 0.0
+    for _ in range(K):  # static filters have no cross-pass state
+        _, _, outs = _run(spec, params, data, start, end)
+        total = total + jnp.sum(window_contributions(outs["pred"], data, start, end))
+    loss = total / spec.N / nobs / K
+    return jnp.where(jnp.isfinite(loss), loss, -jnp.inf)
+
+
+def get_loss_array(spec: ModelSpec, params, data, start=0, end=None, K: int = 1):
+    T = data.shape[1]
+    if end is None:
+        end = T
+    _, _, outs = _run(spec, params, data, start, end)
+    return window_contributions(outs["pred"], data, start, end) * (1.0 / spec.N)
+
+
+def predict(spec: ModelSpec, params, data):
+    T = data.shape[1]
+    if spec.family == "random_walk":
+        outs = _rw_scan(spec, data, 0, T)
+        zeros_M = jnp.zeros((spec.M, T), dtype=data.dtype)
+        zeros_L = jnp.zeros((spec.L, T), dtype=data.dtype)
+        ones_N = jnp.ones((spec.N, T), dtype=data.dtype)
+        return {
+            "preds": outs["pred"].T,
+            "factors": zeros_M,     # RW never writes β/γ (randomwalk.jl:3-32)
+            "states": zeros_L,
+            "factor_loadings_1": ones_N,  # untouched all-ones Z columns
+            "factor_loadings_2": ones_N,
+        }
+    sp, Z, outs = _run(spec, params, data, 0, T)
+    gamma_states = jnp.broadcast_to(sp.gamma, (T, spec.L)).T
+    fl1 = jnp.broadcast_to(Z[:, 1], (T, spec.N)).T
+    fl2 = jnp.broadcast_to(Z[:, 2], (T, spec.N)).T
+    return {
+        "preds": outs["pred"].T,
+        "factors": outs["beta"].T,
+        "states": gamma_states,
+        "factor_loadings_1": fl1,
+        "factor_loadings_2": fl2,
+    }

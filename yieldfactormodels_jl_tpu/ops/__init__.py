@@ -1,0 +1,3 @@
+from . import linalg
+
+__all__ = ["linalg"]

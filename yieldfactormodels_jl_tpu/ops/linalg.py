@@ -1,0 +1,26 @@
+"""Small dense linear-algebra helpers shared by the filter kernels."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+RIDGE = 1e-3
+
+
+def ols_solve(Z, y):
+    """β = (ZᵀZ)⁻¹Zᵀy via Cholesky, with the reference's ridge fallback.
+
+    The reference tries a plain Cholesky of ZᵀZ and, on failure, retries with
+    +1e-3 on the diagonal (/root/reference/src/models/filter.jl:122-137).
+    Branchlessly: factor both and select — a 3×3 Cholesky is free next to the
+    surrounding matmuls, and the select keeps the kernel jit/vmap-safe.
+    """
+    M = Z.shape[-1]
+    G = Z.T @ Z
+    b = Z.T @ y
+    cho = jnp.linalg.cholesky(G)
+    ok = jnp.all(jnp.isfinite(cho))
+    cho_ridge = jnp.linalg.cholesky(G + RIDGE * jnp.eye(M, dtype=G.dtype))
+    cho_sel = jnp.where(ok, jnp.nan_to_num(cho), cho_ridge)
+    return jax.scipy.linalg.cho_solve((cho_sel, True), b)

@@ -1,0 +1,69 @@
+"""Shape transforms that pin learned loading curves to Nelson–Siegel form.
+
+Pure-functional equivalents of the in-place kernels in
+/root/reference/src/utils/neural_network_transform.jl:
+
+- ``transform_net_1`` (slope-type curve): 1 at the short end, 0 at the long
+  end, squared for positivity.  "Transformed" variant (:6-24) rescales by the
+  first/last raw gap first; "anchored" variant (:61-...) just squares.
+- ``transform_net_2`` (curvature/hump): 0 at both ends, squared, normalized by
+  ``sqrt(sum(r^4))/scale``.  The transformed variant (:27-59) first removes the
+  straight line through the endpoint raw values.  Note the reference computes
+  the line as ``slope*x - intercept`` (sign quirk, :44) — replicated here for
+  behavioural parity.
+
+All variants are branchless index-mask expressions over the full vector so they
+vmap/jit cleanly (the reference mutates `dest` in @simd loops).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_EPS = 1e-7
+_SCALE = 0.9610
+
+
+def transform_net_1(raw, maturities, transformed: bool):
+    """Slope-type loading curve. ``raw``: (..., N) net output, returns (..., N)."""
+    n = raw.shape[-1]
+    idx = jnp.arange(n)
+    interior = (idx >= 1) & (idx <= n - 3)  # reference: 2:n-2 (1-based)
+    if transformed:
+        raw_first = raw[..., 0:1]
+        raw_last = raw[..., n - 2:n - 1]
+        t = (raw - raw_last) / (raw_first - raw_last + _EPS)
+        sq = t * t
+    else:
+        sq = raw * raw
+    out = jnp.where(interior, sq, raw)
+    out = out.at[..., 0].set(1.0)
+    out = out.at[..., n - 2].set(0.0)
+    out = out.at[..., n - 1].set(0.0)
+    return out
+
+
+def transform_net_2(raw, maturities, transformed: bool, scale: float = _SCALE):
+    """Curvature-type loading curve. ``raw``: (..., N), ``maturities``: (N,)."""
+    n = raw.shape[-1]
+    idx = jnp.arange(n)
+    interior = (idx >= 1) & (idx <= n - 2)  # reference: 2:n-1 (1-based)
+    if transformed:
+        x1 = maturities[0]
+        xN = maturities[n - 1]
+        raw1 = raw[..., 0:1]
+        rawN = raw[..., n - 1:n]
+        slope = (rawN - raw1) / (xN - x1)
+        intercept = raw1 - slope * x1
+        # Reference evaluates the detrend line as slope*x - intercept (:44).
+        r = raw - (slope * maturities - intercept)
+        r2 = jnp.where(interior, r * r, 0.0)
+        sum_sq = jnp.sum(r2 * r2, axis=-1, keepdims=True)
+        denom = jnp.sqrt(sum_sq) / scale + _EPS
+        return r2 / denom
+    else:
+        r2 = jnp.where(interior, raw * raw, 0.0)
+        sum_sq = jnp.sum(r2 * r2, axis=-1, keepdims=True)
+        # Anchored variant: multiplier is scale/sqrt(sum_sq) + eps (:96).
+        denom_inv = scale / jnp.sqrt(sum_sq) + _EPS
+        return r2 * denom_inv

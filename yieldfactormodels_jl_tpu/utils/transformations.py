@@ -1,0 +1,82 @@
+"""Scalar parameter bijections, vectorized for TPU.
+
+Semantics match the reference's per-parameter transform functions
+(/root/reference/src/utils/transformations.jl):
+
+- ``R -> pos``:    exp(x)            (inverse log)
+- ``R -> (-1,1)``: 2*sigmoid(x) - 1  (== tanh(x/2); inverse log1p(x)-log1p(-x))
+- ``R -> (0,1)``:  sigmoid(x)        (inverse logit)
+
+The reference stores a ``Vector{Function}`` per model and applies it
+element-wise in a loop (/root/reference/src/models/parameteroperations.jl:22-60).
+That is hostile to XLA, so here each model spec carries an integer *code* per
+parameter and the whole vector is transformed branchlessly in one shot.  The
+"double-where" idiom keeps gradients NaN-free when e.g. ``exp`` would overflow
+on a parameter that belongs to a different code.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Transform codes (stored per-parameter in ModelSpec.transform_codes).
+IDENTITY = 0
+R_TO_POS = 1  # exp       — variances, EWMA step sizes A
+R_TO_11 = 2   # 2σ(x)-1   — Phi diagonals
+R_TO_01 = 3   # σ(x)      — persistence B
+
+
+def from_R_to_pos(x):
+    return jnp.exp(x)
+
+
+def from_pos_to_R(x):
+    return jnp.log(x)
+
+
+def from_R_to_11(x):
+    # 2*exp(x)/(1+exp(x)) - 1 in the reference; tanh(x/2) is the same map,
+    # numerically stable on both tails.
+    return jnp.tanh(x / 2.0)
+
+
+def from_11_to_R(x):
+    return jnp.log1p(x) - jnp.log1p(-x)
+
+
+def from_R_to_01(x):
+    return jax.nn.sigmoid(x)
+
+
+def from_01_to_R(x):
+    return jnp.log(x) - jnp.log1p(-x)
+
+
+def _masked(x, mask, fn, neutral):
+    """Apply ``fn`` only where ``mask``; double-where so the un-taken branch
+    never sees an input that could poison gradients (inf * 0 = NaN)."""
+    safe = jnp.where(mask, x, neutral)
+    return jnp.where(mask, fn(safe), x)
+
+
+def apply_transforms(params, codes):
+    """unconstrained -> constrained, elementwise by integer code."""
+    params = jnp.asarray(params)
+    codes = jnp.asarray(codes)
+    out = params
+    out = _masked(out, codes == R_TO_POS, from_R_to_pos, 0.0)
+    out = _masked(out, codes == R_TO_11, from_R_to_11, 0.0)
+    out = _masked(out, codes == R_TO_01, from_R_to_01, 0.0)
+    return out
+
+
+def apply_untransforms(params, codes):
+    """constrained -> unconstrained, elementwise by integer code."""
+    params = jnp.asarray(params)
+    codes = jnp.asarray(codes)
+    out = params
+    out = _masked(out, codes == R_TO_POS, from_pos_to_R, 1.0)
+    out = _masked(out, codes == R_TO_11, from_11_to_R, 0.0)
+    out = _masked(out, codes == R_TO_01, from_01_to_R, 0.5)
+    return out
