@@ -1,0 +1,196 @@
+"""Benchmark: AFNS5 Kalman log-likelihood throughput, device vs 1-thread CPU.
+
+Measures the BASELINE.md north-star metric — loglik evals/sec for a 5-factor
+arbitrage-free NS model on a Liu–Wu-shaped monthly panel (N=20 maturities,
+T=360 months) — as a batch of independent parameter draws evaluated in one
+jit+vmap'd scan on the accelerator, against a single-thread NumPy oracle that
+mirrors the reference's per-step CPU loop (BLAS pinned to 1 thread,
+/root/reference/test.jl:15-18).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": <device evals/sec>, "unit": "evals/s",
+   "vs_baseline": <device/CPU speedup>}
+
+Robustness: this container reaches its single TPU through the axon PJRT relay,
+whose backend init can wedge indefinitely if a previous client died holding
+the claim.  The measurement therefore runs in a watchdog subprocess
+(BENCH_DEVICE_TIMEOUT, default 900 s); on timeout/failure it reruns itself on
+CPU (JAX, still jit+vmap batched) so the driver always gets its JSON line.
+"""
+
+import json
+import math
+import os
+import subprocess
+import sys
+import time
+
+os.environ.setdefault("OMP_NUM_THREADS", "1")
+os.environ.setdefault("OPENBLAS_NUM_THREADS", "1")
+os.environ.setdefault("MKL_NUM_THREADS", "1")
+
+import numpy as np
+
+BATCH = int(os.environ.get("BENCH_BATCH", "1024"))
+N_MATURITIES = 20
+T_MONTHS = 360
+CPU_EVALS = int(os.environ.get("BENCH_CPU_EVALS", "3"))
+
+MATURITIES = np.array([3, 6, 9, 12, 15, 18, 21, 24, 30, 36, 48, 60, 72, 84,
+                       96, 108, 120, 180, 240, 360], dtype=np.float64) / 12.0
+
+
+def make_panel(seed=0):
+    """Synthetic Liu–Wu-shaped panel from a stationary 5-factor AFNS DGP."""
+    rng = np.random.default_rng(seed)
+    lam1, lam2 = 0.5, 0.15
+    Z = np.ones((N_MATURITIES, 5))
+    for col, lam in ((1, lam1), (3, lam2)):
+        tau = lam * MATURITIES
+        Z[:, col] = (1 - np.exp(-tau)) / tau
+        Z[:, col + 1] = Z[:, col] - np.exp(-tau)
+    Phi = np.diag([0.98, 0.94, 0.9, 0.92, 0.88])
+    delta = np.array([0.08, -0.06, 0.03, -0.02, 0.01])
+    x = np.linalg.solve(np.eye(5) - Phi, delta)
+    data = np.zeros((N_MATURITIES, T_MONTHS))
+    for t in range(T_MONTHS):
+        x = delta + Phi @ x + 0.05 * rng.standard_normal(5)
+        data[:, t] = Z @ x + 0.02 * rng.standard_normal(N_MATURITIES)
+    return data + 4.0
+
+
+def make_param_batch(spec, B, seed=1):
+    rng = np.random.default_rng(seed)
+    p = np.zeros(spec.n_params)
+    p[0], p[1] = math.log(0.5), math.log(0.15)
+    p[2] = 4e-4
+    k = 3
+    for j in range(5):
+        for i in range(j + 1):
+            p[k] = 0.05 + 0.01 * i if i == j else 0.002
+            k += 1
+    p[18:23] = [4.0, -1.0, 0.5, -0.3, 0.2]
+    p[23:48] = np.diag([0.98, 0.94, 0.9, 0.92, 0.88]).reshape(-1)
+    batch = np.tile(p, (B, 1))
+    # jitter the decay drivers and transition diagonal per draw (stationary)
+    batch[:, 0:2] += 0.1 * rng.standard_normal((B, 2))
+    for idx in (23, 29, 35, 41, 47):
+        batch[:, idx] = np.clip(batch[:, idx] + 0.01 * rng.standard_normal(B), 0.5, 0.995)
+    return batch
+
+
+# --------------------------------------------------------------------------
+# single-thread CPU oracle (the reference-equivalent per-step loop)
+# --------------------------------------------------------------------------
+
+def cpu_loglik(Z, adj, Phi, delta, Omega_state, obs_var, data):
+    N, T = data.shape
+    Ms = Phi.shape[0]
+    Omega_obs = obs_var * np.eye(N)
+    beta = np.linalg.solve(np.eye(Ms) - Phi, delta)
+    P = np.linalg.solve(np.eye(Ms * Ms) - np.kron(Phi, Phi),
+                        Omega_state.reshape(-1)).reshape(Ms, Ms)
+    loglik = 0.0
+    c = N * math.log(2 * math.pi)
+    for t in range(T - 1):
+        y = data[:, t]
+        v = y - (Z @ beta + adj)
+        F = Z @ P @ Z.T + Omega_obs
+        F_inv = np.linalg.inv(F)
+        K = P @ Z.T @ F_inv
+        beta = delta + Phi @ (beta + K @ v)
+        P = Phi @ ((np.eye(Ms) - K @ Z) @ P) @ Phi.T + Omega_state
+        if t > 0:
+            _, logdet = np.linalg.slogdet(F)
+            loglik -= 0.5 * (logdet + v @ F_inv @ v + c)
+    return loglik
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from yieldfactormodels_jl_tpu import create_model
+    from yieldfactormodels_jl_tpu.models import api
+    from yieldfactormodels_jl_tpu.models.afns import afns_loadings, yield_adjustment
+    from yieldfactormodels_jl_tpu.models.params import unpack_kalman
+
+    spec, _ = create_model("AFNS5", tuple(MATURITIES), float_type="float32")
+    data = make_panel()
+    batch = make_param_batch(spec, BATCH)
+
+    # ---- CPU baseline: single-thread per-step loop, float64 ----
+    kp0 = unpack_kalman(spec, jnp.asarray(batch[0], dtype=jnp.float64)
+                        if jax.config.jax_enable_x64 else jnp.asarray(batch[0]))
+    Z0 = np.asarray(afns_loadings(jnp.asarray(batch[0, 0:2]), jnp.asarray(MATURITIES), 5),
+                    dtype=np.float64)
+    Om0 = np.asarray(kp0.Omega_state, dtype=np.float64)
+    adj0 = np.asarray(yield_adjustment(jnp.asarray(batch[0, 0:2]), jnp.asarray(Om0),
+                                       jnp.asarray(MATURITIES), 5), dtype=np.float64)
+    t0 = time.perf_counter()
+    for _ in range(CPU_EVALS):
+        ll_cpu = cpu_loglik(Z0, adj0, np.asarray(kp0.Phi, dtype=np.float64),
+                            np.asarray(kp0.delta, dtype=np.float64), Om0,
+                            float(kp0.obs_var), data)
+    cpu_per_eval = (time.perf_counter() - t0) / CPU_EVALS
+    cpu_evals_per_sec = 1.0 / cpu_per_eval
+
+    # ---- device: one jit+vmap batch ----
+    dev_data = jnp.asarray(data, dtype=spec.dtype)
+    dev_batch = jnp.asarray(batch, dtype=spec.dtype)
+    fn = jax.jit(jax.vmap(lambda p: api.get_loss(spec, p, dev_data)))
+    out = jax.block_until_ready(fn(dev_batch))  # compile + warm
+    n_finite = int(np.isfinite(np.asarray(out)).sum())
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(dev_batch)
+    jax.block_until_ready(out)
+    dev_time = (time.perf_counter() - t0) / reps
+    dev_evals_per_sec = BATCH / dev_time
+
+    platform = jax.devices()[0].platform
+    result = {
+        "metric": f"AFNS5 Kalman loglik evals/sec (N={N_MATURITIES}, T={T_MONTHS}, "
+                  f"batch={BATCH}, {platform})",
+        "value": round(dev_evals_per_sec, 2),
+        "unit": "evals/s",
+        "vs_baseline": round(dev_evals_per_sec / cpu_evals_per_sec, 2),
+    }
+    print(json.dumps(result))
+    # context to stderr so stdout stays one JSON line
+    print(f"# cpu 1-thread: {cpu_evals_per_sec:.2f} evals/s; device({platform}): "
+          f"{dev_evals_per_sec:.2f} evals/s; finite: {n_finite}/{BATCH}; "
+          f"cpu ll sample {ll_cpu:.2f}", file=sys.stderr)
+
+
+def _orchestrate():
+    """Run main() in a watchdog subprocess; fall back to CPU on wedge."""
+    here = os.path.abspath(__file__)
+    timeout_s = float(os.environ.get("BENCH_DEVICE_TIMEOUT", "900"))
+    try:
+        proc = subprocess.run([sys.executable, here, "--inner"],
+                              timeout=timeout_s, capture_output=True, text=True)
+        if proc.returncode == 0 and proc.stdout.strip():
+            sys.stdout.write(proc.stdout)
+            sys.stderr.write(proc.stderr[-2000:])
+            return
+        sys.stderr.write(f"# device run failed rc={proc.returncode}; "
+                         f"stderr tail: {proc.stderr[-500:]}\n")
+    except subprocess.TimeoutExpired:
+        sys.stderr.write(f"# device run wedged past {timeout_s}s "
+                         "(axon backend init?); falling back to CPU\n")
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # disable the TPU plugin hook
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run([sys.executable, here, "--inner"], env=env,
+                          timeout=timeout_s, capture_output=True, text=True)
+    sys.stdout.write(proc.stdout)
+    sys.stderr.write(proc.stderr[-2000:])
+
+
+if __name__ == "__main__":
+    if "--inner" in sys.argv:
+        main()
+    else:
+        _orchestrate()

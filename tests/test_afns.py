@@ -1,0 +1,90 @@
+"""AFNS3/AFNS5 tests: loadings, yield adjustment, Kalman integration."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from tests import oracle
+from yieldfactormodels_jl_tpu import create_model, get_loss, predict
+from yieldfactormodels_jl_tpu.models.afns import (
+    afns_lambdas, afns_loadings, yield_adjustment
+)
+
+
+def _afns5_params(spec):
+    """[γ(2), σ², chol(15), δ(5), Φ(25)] = 48."""
+    assert spec.n_params == 48
+    p = np.zeros(48)
+    p[0] = np.log(0.5)
+    p[1] = np.log(0.15)
+    p[2] = 4e-4
+    k = 3
+    C = np.zeros((5, 5))
+    for j in range(5):
+        for i in range(j + 1):
+            v = 0.05 + 0.01 * i if i == j else 0.002
+            C[i, j] = v
+            p[k] = v
+            k += 1
+    p[18:23] = [4.0, -1.0, 0.5, -0.3, 0.2]
+    Phi = np.diag([0.98, 0.94, 0.9, 0.92, 0.88])
+    p[23:48] = Phi.reshape(-1)
+    return p, C.T @ C, Phi
+
+
+def test_afns5_loadings_structure(maturities):
+    gamma = jnp.asarray([np.log(0.5), np.log(0.15)])
+    Z = np.asarray(afns_loadings(gamma, jnp.asarray(maturities), 5))
+    assert Z.shape == (len(maturities), 5)
+    np.testing.assert_allclose(Z[:, 0], 1.0)
+    lam1, lam2 = np.asarray(afns_lambdas(gamma))
+    for col, lam in ((1, lam1), (3, lam2)):
+        tau = lam * maturities
+        np.testing.assert_allclose(Z[:, col], (1 - np.exp(-tau)) / tau, rtol=1e-7)
+        np.testing.assert_allclose(Z[:, col + 1], Z[:, col] - np.exp(-tau), rtol=1e-6)
+
+
+def test_yield_adjustment_against_dense_quadrature(maturities):
+    """Quadrature result converges: 64-point grid ≈ 2048-point grid."""
+    gamma = jnp.asarray([np.log(0.5), np.log(0.15)])
+    Omega = np.diag([0.01, 0.02, 0.03, 0.015, 0.025])
+    a64 = np.asarray(yield_adjustment(gamma, jnp.asarray(Omega),
+                                      jnp.asarray(maturities), 5, quad_points=64))
+    a2k = np.asarray(yield_adjustment(gamma, jnp.asarray(Omega),
+                                      jnp.asarray(maturities), 5, quad_points=2048))
+    np.testing.assert_allclose(a64, a2k, rtol=2e-3, atol=1e-9)
+    assert np.all(a64 <= 0)  # positive-semidefinite Ω ⇒ non-positive adjustment
+    # level-only Ω has closed form: α(τ) = −σ²τ²/6
+    Ol = np.zeros((5, 5)); Ol[0, 0] = 0.01
+    al = np.asarray(yield_adjustment(gamma, jnp.asarray(Ol),
+                                     jnp.asarray(maturities), 5, quad_points=512))
+    np.testing.assert_allclose(al, -0.01 * maturities ** 2 / 6, rtol=1e-5)
+
+
+def test_afns5_kalman_loglik_matches_oracle(maturities, yields_panel):
+    spec, canon = create_model("AFNS5", tuple(maturities), float_type="float64")
+    assert canon == "AFNS5" and spec.M == 5 and spec.L == 2
+    p, Omega, Phi = _afns5_params(spec)
+    # oracle: generic Kalman with the AFNS Z and the adjustment folded into data
+    Z = np.asarray(afns_loadings(jnp.asarray(p[0:2]), jnp.asarray(maturities), 5))
+    adj = np.asarray(yield_adjustment(jnp.asarray(p[0:2]), jnp.asarray(Omega),
+                                      jnp.asarray(maturities), 5))
+    want = oracle.kalman_filter_loglik(Z, Phi, p[18:23], Omega, p[2],
+                                       yields_panel - adj[:, None])
+    got = float(get_loss(spec, jnp.asarray(p), jnp.asarray(yields_panel)))
+    np.testing.assert_allclose(got, want, rtol=1e-8)
+
+
+def test_afns5_predict_and_forecast(maturities, yields_panel):
+    spec, _ = create_model("AFNS5", tuple(maturities), float_type="float64")
+    p, *_ = _afns5_params(spec)
+    ext = np.concatenate([yields_panel, np.full((len(maturities), 5), np.nan)], axis=1)
+    res = predict(spec, jnp.asarray(p), jnp.asarray(ext))
+    assert res["factors"].shape == (5, ext.shape[1])
+    assert res["states"].shape == (2, ext.shape[1])
+    assert np.all(np.isfinite(np.asarray(res["preds"])))
+
+
+def test_afns3_param_count(maturities):
+    spec, _ = create_model("AFNS3", tuple(maturities), float_type="float64")
+    # γ(1) + σ²(1) + chol(6) + δ(3) + Φ(9) = 20
+    assert spec.n_params == 20 and spec.M == 3

@@ -1,0 +1,116 @@
+"""Estimation-layer tests: optimizers, multi-start, block-coordinate, grids."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from yieldfactormodels_jl_tpu import create_model, get_loss, transform_params
+from yieldfactormodels_jl_tpu.estimation import optimize as opt
+from yieldfactormodels_jl_tpu.estimation.neldermead import nelder_mead
+
+
+def test_neldermead_on_rosenbrock():
+    def rosen(x):
+        return jnp.sum(100.0 * (x[1:] - x[:-1] ** 2) ** 2 + (1 - x[:-1]) ** 2)
+
+    x, f, it = nelder_mead(rosen, jnp.zeros(2), max_iters=2000, f_tol=1e-14)
+    np.testing.assert_allclose(np.asarray(x), [1.0, 1.0], atol=2e-3)
+
+
+def _static_truth(spec):
+    p = np.zeros(spec.n_params)
+    p[0] = np.log(0.5)
+    p[1:4] = [0.3, -0.1, 0.05]
+    Phi = np.diag([0.95, 0.9, 0.85])
+    p[4:13] = Phi.T.reshape(-1)
+    return p
+
+
+def test_estimate_improves_loglik(maturities, yields_panel):
+    spec, _ = create_model("NS", tuple(maturities), float_type="float64")
+    truth = _static_truth(spec)
+    start = truth.copy()
+    start[0] += 0.3  # perturb λ driver
+    start[1:4] += 0.05
+    ll_start = float(get_loss(spec, jnp.asarray(start), jnp.asarray(yields_panel)))
+    init, ll, best, _ = opt.estimate(
+        spec, yields_panel, start[:, None], max_iters=200
+    )
+    assert ll > ll_start
+    ll_check = float(get_loss(spec, jnp.asarray(best), jnp.asarray(yields_panel)))
+    np.testing.assert_allclose(ll_check, ll, rtol=1e-6)
+
+
+def test_multistart_vmapped_picks_best(maturities, yields_panel):
+    spec, _ = create_model("NS", tuple(maturities), float_type="float64")
+    truth = _static_truth(spec)
+    starts = np.stack([truth + 0.0, truth + 0.2, truth - 0.2], axis=1)  # (P, 3)
+    _, ll_multi, best, _ = opt.estimate(spec, yields_panel, starts, max_iters=100)
+    _, ll_single, _, _ = opt.estimate(spec, yields_panel, starts[:, 1:2], max_iters=100)
+    assert ll_multi >= ll_single - 1e-9
+
+
+def test_estimate_steps_block_coordinate(maturities, yields_panel):
+    spec, _ = create_model("SD-NS", tuple(maturities), float_type="float64")
+    vals = [1e-3, 0.97, np.log(0.5), 0.3, -0.1, 0.05]
+    Phi = np.diag([0.95, 0.9, 0.85])
+    p = np.asarray(vals + list(Phi.T.reshape(-1)))
+    groups = ["1"] * 3 + ["2"] * 12
+    table = {  # shrunk iteration budgets to keep the test fast
+        "1": ("neldermead", dict(max_iters=60)),
+        "2": ("lbfgs", dict(max_iters=30, g_tol=1e-6, f_abstol=1e-6)),
+    }
+    ll_start = float(get_loss(spec, jnp.asarray(p), jnp.asarray(yields_panel)))
+    init, ll, best, _ = opt.estimate_steps(
+        spec, yields_panel, p[:, None], groups, max_group_iters=2,
+        optimizers=table,
+    )
+    assert np.isfinite(ll)
+    assert ll >= ll_start - 1e-9
+    assert best.shape == p.shape
+
+
+def test_try_initializations_msed_grid(maturities, yields_panel):
+    spec, _ = create_model("SD-NS", tuple(maturities), float_type="float64")
+    vals = [1e-3, 0.97, np.log(0.5), 0.3, -0.1, 0.05]
+    Phi = np.diag([0.95, 0.9, 0.85])
+    p = np.asarray(vals + list(Phi.T.reshape(-1)))
+    out = opt.try_initializations(spec, p, jnp.asarray(yields_panel))
+    assert out.shape == (15, 1)
+    # the winner must be at least as good as the input
+    ll_in = float(get_loss(spec, jnp.asarray(p), jnp.asarray(yields_panel)))
+    ll_out = float(get_loss(spec, jnp.asarray(out[:, 0]), jnp.asarray(yields_panel)))
+    assert ll_out >= ll_in - 1e-12
+
+
+def test_try_initializations_static_jitter(maturities, yields_panel):
+    spec, _ = create_model("NS", tuple(maturities), float_type="float64")
+    p = _static_truth(spec)
+    out = opt.try_initializations(spec, p, jnp.asarray(yields_panel), max_tries=3)
+    assert out.shape == (13, 4)
+    np.testing.assert_allclose(out[:, 0], p)
+    # jitters only touch the non-(δ,Φ) head
+    np.testing.assert_allclose(out[1:, 1][3:], p[4:])
+
+
+def test_estimate_windows_batched(maturities, yields_panel):
+    spec, _ = create_model("NS", tuple(maturities), float_type="float64")
+    truth = _static_truth(spec)
+    from yieldfactormodels_jl_tpu.models.params import untransform_params
+
+    raw = np.asarray(untransform_params(spec, jnp.asarray(truth)))
+    starts = np.stack([raw, raw + 0.1], axis=0)  # (S=2, P)
+    w_starts = np.array([0, 0, 10])
+    w_ends = np.array([50, 60, 70])
+    xs, lls = opt.estimate_windows(
+        spec, yields_panel, starts, w_starts, w_ends, max_iters=40
+    )
+    assert xs.shape == (3, 2, 13)
+    assert lls.shape == (3, 2)
+    assert np.all(np.isfinite(np.asarray(lls)))
+    # batched window loss equals the truncated-sample loss at the same params
+    from yieldfactormodels_jl_tpu.models import static_model as SM
+
+    p0 = transform_params(spec, jnp.asarray(np.asarray(xs)[2, 0]))
+    l_mask = float(SM.get_loss(spec, p0, jnp.asarray(yields_panel), start=10, end=70))
+    l_trunc = float(SM.get_loss(spec, p0, jnp.asarray(yields_panel[:, 10:70])))
+    np.testing.assert_allclose(l_mask, l_trunc, rtol=1e-9)

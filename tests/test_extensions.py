@@ -1,0 +1,97 @@
+"""Tests for the beyond-reference capabilities: SV particle filter,
+block bootstrap over a λ grid, associative-scan (parallel-in-time) Kalman."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from yieldfactormodels_jl_tpu import create_model, get_loss
+from yieldfactormodels_jl_tpu.estimation.bootstrap import (
+    bootstrap_lambda_grid, moving_block_indices
+)
+from yieldfactormodels_jl_tpu.ops import assoc_scan
+from yieldfactormodels_jl_tpu.ops.particle import particle_filter_loglik
+
+
+def _dns_params():
+    p = np.zeros(20)
+    p[0] = np.log(0.5)
+    p[1] = 4e-4
+    p[2], p[4], p[7] = 0.10, 0.08, 0.12
+    p[3], p[5], p[6] = 0.01, -0.02, 0.005
+    p[8:11] = [0.3, -0.1, 0.05]
+    p[11:20] = np.array([[0.95, 0.02, 0.0], [0.01, 0.9, 0.03], [0.0, 0.02, 0.85]]).reshape(-1)
+    return p
+
+
+def test_particle_filter_collapses_to_kalman(maturities, yields_panel):
+    """With σ_h → 0 and φ_h = 0, every particle is exact ⇒ PF loglik == KF."""
+    spec, _ = create_model("1C", tuple(maturities), float_type="float64")
+    p = jnp.asarray(_dns_params())
+    data = jnp.asarray(yields_panel[:, :40])
+    want = float(get_loss(spec, p, data))
+    got = float(particle_filter_loglik(spec, p, data, jax.random.PRNGKey(0),
+                                       n_particles=8, sv_phi=0.0, sv_sigma=0.0))
+    np.testing.assert_allclose(got, want, rtol=1e-9)
+
+
+def test_particle_filter_sv_estimates_are_stable(maturities, yields_panel):
+    spec, _ = create_model("1C", tuple(maturities), float_type="float64")
+    p = jnp.asarray(_dns_params())
+    data = jnp.asarray(yields_panel[:, :40])
+    lls = [float(particle_filter_loglik(spec, p, data, jax.random.PRNGKey(s),
+                                        n_particles=200, sv_phi=0.9, sv_sigma=0.2))
+           for s in range(3)]
+    assert all(np.isfinite(lls))
+    assert np.std(lls) < 0.05 * abs(np.mean(lls))  # RB keeps MC noise small
+
+
+def test_moving_block_indices_shape_and_range():
+    idx = np.asarray(moving_block_indices(jax.random.PRNGKey(0), 50, 12, 7))
+    assert idx.shape == (7, 50)
+    assert idx.min() >= 0 and idx.max() < 50
+    # blocks are contiguous runs of length 12
+    d = np.diff(idx[0][:12])
+    np.testing.assert_array_equal(d, 1)
+
+
+def test_bootstrap_lambda_grid(maturities, yields_panel):
+    spec, _ = create_model("NS", tuple(maturities), float_type="float64")
+    p = np.zeros(13)
+    p[0] = np.log(0.5)
+    p[1:4] = [0.3, -0.1, 0.05]
+    p[4:13] = np.diag([0.9, 0.85, 0.8]).T.reshape(-1)
+    grid = np.array([0.2, 0.5, 1.0])
+    losses, lo, hi, freq = bootstrap_lambda_grid(
+        spec, p, yields_panel, grid, n_resamples=32, block_len=8)
+    assert losses.shape == (32, 3)
+    assert np.all(np.asarray(lo) <= np.asarray(hi))
+    np.testing.assert_allclose(float(jnp.sum(freq)), 1.0, rtol=1e-6)
+
+
+def test_assoc_scan_matches_sequential_kalman(maturities, yields_panel):
+    spec, _ = create_model("1C", tuple(maturities), float_type="float64")
+    p = jnp.asarray(_dns_params())
+    data = jnp.asarray(yields_panel)
+    want = float(get_loss(spec, p, data))
+    got = float(assoc_scan.get_loss(spec, p, data))
+    np.testing.assert_allclose(got, want, rtol=1e-8)
+
+
+def test_assoc_scan_masked_window(maturities, yields_panel):
+    spec, _ = create_model("1C", tuple(maturities), float_type="float64")
+    p = jnp.asarray(_dns_params())
+    data = jnp.asarray(yields_panel)
+    want = float(get_loss(spec, p, data, start=10, end=60))
+    got = float(assoc_scan.get_loss(spec, p, data, start=10, end=60))
+    np.testing.assert_allclose(got, want, rtol=1e-8)
+
+
+def test_assoc_scan_afns5(maturities, yields_panel):
+    spec, _ = create_model("AFNS5", tuple(maturities), float_type="float64")
+    from tests.test_afns import _afns5_params
+
+    p, *_ = _afns5_params(spec)
+    want = float(get_loss(spec, jnp.asarray(p), jnp.asarray(yields_panel)))
+    got = float(assoc_scan.get_loss(spec, jnp.asarray(p), jnp.asarray(yields_panel)))
+    np.testing.assert_allclose(got, want, rtol=1e-8)

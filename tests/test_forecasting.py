@@ -1,0 +1,173 @@
+"""Forecast driver + persistence integration tests (tmp dirs, small models)."""
+
+import dataclasses
+import os
+import sqlite3
+
+import numpy as np
+import pytest
+
+from yieldfactormodels_jl_tpu import create_model
+from yieldfactormodels_jl_tpu.forecasting import (
+    run_forecast_no_window_database,
+    run_forecast_window_batched,
+    run_forecast_window_database,
+    run_rolling_forecasts,
+)
+from yieldfactormodels_jl_tpu.persistence import database as db
+from yieldfactormodels_jl_tpu.persistence.locks import acquire_task_lock, release_task_lock
+
+MATS = tuple(np.array([3.0, 12.0, 24.0, 60.0, 120.0, 360.0]) / 12.0)
+
+
+def _spec(tmp_path, code="RW"):
+    spec, _ = create_model(code, MATS, float_type="float64",
+                           results_location=str(tmp_path) + os.sep)
+    return spec
+
+
+def _panel(T=40):
+    rng = np.random.default_rng(5)
+    return np.cumsum(rng.standard_normal((len(MATS), T)) * 0.1, axis=1) + 5.0
+
+
+def test_locks_are_atomic(tmp_path):
+    root = str(tmp_path / "locks")
+    l1 = acquire_task_lock(root, "expanding", 7)
+    assert l1 is not None
+    assert acquire_task_lock(root, "expanding", 7) is None
+    release_task_lock(l1)
+    assert acquire_task_lock(root, "expanding", 7) is not None
+
+
+def test_shard_save_merge_export_roundtrip(tmp_path):
+    spec = _spec(tmp_path)
+    base = os.path.join(str(tmp_path), "db", "forecasts_expanding.sqlite3")
+    H = 3
+    results = {
+        "preds": np.arange(len(MATS) * 10, dtype=float).reshape(len(MATS), 10),
+        "factors": np.ones((3, 10)),
+        "states": np.zeros((1, 10)),
+        "factor_loadings_1": np.ones((len(MATS), 10)),
+        "factor_loadings_2": np.ones((len(MATS), 10)),
+    }
+    params = np.array([1.0, 2.0, 3.0])
+    for task in (30, 31, 32):
+        p = db.save_oos_forecast_sharded(base, spec.model_string, "1", "expanding",
+                                         task, results, -0.5, params, forecast_horizon=H)
+        assert os.path.isfile(p)
+    out = db.merge_forecast_shards(base, task_ids=[30, 31, 32], delete_shards=True)
+    assert out.endswith("_merged.sqlite3")
+    conn = sqlite3.connect(out)
+    n = conn.execute("SELECT COUNT(*) FROM forecasts").fetchone()[0]
+    conn.close()
+    assert n == 3
+    # round-trip params through the blob format
+    got = db.read_task_params(out, 31)
+    np.testing.assert_allclose(got, params)
+    csvs = db.export_all_csv(spec, "1", [30, 31, 32], window_type="expanding")
+    fc = np.loadtxt(csvs["forecasts"], delimiter=",")
+    assert fc.shape == (3 * H, 2 + len(MATS))
+    # legacy layout: col0=origin, col1=target=origin+h
+    assert fc[0, 1] == fc[0, 0] + 1
+    fp = np.loadtxt(csvs["fitted_params"], delimiter=",")
+    assert fp.shape == (3, 1 + 3)
+
+
+def test_rolling_window_database_rw_model(tmp_path):
+    """End-to-end rolling backtest with the RW model (no estimation cost)."""
+    spec = _spec(tmp_path)
+    data = _panel(T=36)
+    init = np.zeros((spec.n_params, 1))
+    run_forecast_window_database(
+        spec, data, "1", 30, 1, 4, "expanding", init,
+        param_groups=[], reestimate=False, printing=False)
+    merged = os.path.join(str(tmp_path), "db", "forecasts_expanding_merged.sqlite3")
+    assert os.path.isfile(merged)
+    csv = os.path.join(str(tmp_path),
+                       "RW__thread_id__1__expanding_window_forecasts.csv")
+    arr = np.loadtxt(csv, delimiter=",")
+    # 7 origins (30..36) × horizon 4
+    assert arr.shape == (7 * 4, 2 + len(MATS))
+    # RW forecast = last observed column, rounded to 3 decimals
+    first = arr[arr[:, 0] == 30][0]
+    np.testing.assert_allclose(first[2:], np.round(data[:, 29], 3))
+    # resume is a no-op (idempotent shards/merged short-circuit)
+    run_forecast_window_database(
+        spec, data, "1", 30, 1, 4, "expanding", init,
+        param_groups=[], reestimate=False, printing=False)
+
+
+def test_rolling_window_batched_static_model(tmp_path):
+    """Batched (windows × starts) path writes the same artifact contract."""
+    spec = _spec(tmp_path, code="NS")
+    data = _panel(T=36)
+    p = np.zeros(spec.n_params)
+    p[0] = np.log(0.5)
+    p[1:4] = [0.3, -0.1, 0.05]
+    p[4:13] = np.diag([0.9, 0.85, 0.8]).T.reshape(-1)
+    run_forecast_window_batched(
+        spec, data, "1", 32, 1, 3, "expanding", p[:, None],
+        reestimate=True, printing=False)
+    merged = os.path.join(str(tmp_path), "db", "forecasts_expanding_merged.sqlite3")
+    assert os.path.isfile(merged)
+    conn = sqlite3.connect(merged)
+    rows = conn.execute("SELECT task_id, loss FROM forecasts ORDER BY task_id").fetchall()
+    conn.close()
+    assert [r[0] for r in rows] == [32, 33, 34, 35, 36]
+    assert all(np.isfinite(r[1]) for r in rows)
+
+
+def test_no_window_database(tmp_path):
+    spec = _spec(tmp_path)
+    data = _panel(T=30)
+    init = np.zeros((spec.n_params, 1))
+    run_forecast_no_window_database(
+        spec, data, "1", 25, 1, 3, "no_windowing", init,
+        param_groups=["1"] * spec.n_params, max_group_iters=1, reestimate=False)
+    csv = os.path.join(str(tmp_path),
+                       "RW__thread_id__1__expanding_window_forecasts.csv")
+    arr = np.loadtxt(csv, delimiter=",")
+    assert arr.shape == (6 * 3, 2 + 3 + 1 + len(MATS))
+
+
+def test_moving_window_span(tmp_path):
+    spec = _spec(tmp_path)
+    data = _panel(T=34)
+    init = np.zeros((spec.n_params, 1))
+    run_rolling_forecasts(spec, data, "1", 30, 1, 3, init,
+                          window_type="moving", param_groups=[],
+                          reestimate=False)
+    merged = os.path.join(str(tmp_path), "db", "forecasts_moving_merged.sqlite3")
+    assert os.path.isfile(merged)
+
+
+def test_merged_db_path_resolves_sibling_model(tmp_path):
+    """Warm-start reads must target .../thread_id__X/<static_model>/db/."""
+    from yieldfactormodels_jl_tpu.persistence.database import _merged_db_path
+
+    rl = os.path.join(str(tmp_path), "results", "thread_id__1", "SD-NS") + os.sep
+    got = _merged_db_path(rl, "NS", "expanding")
+    want = os.path.join(str(tmp_path), "results", "thread_id__1", "NS", "db",
+                        "forecasts_expanding_merged.sqlite3")
+    assert got == want
+
+
+def test_read_static_params_from_db_roundtrip(tmp_path):
+    """MSED warm start pulls the static model's fitted tail from its merged DB."""
+    spec, _ = create_model("SD-NS", MATS, float_type="float64",
+                           results_location=os.path.join(
+                               str(tmp_path), "thread_id__1", "SD-NS") + os.sep)
+    ns_db_dir = os.path.join(str(tmp_path), "thread_id__1", "NS", "db")
+    base = os.path.join(ns_db_dir, "forecasts_expanding.sqlite3")
+    static_params = np.arange(13, dtype=float)
+    results = {k: np.ones((2, 4)) for k in
+               ("preds", "factors", "states", "factor_loadings_1", "factor_loadings_2")}
+    db.save_oos_forecast_sharded(base, "NS", "1", "expanding", 30, results,
+                                 -1.0, static_params, forecast_horizon=2)
+    db.merge_forecast_shards(base, task_ids=[30])
+    all_params = np.zeros((15, 1))
+    out = db.read_static_params_from_db(spec, 30, all_params, window_type="expanding")
+    # tail [ω, δ, Φ] overwritten with the static fit (paramteroperations.jl:124-128)
+    np.testing.assert_allclose(out[2:, 0], static_params)
+    np.testing.assert_allclose(out[:2, 0], 0.0)

@@ -1,0 +1,81 @@
+"""Multi-device sharding tests on the virtual 8-device CPU mesh (SURVEY.md §4)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from yieldfactormodels_jl_tpu import create_model, get_loss
+from yieldfactormodels_jl_tpu.parallel import mesh as pmesh
+from yieldfactormodels_jl_tpu.parallel.multihost import host_task_slice, sweep_stale_locks
+
+MATS = tuple(np.array([3.0, 12.0, 24.0, 60.0, 120.0, 360.0]) / 12.0)
+
+
+def _panel(T=40):
+    rng = np.random.default_rng(5)
+    return np.cumsum(rng.standard_normal((len(MATS), T)) * 0.1, axis=1) + 5.0
+
+
+def _static_params(spec, n_batch, jitter=0.0):
+    p = np.zeros(spec.n_params)
+    p[0] = np.log(0.5)
+    p[1:4] = [0.3, -0.1, 0.05]
+    p[4:13] = np.diag([0.9, 0.85, 0.8]).T.reshape(-1)
+    batch = np.tile(p, (n_batch, 1))
+    if jitter:
+        batch += np.random.default_rng(0).uniform(-jitter, jitter, batch.shape)
+    return batch
+
+
+def test_mesh_uses_all_devices():
+    m = pmesh.make_mesh()
+    assert m.devices.size == 8
+
+
+def test_sharded_batch_loss_matches_serial():
+    spec, _ = create_model("NS", MATS, float_type="float64")
+    data = _panel()
+    batch = _static_params(spec, 13, jitter=0.05)  # non-multiple of 8 → padding
+    out = np.asarray(pmesh.batch_loss_sharded(spec, batch, data))
+    assert out.shape == (13,)
+    for i in (0, 5, 12):
+        want = float(get_loss(spec, jnp.asarray(batch[i]), jnp.asarray(data)))
+        np.testing.assert_allclose(out[i], want, rtol=1e-9)
+
+
+def test_sharded_multistart_runs_and_improves():
+    spec, _ = create_model("NS", MATS, float_type="float64")
+    data = _panel()
+    from yieldfactormodels_jl_tpu.models.params import untransform_params
+
+    batch = _static_params(spec, 8, jitter=0.1)
+    raw = np.stack([np.asarray(untransform_params(spec, jnp.asarray(b))) for b in batch])
+    xs, lls = pmesh.multistart_sharded(spec, raw, data, max_iters=30)
+    assert xs.shape == (8, 13) and lls.shape == (8,)
+    base = np.asarray(pmesh.batch_loss_sharded(spec, batch, data))
+    assert np.nanmax(np.asarray(lls)) >= np.nanmax(base) - 1e-9
+
+
+def test_host_task_slice_partition():
+    tasks = list(range(100, 120))
+    parts = [host_task_slice(tasks, process_id=i, num_processes=3) for i in range(3)]
+    merged = sorted(t for p in parts for t in p)
+    assert merged == tasks
+    for i, j in [(0, 1), (0, 2), (1, 2)]:
+        assert not set(parts[i]) & set(parts[j])
+
+
+def test_stale_lock_sweep(tmp_path):
+    root = str(tmp_path / "locks")
+    d = os.path.join(root, "expanding", "task_5.lock")
+    os.makedirs(d)
+    old = 1.0
+    os.utime(d, (old, old))
+    fresh = os.path.join(root, "expanding", "task_6.lock")
+    os.makedirs(fresh)
+    removed = sweep_stale_locks(root, ttl_seconds=3600)
+    assert d in removed
+    assert not os.path.isdir(d)
+    assert os.path.isdir(fresh)

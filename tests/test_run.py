@@ -1,0 +1,80 @@
+"""Top-level run() end-to-end tests (reference call-stack §3.1 parity)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from yieldfactormodels_jl_tpu.run import run
+
+MATS_MONTHS = np.array([3.0, 12.0, 24.0, 60.0, 120.0, 360.0])
+
+
+def _write_data(scratch, thread_id="1", T=40, simulation=False):
+    sub = "data_simulation" if simulation else "data"
+    folder = os.path.join(scratch, "YieldFactorModels.jl", sub)
+    os.makedirs(folder, exist_ok=True)
+    rng = np.random.default_rng(11)
+    data = np.cumsum(rng.standard_normal((len(MATS_MONTHS), T)) * 0.1, axis=1) + 5.0
+    np.savetxt(os.path.join(folder, f"thread_id__{thread_id}__data.csv"),
+               data, delimiter=",")
+    np.savetxt(os.path.join(folder, f"thread_id__{thread_id}__maturities.csv"),
+               MATS_MONTHS / 12.0, delimiter=",")
+    return data
+
+
+def test_run_simulation_mode_rw(tmp_path, monkeypatch):
+    """simulation=True forces no-window forecasting, no optimization, no saving
+    (YieldFactorModels.jl:241-246)."""
+    monkeypatch.chdir(tmp_path)
+    scratch = str(tmp_path) + os.sep
+    _write_data(scratch, simulation=True)
+    out = run("1", 30, 3, True, "RW", "float64",
+              simulation=True, scratch_dir=scratch)
+    assert out is not None
+    csv = os.path.join(scratch, "YieldFactorModels.jl", "results_simulation",
+                       "thread_id__1", "RW",
+                       "RW__thread_id__1__expanding_window_forecasts.csv")
+    assert os.path.isfile(csv)
+    arr = np.loadtxt(csv, delimiter=",")
+    assert arr.shape[1] == 2 + 3 + 1 + len(MATS_MONTHS)
+
+
+def test_run_no_optimization_saves_artifacts(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    scratch = str(tmp_path) + os.sep
+    _write_data(scratch)
+    out = run("1", 30, 3, False, "NS", "float64",
+              run_optimization=False, scratch_dir=scratch)
+    assert out is not None
+    res = os.path.join(scratch, "YieldFactorModels.jl", "results", "thread_id__1", "NS")
+    for suffix in ("factors_filtered_insample", "fit_filtered_insample",
+                   "factor_loadings_1_filtered_insample", "loss", "out_params"):
+        assert os.path.isfile(
+            os.path.join(res, f"NS__thread_id__1__{suffix}.csv")), suffix
+    # random initial parameters were written for reuse (fallback path)
+    assert os.path.isfile(os.path.join(
+        str(tmp_path), "YieldFactorModels.jl", "initializations", "NS",
+        "init_params_NS.csv"))
+
+
+def test_run_placeholder_returns_none(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    scratch = str(tmp_path) + os.sep
+    _write_data(scratch)
+    assert run("1", 30, 3, False, "pC", "float64", scratch_dir=scratch) is None
+
+
+def test_run_rolling_rw_end_to_end(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    scratch = str(tmp_path) + os.sep
+    data = _write_data(scratch, T=36)
+    run("1", 32, 3, True, "RW", "float64",
+        window_type="expanding", run_optimization=False,
+        reestimate=False, scratch_dir=scratch)
+    res = os.path.join(scratch, "YieldFactorModels.jl", "results", "thread_id__1", "RW")
+    merged = os.path.join(res, "db", "forecasts_expanding_merged.sqlite3")
+    assert os.path.isfile(merged)
+    csv = os.path.join(res, "RW__thread_id__1__expanding_window_forecasts.csv")
+    arr = np.loadtxt(csv, delimiter=",")
+    assert arr.shape == (5 * 3, 2 + len(MATS_MONTHS))

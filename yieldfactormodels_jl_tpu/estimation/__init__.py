@@ -1,0 +1,3 @@
+from . import optimize, neldermead
+
+__all__ = ["optimize", "neldermead"]

@@ -1,0 +1,121 @@
+"""Jittable Nelder–Mead simplex optimizer.
+
+The reference uses Optim.jl's ``NelderMead()`` for parameter groups "1"/"4"
+(/root/reference/src/optimization.jl:476-494).  Optim.jl has no JAX
+counterpart, so this is a from-scratch implementation of the same algorithm
+family with Optim.jl's documented conventions (SURVEY.md §7 "optimizer parity
+… documented, tested replacements rather than bit-parity"):
+
+- adaptive parameters α=1, β=1+2/n, γ=0.75−1/(2n), δ=1−1/n,
+- affine initial simplex x_j = x0 + (0.025 + 0.05·x0_j)·e_j,
+- convergence when the simplex f-value standard deviation < ``f_tol``.
+
+Implemented as a ``lax.while_loop`` so the whole optimization jits; the shrink
+branch is a ``lax.cond`` and stays cheap when the function is a scan loss.
+Minimizes ``fun``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class NMState(NamedTuple):
+    simplex: jnp.ndarray  # (n+1, n)
+    fvals: jnp.ndarray    # (n+1,)
+    it: jnp.ndarray       # ()
+    n_fev: jnp.ndarray    # ()
+
+
+def _initial_simplex(x0):
+    n = x0.shape[0]
+    pts = jnp.broadcast_to(x0, (n, n))
+    pts = pts + jnp.diag(0.025 + 0.05 * x0)
+    return jnp.concatenate([x0[None, :], pts], axis=0)
+
+
+def nelder_mead(
+    fun: Callable,
+    x0,
+    max_iters: int = 500,
+    f_tol: float = 1e-8,
+):
+    """Returns (x_best, f_best, n_iters)."""
+    n = x0.shape[0]
+    alpha = 1.0
+    beta = 1.0 + 2.0 / n
+    gamma = 0.75 - 1.0 / (2.0 * n)
+    delta = 1.0 - 1.0 / n
+
+    simplex0 = _initial_simplex(x0)
+    fvals0 = jax.vmap(fun)(simplex0)
+    state0 = NMState(simplex0, fvals0, jnp.zeros((), jnp.int32), jnp.asarray(n + 1, jnp.int32))
+
+    def cond(state):
+        # NaN-safe: a simplex full of NaN stops via the std test being False
+        fstd = jnp.std(jnp.nan_to_num(state.fvals, nan=jnp.inf, posinf=1e30))
+        return (state.it < max_iters) & (fstd > f_tol)
+
+    def body(state):
+        order = jnp.argsort(state.fvals)
+        simplex = state.simplex[order]
+        fvals = state.fvals[order]
+        best, worst = simplex[0], simplex[-1]
+        f_best, f_second, f_worst = fvals[0], fvals[-2], fvals[-1]
+        centroid = jnp.mean(simplex[:-1], axis=0)
+
+        xr = centroid + alpha * (centroid - worst)
+        fr = fun(xr)
+
+        def do_expand(_):
+            xe = centroid + beta * (xr - centroid)
+            fe = fun(xe)
+            x_new, f_new = lax.cond(fe < fr, lambda: (xe, fe), lambda: (xr, fr))
+            return simplex.at[-1].set(x_new), fvals.at[-1].set(f_new), jnp.asarray(1, jnp.int32)
+
+        def do_reflect(_):
+            return simplex.at[-1].set(xr), fvals.at[-1].set(fr), jnp.asarray(0, jnp.int32)
+
+        def do_contract_or_shrink(_):
+            def outside(_):
+                xc = centroid + gamma * (xr - centroid)
+                fc = fun(xc)
+                ok = fc <= fr
+                return xc, fc, ok
+
+            def inside(_):
+                xc = centroid - gamma * (xr - centroid)
+                fc = fun(xc)
+                ok = fc < f_worst
+                return xc, fc, ok
+
+            xc, fc, ok = lax.cond(fr < f_worst, outside, inside, operand=None)
+
+            def accept(_):
+                return simplex.at[-1].set(xc), fvals.at[-1].set(fc), jnp.asarray(1, jnp.int32)
+
+            def shrink(_):
+                new_simplex = best[None, :] + delta * (simplex - best[None, :])
+                new_simplex = new_simplex.at[0].set(best)
+                new_f = jax.vmap(fun)(new_simplex)
+                new_f = new_f.at[0].set(f_best)
+                return new_simplex, new_f, jnp.asarray(n, jnp.int32)
+
+            return lax.cond(ok, accept, shrink, operand=None)
+
+        new_simplex, new_fvals, extra = lax.cond(
+            fr < f_best,
+            do_expand,
+            lambda _: lax.cond(fr < f_second, do_reflect, do_contract_or_shrink, operand=None),
+            operand=None,
+        )
+        return NMState(new_simplex, new_fvals, state.it + 1, state.n_fev + 1 + extra)
+
+    final = lax.while_loop(cond, body, state0)
+    i_best = jnp.argmin(final.fvals)
+    return final.simplex[i_best], final.fvals[i_best], final.it

@@ -1,0 +1,346 @@
+"""Rolling-window forecast/backtest driver.
+
+Parity with /root/reference/src/forecasting.jl:
+
+- ``run_rolling_forecasts`` dispatches on window_type ∈ {both, expanding,
+  moving, no_windowing, simulation} (:16-51),
+- the per-origin loop shuffles tasks so concurrent workers start at different
+  places (:86-88), skips existing shards (:128-131), takes a per-task mkdir
+  lock (:133-136), optionally warm-starts from a simpler model's merged DB
+  (:139), re-estimates (or reuses params when ``reestimate=False``), forecasts
+  by appending ``forecast_horizon−1`` NaN columns (:141,161) and saves a
+  SQLite shard; when all shards exist they merge and export CSVs (:203-221).
+- Reference quirk kept: re-estimation uses the *expanding* sample
+  ``data[:, :task_id]`` even for moving windows (forecasting.jl:165 passes the
+  full data with in_sample_end = task_id); only the forecast pass uses the
+  moving span.
+
+TPU fast path: ``run_forecast_window_batched`` replaces the per-origin process
+farm with ONE jitted (windows × starts) LBFGS batch (leading-NaN masking ==
+truncation, see models/kalman.py), then writes the identical shard artifacts.
+The crash-only shard/lock protocol is retained for multi-host (DCN) farming.
+"""
+
+from __future__ import annotations
+
+import os
+import secrets
+import time
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from .estimation import optimize as opt
+from .models import api
+from .models.params import transform_params, untransform_params
+from .models.specs import ModelSpec
+from .parallel.multihost import sweep_stale_locks
+from .persistence import database as db
+from .persistence.locks import acquire_task_lock, release_task_lock
+
+
+def _forecast_db_base(spec: ModelSpec, window_type: str) -> str:
+    return os.path.join(spec.results_location, "db", f"forecasts_{window_type}.sqlite3")
+
+
+def _merged_path(spec: ModelSpec, window_type: str) -> str:
+    return os.path.join(spec.results_location, "db", f"forecasts_{window_type}_merged.sqlite3")
+
+
+def _lockroot(spec: ModelSpec) -> str:
+    return os.path.join(spec.results_location, "db", "locks")
+
+
+def _estimate_for_window(spec: ModelSpec, data, task_id: int, all_params,
+                         param_groups, max_group_iters, group_tol):
+    """run_estimation! equivalent on the expanding sample data[:, :task_id]."""
+    if param_groups:
+        _, loss, params, _ = opt.estimate_steps(
+            spec, data, all_params, param_groups,
+            max_group_iters=max_group_iters, tol=group_tol,
+            start=0, end=task_id,
+        )
+    else:
+        _, loss, params, _ = opt.estimate(spec, data, all_params, start=0, end=task_id)
+    return loss, params
+
+
+def run_rolling_forecasts(
+    spec: ModelSpec,
+    data,
+    thread_id: str,
+    in_sample_end: int,
+    in_sample_start: int,
+    forecast_horizon: int,
+    init_params,
+    window_type: str = "both",
+    param_groups: Sequence[str] = (),
+    max_group_iters: int = 10,
+    group_tol: float = 1e-8,
+    reestimate: bool = True,
+    batched: bool = False,
+    stale_lock_ttl: float | None = None,
+) -> None:
+    window_fn = run_forecast_window_batched if batched else run_forecast_window_database
+    kw = dict(
+        param_groups=param_groups, max_group_iters=max_group_iters,
+        group_tol=group_tol, reestimate=reestimate, stale_lock_ttl=stale_lock_ttl,
+    )
+    if window_type == "both":
+        window_fn(spec, data, thread_id, in_sample_end, in_sample_start,
+                  forecast_horizon, "expanding", init_params, **kw)
+        window_fn(spec, data, thread_id, in_sample_end, in_sample_start,
+                  forecast_horizon, "moving", init_params, **kw)
+    elif window_type in ("expanding", "moving"):
+        window_fn(spec, data, thread_id, in_sample_end, in_sample_start,
+                  forecast_horizon, window_type, init_params, **kw)
+    elif window_type in ("no_windowing", "simulation"):
+        run_forecast_no_window_database(
+            spec, data, thread_id, in_sample_end, in_sample_start,
+            forecast_horizon, window_type, init_params, **kw)
+    else:
+        raise ValueError("Invalid window type")
+
+
+def _window_forecast_data(spec: ModelSpec, data, task_id: int, window_type: str,
+                          in_sample_end: int, in_sample_start: int,
+                          forecast_horizon: int):
+    N = data.shape[0]
+    pad = np.full((N, forecast_horizon - 1), np.nan)
+    if window_type == "expanding":
+        return np.concatenate([data[:, :task_id], pad], axis=1)
+    if window_type == "moving":
+        span = task_id - (in_sample_end - in_sample_start)  # forecasting.jl:158
+        if span < 1:  # guard the Julia 1-based precondition (in_sample_start >= 1)
+            raise ValueError(
+                f"moving window span={span} < 1; in_sample_start is 1-based "
+                f"(got in_sample_start={in_sample_start}, in_sample_end={in_sample_end})")
+        return np.concatenate([data[:, span - 1:task_id], pad], axis=1)
+    raise ValueError("Invalid window type")
+
+
+def run_forecast_window_database(
+    spec: ModelSpec, data, thread_id: str, in_sample_end: int, in_sample_start: int,
+    forecast_horizon: int, window_type: str, init_params,
+    param_groups=(), max_group_iters: int = 10, group_tol: float = 1e-8,
+    reestimate: bool = True, printing: bool = True,
+    stale_lock_ttl: float | None = None,
+) -> None:
+    data = np.asarray(data, dtype=np.float64)
+    T = data.shape[1]
+    tasks = list(range(in_sample_end, T + 1))
+    rng = np.random.default_rng(secrets.randbits(63))  # RandomDevice shuffle (:88)
+    rng.shuffle(tasks)
+
+    base = _forecast_db_base(spec, window_type)
+    merged = _merged_path(spec, window_type)
+    lockroot = _lockroot(spec)
+    if stale_lock_ttl is not None:  # crash recovery (SURVEY.md §5.3 weakness)
+        sweep_stale_locks(lockroot, ttl_seconds=stale_lock_ttl)
+
+    if os.path.isfile(merged):
+        forecast_csv = db._legacy_path(
+            spec.results_location, spec.model_string, thread_id, window_type, "forecasts")
+        if os.path.isfile(forecast_csv):
+            return
+        lockdir = acquire_task_lock(lockroot, window_type, 0)
+        if lockdir is None:
+            return
+        try:
+            db.export_all_csv(spec, thread_id, tasks, window_type=window_type)
+        finally:
+            release_task_lock(lockdir)
+        return
+
+    all_params = np.asarray(init_params, dtype=np.float64)
+    if all_params.ndim == 1:
+        all_params = all_params[:, None]
+
+    est_total, est_count = 0.0, 0
+    for task_id in tasks:
+        if os.path.isfile(db.forecast_path(base, task_id)):
+            continue
+        lockdir = acquire_task_lock(lockroot, window_type, task_id)
+        if lockdir is None:
+            continue
+        try:
+            cur = db.read_static_params_from_db(spec, task_id, all_params,
+                                                window_type=window_type)
+            if reestimate:
+                t0 = time.perf_counter()
+                loss, params = _estimate_for_window(
+                    spec, data, task_id, cur, param_groups, max_group_iters, group_tol)
+                est_total += time.perf_counter() - t0
+                est_count += 1
+            else:
+                params = db.read_params_from_db(spec, task_id, cur,
+                                                window_type=window_type)[:, 0]
+                loss = np.nan
+            fdata = _window_forecast_data(spec, data, task_id, window_type,
+                                          in_sample_end, in_sample_start,
+                                          forecast_horizon)
+            results = api.predict(spec, jnp.asarray(params, dtype=spec.dtype),
+                                  jnp.asarray(fdata, dtype=spec.dtype))
+            db.save_oos_forecast_sharded(base, spec.model_string, thread_id,
+                                         window_type, task_id, results, loss,
+                                         params, forecast_horizon=forecast_horizon)
+            if printing and est_count:
+                print(f"Thread {thread_id}: {est_count} estimations, "
+                      f"avg {est_total / est_count:.2f}s/task")
+        finally:
+            release_task_lock(lockdir)
+
+    if all(os.path.isfile(db.forecast_path(base, t)) for t in tasks):
+        lockdir = acquire_task_lock(lockroot, window_type, 0)
+        if lockdir is None:
+            return
+        try:
+            db.merge_forecast_shards(base, task_ids=tasks, delete_shards=True)
+            db.export_all_csv(spec, thread_id, tasks, window_type=window_type)
+        finally:
+            release_task_lock(lockdir)
+
+
+def run_forecast_window_batched(
+    spec: ModelSpec, data, thread_id: str, in_sample_end: int, in_sample_start: int,
+    forecast_horizon: int, window_type: str, init_params,
+    param_groups=(), max_group_iters: int = 10, group_tol: float = 1e-8,
+    reestimate: bool = True, printing: bool = True,
+    stale_lock_ttl: float | None = None,
+) -> None:
+    """All missing origins re-estimated in ONE (windows × starts) device batch,
+    then written through the identical shard/merge/export pipeline.
+
+    Uses multi-start LBFGS on the full parameter vector (the batched analogue
+    of estimate!); the sequential block-coordinate path remains available via
+    ``run_forecast_window_database``.
+    """
+    data = np.asarray(data, dtype=np.float64)
+    T = data.shape[1]
+    tasks = list(range(in_sample_end, T + 1))
+    base = _forecast_db_base(spec, window_type)
+    merged = _merged_path(spec, window_type)
+    lockroot = _lockroot(spec)
+    if stale_lock_ttl is not None:
+        sweep_stale_locks(lockroot, ttl_seconds=stale_lock_ttl)
+    if os.path.isfile(merged):
+        return run_forecast_window_database(
+            spec, data, thread_id, in_sample_end, in_sample_start,
+            forecast_horizon, window_type, init_params,
+            param_groups=param_groups, reestimate=reestimate, printing=printing)
+
+    all_params = np.asarray(init_params, dtype=np.float64)
+    if all_params.ndim == 1:
+        all_params = all_params[:, None]
+
+    todo = [t for t in tasks if not os.path.isfile(db.forecast_path(base, t))]
+    locks = {}
+    claimed = []
+    for t in todo:
+        ld = acquire_task_lock(lockroot, window_type, t)
+        if ld is not None:
+            locks[t] = ld
+            claimed.append(t)
+    try:
+        if claimed and reestimate:
+            raw0 = np.stack(
+                [np.asarray(untransform_params(spec, jnp.asarray(c)))
+                 for c in all_params.T], axis=0)  # (S, P)
+            raw0[~np.isfinite(raw0)] = 0.0
+            w_ends = np.asarray(claimed)
+            w_starts = np.zeros_like(w_ends)  # estimation quirk: expanding sample
+            xs, lls = opt.estimate_windows(spec, data, raw0, w_starts, w_ends)
+            xs = np.asarray(xs)    # (W, S, P)
+            lls = np.asarray(lls)  # (W, S)
+            best = np.nanargmax(np.where(np.isfinite(lls), lls, -np.inf), axis=1)
+        for i, task_id in enumerate(claimed):
+            if reestimate:
+                raw_best = xs[i, best[i]]
+                params = np.asarray(
+                    transform_params(spec, jnp.asarray(raw_best, dtype=spec.dtype)))
+                loss = float(lls[i, best[i]])
+            else:
+                cur = db.read_static_params_from_db(spec, task_id, all_params,
+                                                    window_type=window_type)
+                params = db.read_params_from_db(spec, task_id, cur,
+                                                window_type=window_type)[:, 0]
+                loss = np.nan
+            fdata = _window_forecast_data(spec, data, task_id, window_type,
+                                          in_sample_end, in_sample_start,
+                                          forecast_horizon)
+            results = api.predict(spec, jnp.asarray(params, dtype=spec.dtype),
+                                  jnp.asarray(fdata, dtype=spec.dtype))
+            db.save_oos_forecast_sharded(base, spec.model_string, thread_id,
+                                         window_type, task_id, results, loss,
+                                         params, forecast_horizon=forecast_horizon)
+    finally:
+        for ld in locks.values():
+            release_task_lock(ld)
+
+    if all(os.path.isfile(db.forecast_path(base, t)) for t in tasks):
+        lockdir = acquire_task_lock(lockroot, window_type, 0)
+        if lockdir is None:
+            return
+        try:
+            db.merge_forecast_shards(base, task_ids=tasks, delete_shards=True)
+            db.export_all_csv(spec, thread_id, tasks, window_type=window_type)
+        finally:
+            release_task_lock(lockdir)
+
+
+def run_forecast_no_window_database(
+    spec: ModelSpec, data, thread_id: str, in_sample_end: int, in_sample_start: int,
+    forecast_horizon: int, window_type: str, init_params,
+    param_groups=(), max_group_iters: int = 10, group_tol: float = 1e-8,
+    reestimate: bool = True, stale_lock_ttl: float | None = None,
+) -> None:
+    """Estimate once, forecast every origin, single legacy CSV
+    (forecasting.jl:228-283)."""
+    data = np.asarray(data, dtype=np.float64)
+    T = data.shape[1]
+    all_params = np.asarray(init_params, dtype=np.float64)
+    if all_params.ndim == 1:
+        all_params = all_params[:, None]
+    # single estimation on the in-sample span (forecasting.jl:233)
+    loss, params = _estimate_for_window(
+        spec, data, in_sample_end, all_params, param_groups, max_group_iters, group_tol)
+
+    tasks = list(range(in_sample_end, T + 1))
+    M, L, N = spec.M, spec.L, spec.N
+    H = forecast_horizon
+    all_results = np.zeros((2 + M + L + N, H * len(tasks)))
+    for k, task_id in enumerate(tasks):
+        fdata = np.concatenate(
+            [data[:, :task_id], np.full((N, H - 1), np.nan)], axis=1)
+        res = api.predict(spec, jnp.asarray(params, dtype=spec.dtype),
+                          jnp.asarray(fdata, dtype=spec.dtype))
+        cols = slice(k * H, (k + 1) * H)
+        all_results[0, cols] = task_id
+        all_results[1, cols] = np.arange(1, H + 1) + task_id
+        all_results[2:2 + M, cols] = np.asarray(res["factors"])[:, -H:]
+        all_results[2 + M:2 + M + L, cols] = np.asarray(res["states"])[:, -H:]
+        all_results[2 + M + L:, cols] = np.asarray(res["preds"])[:, -H:]
+
+    order = np.lexsort((all_results[1], all_results[0]))
+    all_results = np.round(all_results[:, order], 3)
+
+    res_full = api.predict(spec, jnp.asarray(params, dtype=spec.dtype),
+                           jnp.asarray(data, dtype=spec.dtype))
+    factors_oos = np.round(
+        np.concatenate([np.asarray(res_full["factors"]),
+                        np.asarray(res_full["states"])], axis=0), 3)
+
+    folder = spec.results_location
+    os.makedirs(folder, exist_ok=True)
+    ms = spec.model_string
+    # reference hardcodes "expanding" in this filename (forecasting.jl:267)
+    np.savetxt(os.path.join(
+        folder, f"{ms}__thread_id__{thread_id}__expanding_window_forecasts.csv"),
+        all_results.T, delimiter=",", fmt="%.18g")
+    np.savetxt(os.path.join(
+        folder, f"{ms}__thread_id__{thread_id}__out_params.csv"),
+        np.asarray(params, dtype=np.float64), delimiter=",")
+    np.savetxt(os.path.join(
+        folder, f"{ms}__thread_id__{thread_id}__factors_filtered_outofsample.csv"),
+        factors_oos, delimiter=",", fmt="%.18g")

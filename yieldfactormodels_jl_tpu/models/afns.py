@@ -1,0 +1,70 @@
+"""Arbitrage-free (generalized) Nelson–Siegel models — AFNS3 / AFNS5.
+
+NEW capability relative to the reference (SURVEY.md §7 "stretch": the
+BASELINE.json benchmark configs name a 5-factor AFNS on the Liu–Wu panel;
+the reference itself has no AFNS).  Model of Christensen–Diebold–Rudebusch:
+
+- AFNS3: factors (level, slope, curvature) with one decay λ₁ — DNS loadings
+  plus an arbitrage-free *yield-adjustment* intercept.
+- AFNS5 (AFGNS): (level, slope₁, curv₁, slope₂, curv₂) with decays λ₁, λ₂.
+
+Measurement: y(τ) = Z(τ)·X + α(τ) + ε, where α(τ) = −A(τ)/τ and
+A(τ) = ½∫₀^τ B(s)ᵀ Ω B(s) ds with B(s) the bond-price factor loadings.
+Substituting s = uτ gives α(τ) = −½∫₀¹ B(uτ)ᵀ Ω B(uτ) du, evaluated here by
+a fixed-grid trapezoid — one (N, Q, M) tensor contraction, jit/vmap-friendly
+and exact to quadrature error instead of transcribing the long closed form.
+
+Parameter layout (flat, following the kalman convention of specs.py):
+[γ (n_lambda drivers, λᵢ = 1e-2 + exp γᵢ) | σ²_obs | chol(Ω_state) | δ | Φ_rowmajor].
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .loadings import LAMBDA_FLOOR
+
+
+def afns_lambdas(gamma):
+    """λᵢ = 1e-2 + exp(γᵢ), same convention as dns.jl:55."""
+    return LAMBDA_FLOOR + jnp.exp(gamma)
+
+
+def afns_loadings(gamma, maturities, M: int):
+    """(N, M) yield loading matrix; M ∈ {3, 5}."""
+    lams = afns_lambdas(gamma)
+    cols = [jnp.ones_like(maturities)]
+    n_lam = (M - 1) // 2
+    for i in range(n_lam):
+        tau = lams[i] * maturities
+        z = jnp.exp(-tau)
+        slope = (1.0 - z) / tau
+        cols.append(slope)
+        cols.append(slope - z)
+    return jnp.stack(cols, axis=-1)
+
+
+def _price_loadings(s, lams, M: int):
+    """B(s): bond-price factor loadings at time-to-maturity s (…, broadcast)."""
+    cols = [-s]
+    n_lam = (M - 1) // 2
+    for i in range(n_lam):
+        lam = lams[i]
+        e = jnp.exp(-lam * s)
+        b_slope = -(1.0 - e) / lam
+        b_curv = s * e + b_slope
+        cols.append(b_slope)
+        cols.append(b_curv)
+    return jnp.stack(cols, axis=-1)
+
+
+def yield_adjustment(gamma, Omega_state, maturities, M: int, quad_points: int = 64):
+    """α(τ) = −½ ∫₀¹ B(uτ)ᵀ Ω B(uτ) du per maturity, trapezoid in u."""
+    lams = afns_lambdas(gamma)
+    u = jnp.linspace(0.0, 1.0, quad_points + 1)
+    s = maturities[:, None] * u[None, :]           # (N, Q+1)
+    B = _price_loadings(s, lams, M)                # (N, Q+1, M)
+    f = jnp.einsum("nqi,ij,nqj->nq", B, Omega_state, B)
+    w = jnp.ones_like(u).at[0].set(0.5).at[-1].set(0.5) / quad_points
+    integral = f @ w                               # (N,)
+    return -0.5 * integral

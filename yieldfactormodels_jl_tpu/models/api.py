@@ -55,6 +55,10 @@ def update_factor_loadings(spec: ModelSpec, gamma):
         if spec.family == "kalman_tvl":
             # TVλ builds Z from the 4th state at filter time
             raise ValueError("kalman_tvl loadings are state-dependent; see kalman._tvl_measurement")
+        if spec.family == "kalman_afns":
+            from .afns import afns_loadings
+
+            return afns_loadings(gamma, spec.maturities_array, spec.M)
         return dns_loadings(gamma, spec.maturities_array)
     if spec.is_msed:
         return score_driven.loadings_fn(spec, gamma)

@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .afns import afns_loadings, yield_adjustment
 from .loadings import LAMBDA_FLOOR, dns_lambda, dns_loadings, dns_slope_curvature
 from .params import KalmanParams, unpack_kalman
 from .specs import ModelSpec
@@ -80,7 +81,7 @@ def _tvl_measurement(spec: ModelSpec, beta, maturities):
     return Z, y_pred
 
 
-def _step(spec: ModelSpec, kp: KalmanParams, Z_const, state: KalmanState, y, observed):
+def _step(spec: ModelSpec, kp: KalmanParams, Z_const, d_const, state: KalmanState, y, observed):
     """One branchless KF/EKF step.  Returns (next_state, per-step outputs)."""
     beta, P = state
     Ms = spec.state_dim
@@ -93,6 +94,8 @@ def _step(spec: ModelSpec, kp: KalmanParams, Z_const, state: KalmanState, y, obs
     else:
         Z = Z_const
         y_pred = Z @ beta
+        if d_const is not None:  # AFNS yield-adjustment intercept
+            y_pred = y_pred + d_const
 
     obs = observed & jnp.all(jnp.isfinite(y))
     obs_f = obs.astype(dtype)
@@ -136,8 +139,13 @@ def _scan_filter(spec: ModelSpec, params, data, start, end, state0: KalmanState 
     may be traced scalars; columns outside [start, end) are treated as missing."""
     kp = unpack_kalman(spec, params)
     Z_const = None
+    d_const = None
     if spec.family == "kalman_dns":
         Z_const = dns_loadings(kp.gamma, spec.maturities_array).astype(params.dtype)
+    elif spec.family == "kalman_afns":
+        mats = spec.maturities_array
+        Z_const = afns_loadings(kp.gamma, mats, spec.M).astype(params.dtype)
+        d_const = yield_adjustment(kp.gamma, kp.Omega_state, mats, spec.M)
     if state0 is None:
         state0 = init_state(spec, kp)
     T = data.shape[1]
@@ -146,7 +154,7 @@ def _scan_filter(spec: ModelSpec, params, data, start, end, state0: KalmanState 
 
     def body(state, inp):
         y, obs_t = inp
-        return _step(spec, kp, Z_const, state, y, obs_t)
+        return _step(spec, kp, Z_const, d_const, state, y, obs_t)
 
     state, outs = lax.scan(body, state0, (data.T, observed))
     return kp, Z_const, state, outs
@@ -211,8 +219,8 @@ def predict(spec: ModelSpec, params, data):
     factors = outs["beta_after"][1:].T
     fl1 = outs["Z2"][1:].T
     fl2 = outs["Z3"][1:].T
-    if spec.family == "kalman_dns":
-        states = jnp.broadcast_to(kp.gamma, (T, spec.L)).T
+    if spec.family in ("kalman_dns", "kalman_afns"):
+        states = jnp.broadcast_to(kp.gamma, (T, kp.gamma.shape[-1])).T
     else:
         # TVλ never writes its γ buffer (set_params! at kalman/paramoperations.jl:61-68)
         states = jnp.zeros((spec.L, T), dtype=params.dtype)

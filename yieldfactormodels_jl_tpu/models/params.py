@@ -109,7 +109,8 @@ def unpack_kalman(spec: ModelSpec, params) -> KalmanParams:
     """kalman/paramoperations.jl:6-58: Ω_obs = σ²I; Ω_state = CᵀC with C the
     upper-triangular factor filled column-by-column; Φ filled row-major."""
     Ms = spec.state_dim
-    gamma = spec.slice(params, "gamma") if spec.family == "kalman_dns" else None
+    gamma = (spec.slice(params, "gamma")
+             if spec.family in ("kalman_dns", "kalman_afns") else None)
     obs_var = spec.slice(params, "obs_var")[..., 0]
     chol_flat = spec.slice(params, "chol")
     rows, cols = spec.chol_indices

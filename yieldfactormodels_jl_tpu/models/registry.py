@@ -75,6 +75,11 @@ def _build_table():
     t["vanillaNN"] = ("vanillaNN", None)
     t["a"] = ("vanillaNN", None)
     add("RW", "-1", family="random_walk", L=1)
+
+    # Extensions beyond the reference (BASELINE.md benchmark configs):
+    # arbitrage-free NS with yield-adjustment term; AFNS5 = AFGNS (two decays).
+    add("AFNS3", "af3", family="kalman_afns", L=1, M_override=3)
+    add("AFNS5", "af5", family="kalman_afns", L=2, M_override=5)
     return t
 
 
@@ -99,9 +104,23 @@ def create_model(
     mats = tuple(float(m) for m in maturities)
     if N is not None and N != len(mats):
         raise ValueError(f"N={N} does not match len(maturities)={len(mats)}")
+    kw = dict(kw)
+    M = kw.pop("M_override", M)
     import numpy as _np
 
     dtype_name = _np.dtype(float_type).name
+    if dtype_name == "float64":
+        import jax as _jax
+
+        if not _jax.config.jax_enable_x64:
+            import warnings
+
+            warnings.warn(
+                "float_type=float64 requested but jax_enable_x64 is off — "
+                "arrays will silently truncate to float32. Set JAX_ENABLE_X64=1 "
+                "or jax.config.update('jax_enable_x64', True) first.",
+                stacklevel=2,
+            )
     spec = ModelSpec(
         model_code=canon,
         maturities=mats,

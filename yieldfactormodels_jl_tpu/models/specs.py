@@ -35,7 +35,7 @@ import numpy as np
 
 from ..utils import transformations as tr
 
-KALMAN_FAMILIES = ("kalman_dns", "kalman_tvl")
+KALMAN_FAMILIES = ("kalman_dns", "kalman_tvl", "kalman_afns")
 MSED_FAMILIES = ("msed_lambda", "msed_neural")
 STATIC_FAMILIES = ("static_lambda", "static_neural", "random_walk")
 ALL_FAMILIES = KALMAN_FAMILIES + MSED_FAMILIES + STATIC_FAMILIES
@@ -99,6 +99,11 @@ class ModelSpec:
         return self.M + 1 if self.family == "kalman_tvl" else self.M
 
     @property
+    def n_lambdas(self) -> int:
+        """Number of λ decay drivers (AFNS5/AFGNS has two)."""
+        return (self.M - 1) // 2 if self.family == "kalman_afns" else 1
+
+    @property
     def n_unique(self) -> int:
         return (max(self.duplicator) + 1) if self.duplicator else self.L
 
@@ -136,6 +141,8 @@ class ModelSpec:
             Ms = self.state_dim
             if self.family == "kalman_dns":
                 put("gamma", 1)
+            elif self.family == "kalman_afns":
+                put("gamma", self.n_lambdas)
             put("obs_var", 1)
             put("chol", Ms * (Ms + 1) // 2)
             put("delta", Ms)
@@ -178,6 +185,8 @@ class ModelSpec:
             Ms = self.state_dim
             if self.family == "kalman_dns":
                 codes.append(tr.IDENTITY)  # λ driver γ
+            elif self.family == "kalman_afns":
+                codes.extend([tr.IDENTITY] * self.n_lambdas)
             codes.append(tr.R_TO_POS)  # observation variance
             for j in range(Ms):  # chol, column-by-column; diag positive
                 for i in range(j + 1):

@@ -1,0 +1,169 @@
+"""Associative-scan (parallel-in-time) Kalman filter.
+
+The reference's filters are strictly sequential ``for t`` loops
+(SURVEY.md §5.7); on TPU the time recursion can instead run in O(log T) span
+with `jax.lax.associative_scan` using the parallel Kalman formulation of
+Särkkä & García-Fernández (temporal parallelization of Bayesian smoothers; cf.
+PAPERS.md "Parallel square-root statistical linear regression").  This is the
+framework's sequence-parallelism story: long panels (daily data, simulation
+studies) stop being latency-bound on sequential steps, and the scan can be
+sharded over the time axis of a mesh.
+
+Each step is the 5-tuple element (A, b, C, J, η); composition is closed under
+the filtering semigroup.  Missing observations (NaN columns) become pure
+prediction elements, so multi-step forecasting composes the same way.
+Applies to the time-invariant-measurement families (DNS, AFNS).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..models import kalman as K
+from ..models.afns import afns_loadings, yield_adjustment
+from ..models.loadings import dns_loadings
+from ..models.params import unpack_kalman
+from ..models.specs import ModelSpec
+
+_LOG_2PI = math.log(2.0 * math.pi)
+
+
+class FilterElement(NamedTuple):
+    A: jnp.ndarray
+    b: jnp.ndarray
+    C: jnp.ndarray
+    J: jnp.ndarray
+    eta: jnp.ndarray
+
+
+def _mv(M, v):
+    return jnp.einsum("...ij,...j->...i", M, v)
+
+
+def _combine(ei: FilterElement, ej: FilterElement) -> FilterElement:
+    """Associative composition (element i happens before j)."""
+    I = jnp.eye(ei.A.shape[-1], dtype=ei.A.dtype)
+    D = I + ei.C @ ej.J
+    Dinv_Ai = jnp.linalg.solve(D, ei.A)
+    Dinv_bCe = jnp.linalg.solve(D, (ei.b + _mv(ei.C, ej.eta))[..., None])[..., 0]
+    A = ej.A @ Dinv_Ai
+    b = _mv(ej.A, Dinv_bCe) + ej.b
+    C = ej.A @ jnp.linalg.solve(D, ei.C) @ ej.A.swapaxes(-1, -2) + ej.C
+    E = I + ej.J @ ei.C
+    Einv_Jj = jnp.linalg.solve(E, ej.J)
+    Ait = ei.A.swapaxes(-1, -2)
+    eta = _mv(Ait, jnp.linalg.solve(
+        E, (ej.eta - _mv(ej.J, ei.b))[..., None])[..., 0]) + ei.eta
+    J = Ait @ Einv_Jj @ ei.A + ei.J
+    return FilterElement(A, b, C, J, eta)
+
+
+def _elements(Z, d, Phi, delta, Q, R_diag, m0, P0, data, observed):
+    """Build the per-step elements for all T steps at once (batched)."""
+    N, Ms = Z.shape
+    T = data.shape[1]
+    I = jnp.eye(Ms, dtype=Z.dtype)
+    y = jnp.where(jnp.isfinite(data.T), data.T, 0.0)  # (T, N)
+    obs = observed & jnp.all(jnp.isfinite(data.T), axis=1)
+    obs_f = obs.astype(Z.dtype)[:, None]
+
+    R = jnp.diag(R_diag)
+    # generic element (k >= 2): uses only local quantities
+    S = Z @ Q @ Z.T + R
+    S_cho = jnp.linalg.cholesky(S)
+    Kg = jax.scipy.linalg.cho_solve((S_cho, True), Z @ Q.T).T  # Q Zᵀ S⁻¹
+    A_g = (I - Kg @ Z) @ Phi
+    C_g = (I - Kg @ Z) @ Q
+    ZtSi = jax.scipy.linalg.cho_solve((S_cho, True), Z).T  # Zᵀ S⁻¹
+    J_g = Phi.T @ ZtSi @ Z @ Phi
+
+    resid = y - (Z @ delta + d)[None, :]  # y_k − Z c − d  (T, N)
+    b_g = delta[None, :] + resid @ Kg.T
+    eta_g = resid @ (Phi.T @ ZtSi).T
+
+    # first element: exact update from the prior (m0, P0)
+    mpred1 = Phi @ m0 + delta
+    Ppred1 = Phi @ P0 @ Phi.T + Q
+    S1 = Z @ Ppred1 @ Z.T + R
+    S1_cho = jnp.linalg.cholesky(S1)
+    K1 = jax.scipy.linalg.cho_solve((S1_cho, True), Z @ Ppred1.T).T
+    b_1 = mpred1 + K1 @ (y[0] - Z @ mpred1 - d)
+    C_1 = (I - K1 @ Z) @ Ppred1
+
+    # assemble (T, ...) with missing steps as pure prediction elements
+    A = jnp.where(obs_f[:, :, None], A_g[None], Phi[None])
+    b = jnp.where(obs_f, b_g, delta[None, :])
+    C = jnp.where(obs_f[:, :, None], C_g[None], Q[None])
+    J = jnp.where(obs_f[:, :, None], J_g[None], jnp.zeros_like(J_g)[None])
+    eta = jnp.where(obs_f, eta_g, jnp.zeros_like(eta_g))
+
+    # overwrite k = 1 (prior-conditioned); A₁ = 0, J₁ = η₁ = 0
+    A = A.at[0].set(jnp.where(obs[0], jnp.zeros_like(Phi), Phi))
+    b = b.at[0].set(jnp.where(obs[0], b_1, mpred1))
+    C = C.at[0].set(jnp.where(obs[0], C_1, Ppred1))
+    J = J.at[0].set(jnp.zeros_like(J_g))
+    eta = eta.at[0].set(jnp.zeros_like(eta_g[0]))
+    return FilterElement(A, b, C, J, eta), obs
+
+
+def filter_means_covs(spec: ModelSpec, params, data, start=0, end=None):
+    """Filtered means/covariances for every t via `lax.associative_scan`.
+
+    Returns (m (T, Ms) = E[x_t | y_{1:t}], P (T, Ms, Ms)).
+    """
+    kp = unpack_kalman(spec, params)
+    mats = spec.maturities_array
+    if spec.family == "kalman_afns":
+        Z = afns_loadings(kp.gamma, mats, spec.M)
+        d = yield_adjustment(kp.gamma, kp.Omega_state, mats, spec.M)
+    elif spec.family == "kalman_dns":
+        Z = dns_loadings(kp.gamma, mats)
+        d = jnp.zeros((spec.N,), dtype=Z.dtype)
+    else:
+        raise ValueError("associative-scan filter requires a constant measurement matrix")
+    state0 = K.init_state(spec, kp)
+    T = data.shape[1]
+    if end is None:
+        end = T
+    t_idx = jnp.arange(T)
+    observed = (t_idx >= start) & (t_idx < end)
+    R_diag = kp.obs_var * jnp.ones((spec.N,), dtype=Z.dtype)
+    elems, obs = _elements(Z, d, kp.Phi, kp.delta, kp.Omega_state, R_diag,
+                           state0.beta, state0.P, data, observed)
+    out = lax.associative_scan(_combine, elems)
+    return out.b, out.C, (Z, d, kp, state0, obs)
+
+
+def get_loss(spec: ModelSpec, params, data, start=0, end=None):
+    """Gaussian loglik computed from the parallel filter — numerically matches
+    the sequential kalman.get_loss (same skip-first convention)."""
+    m, P, (Z, d, kp, state0, obs) = filter_means_covs(spec, params, data, start, end)
+    T = data.shape[1]
+    if end is None:
+        end = T
+    N = spec.N
+    R = kp.obs_var * jnp.eye(N, dtype=Z.dtype)
+    # predicted moments at t from filtered at t−1
+    m_prev = jnp.concatenate([state0.beta[None], m[:-1]], axis=0)
+    P_prev = jnp.concatenate([state0.P[None], P[:-1]], axis=0)
+    mpred = m_prev @ kp.Phi.T + kp.delta[None]
+    Ppred = jnp.einsum("ij,tjk,lk->til", kp.Phi, P_prev, kp.Phi) + kp.Omega_state[None]
+    ysafe = jnp.where(jnp.isfinite(data.T), data.T, 0.0)
+    v = ysafe - (mpred @ Z.T + d[None])
+    F = jnp.einsum("ij,tjk,lk->til", Z, Ppred, Z) + R[None]
+    cho = jnp.linalg.cholesky(F)
+    ok = jnp.all(jnp.isfinite(cho), axis=(1, 2))
+    cho_safe = jnp.where(ok[:, None, None], jnp.nan_to_num(cho),
+                         jnp.eye(N, dtype=Z.dtype)[None])
+    Fi_v = jax.scipy.linalg.cho_solve((cho_safe, True), v[..., None])[..., 0]
+    logdet = 2.0 * jnp.sum(jnp.log(jnp.diagonal(cho_safe, axis1=1, axis2=2)), axis=1)
+    ll_t = -0.5 * (logdet + jnp.sum(v * Fi_v, axis=1) + N * _LOG_2PI)
+    t_idx = jnp.arange(T)
+    contrib = (t_idx >= start + 1) & (t_idx <= end - 2) & obs
+    total = jnp.sum(jnp.where(contrib, jnp.where(ok, ll_t, -jnp.inf), 0.0))
+    return jnp.where(jnp.isfinite(total), total, -jnp.inf)

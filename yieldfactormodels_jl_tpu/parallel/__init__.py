@@ -1,0 +1,3 @@
+from . import mesh, multihost
+
+__all__ = ["mesh", "multihost"]

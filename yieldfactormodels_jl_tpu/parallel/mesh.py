@@ -1,0 +1,122 @@
+"""Device-mesh batching: the TPU replacement for the reference's process farm.
+
+The reference parallelizes only at the *experiment* level — independent OS
+processes contending on filesystem locks (SURVEY.md §2.14, forecasting.jl:
+86-136).  Here every independent unit of work (parameter draw, multi-start
+column, rolling-window origin, bootstrap resample) is a batch axis:
+
+- within one chip, `vmap` fuses the batch into large dense ops for the MXU;
+- across chips, inputs carry a `NamedSharding` over a `Mesh` and XLA
+  partitions the same jitted program, inserting ICI collectives only for the
+  final argmax/reduction (which is bytes, not bandwidth).
+
+The work is embarrassingly parallel, so the right "distributed backend" is
+SPMD sharding of the batch axis, not point-to-point messaging.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..estimation import optimize as opt
+from ..models import api
+from ..models.specs import ModelSpec
+
+
+def make_mesh(n_devices: Optional[int] = None, axis_name: str = "batch") -> Mesh:
+    """1-D mesh over the first ``n_devices`` local devices."""
+    devs = jax.devices()
+    if n_devices is None:
+        n_devices = len(devs)
+    return Mesh(np.asarray(devs[:n_devices]), (axis_name,))
+
+
+def pad_to_multiple(arr, multiple: int, axis: int = 0):
+    """Pad a batch axis up to a device-count multiple (returns arr, true_n)."""
+    n = arr.shape[axis]
+    rem = (-n) % multiple
+    if rem == 0:
+        return arr, n
+    pad_widths = [(0, 0)] * arr.ndim
+    pad_widths[axis] = (0, rem)
+    return np.pad(np.asarray(arr), pad_widths, mode="edge"), n
+
+
+@lru_cache(maxsize=64)
+def _sharded_batch_loss(spec: ModelSpec, T: int, mesh: Mesh, axis_name: str):
+    batch_sharding = NamedSharding(mesh, P(axis_name, None))
+    repl = NamedSharding(mesh, P())
+
+    fn = jax.vmap(lambda p, data, start, end: api.get_loss(spec, p, data, start, end),
+                  in_axes=(0, None, None, None))
+    return jax.jit(fn, in_shardings=(batch_sharding, repl, repl, repl),
+                   out_shardings=NamedSharding(mesh, P(axis_name)))
+
+
+def batch_loss_sharded(spec: ModelSpec, params_batch, data, mesh: Optional[Mesh] = None,
+                       start=0, end=None, axis_name: str = "batch"):
+    """Loglik of a (B, P) parameter batch, sharded over the mesh.
+
+    This is the BASELINE.json hot path: thousands of likelihood evaluations
+    (draws/resamples) as one SPMD program over the chips.
+    """
+    if mesh is None:
+        mesh = make_mesh(axis_name=axis_name)
+    data = jnp.asarray(data, dtype=spec.dtype)
+    if end is None:
+        end = data.shape[1]
+    n_dev = mesh.devices.size
+    padded, n = pad_to_multiple(np.asarray(params_batch), n_dev, axis=0)
+    fn = _sharded_batch_loss(spec, data.shape[1], mesh, axis_name)
+    out = fn(jnp.asarray(padded, dtype=spec.dtype), data,
+             jnp.asarray(start), jnp.asarray(end))
+    return out[:n]
+
+
+@lru_cache(maxsize=64)
+def _sharded_multistart(spec: ModelSpec, T: int, mesh: Mesh, axis_name: str,
+                        max_iters: int, g_tol: float, f_abstol: float):
+    batch_sharding = NamedSharding(mesh, P(axis_name, None))
+    repl = NamedSharding(mesh, P())
+
+    def single(x0, data, start, end):
+        fun = lambda p: opt._finite_objective(spec, data, p, start, end)
+        return opt._run_lbfgs(fun, x0, max_iters, g_tol, f_abstol)
+
+    fn = jax.vmap(single, in_axes=(0, None, None, None))
+    return jax.jit(
+        fn,
+        in_shardings=(batch_sharding, repl, repl, repl),
+        out_shardings=(NamedSharding(mesh, P(axis_name, None)),
+                       NamedSharding(mesh, P(axis_name)),
+                       NamedSharding(mesh, P(axis_name))),
+    )
+
+
+def multistart_sharded(spec: ModelSpec, raw_starts, data, mesh: Optional[Mesh] = None,
+                       start=0, end=None, max_iters: int = 1000,
+                       g_tol: float = 1e-6, f_abstol: float = 1e-6,
+                       axis_name: str = "batch"):
+    """Multi-start LBFGS with the start axis sharded across chips.
+
+    Returns (raw_params (S, P), lls (S,)).  64 starts on a v4-8 run 8-per-chip
+    with zero communication until the final best-of reduction.
+    """
+    if mesh is None:
+        mesh = make_mesh(axis_name=axis_name)
+    data = jnp.asarray(data, dtype=spec.dtype)
+    if end is None:
+        end = data.shape[1]
+    n_dev = mesh.devices.size
+    padded, n = pad_to_multiple(np.asarray(raw_starts), n_dev, axis=0)
+    fn = _sharded_multistart(spec, data.shape[1], mesh, axis_name,
+                             max_iters, g_tol, f_abstol)
+    xs, fs, its = fn(jnp.asarray(padded, dtype=spec.dtype), data,
+                     jnp.asarray(start), jnp.asarray(end))
+    return xs[:n], -fs[:n]

@@ -1,0 +1,3 @@
+from . import database, io, locks
+
+__all__ = ["database", "io", "locks"]

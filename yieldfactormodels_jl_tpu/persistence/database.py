@@ -1,0 +1,301 @@
+"""SQLite shard persistence for out-of-sample forecasts.
+
+Same artifact contract as /root/reference/src/databaseoperations/
+databaseoperations.jl: one SQLite file per (window, task) shard with a
+``forecasts`` table keyed (model, thread, window, task_id) holding loss,
+params and the five result blobs; WAL mode, busy_timeout, IMMEDIATE
+transactions; shards merge into ``forecasts_<window>_merged.sqlite3``
+(:195-364).  Values are rounded to 3 decimals before saving (:251-255).
+
+One deliberate change: blobs are ``numpy .npy`` bytes instead of Julia
+``Serialization`` bytes — a portable, documented format with identical
+array content (the reference's blobs are Julia-version-locked).
+"""
+
+from __future__ import annotations
+
+import io as _io
+import os
+import sqlite3
+import threading
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+_DB_INIT_LOCK = threading.Lock()
+_DB_INIT_LOCKS: Dict[str, threading.Lock] = {}
+
+SCHEMA = """
+    CREATE TABLE IF NOT EXISTS forecasts(
+        model  TEXT NOT NULL,
+        thread TEXT NOT NULL,
+        window TEXT NOT NULL,
+        task_id INTEGER NOT NULL,
+        loss   REAL,
+        params BLOB NOT NULL,
+        preds  BLOB NOT NULL,
+        fl1    BLOB NOT NULL,
+        fl2    BLOB NOT NULL,
+        factors BLOB NOT NULL,
+        states  BLOB NOT NULL,
+        PRIMARY KEY(model,thread,window,task_id)
+    );
+"""
+
+
+def ser(arr) -> bytes:
+    buf = _io.BytesIO()
+    np.save(buf, np.asarray(arr, dtype=np.float64))
+    return buf.getvalue()
+
+
+def deser(blob: bytes) -> np.ndarray:
+    return np.load(_io.BytesIO(blob))
+
+
+def forecast_path(base: str, k: int) -> str:
+    """databaseoperations.jl:245: shard path for task k (k=0 → base)."""
+    return base if k == 0 else base.replace(".sqlite3", f"_{k}.sqlite3")
+
+
+def init_forecast_db(path: str) -> sqlite3.Connection:
+    """WAL + busy_timeout + schema, one initializer per path at a time
+    (databaseoperations.jl:195-243)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with _DB_INIT_LOCK:
+        lock = _DB_INIT_LOCKS.setdefault(path, threading.Lock())
+    with lock:
+        db = sqlite3.connect(path, timeout=10.0)
+        db.execute("PRAGMA busy_timeout=10000;")
+        db.execute("PRAGMA temp_store=MEMORY;")
+        mode = db.execute("PRAGMA journal_mode=WAL;").fetchone()[0]
+        if str(mode).lower() != "wal":
+            db.execute("PRAGMA journal_mode=DELETE;")
+        db.execute("PRAGMA synchronous=NORMAL;")
+        db.execute(SCHEMA)
+        db.commit()
+        return db
+
+
+def save_oos_forecast_sharded(
+    base: str,
+    model_string: str,
+    thread: str,
+    window: str,
+    task_id: int,
+    results: dict,
+    loss: float,
+    params,
+    forecast_horizon: int,
+) -> str:
+    """Round, slice the last ``forecast_horizon`` columns, INSERT OR REPLACE in
+    an IMMEDIATE transaction (databaseoperations.jl:247-293)."""
+    h = forecast_horizon
+    rounded = {k: np.round(np.asarray(v, dtype=np.float64), 3) for k, v in results.items()}
+    p = rounded["preds"][:, -h:]
+    f = rounded["factors"][:, -h:]
+    s = rounded["states"][:, -h:]
+    fl1 = rounded["factor_loadings_1"][:, -h:]
+    fl2 = rounded["factor_loadings_2"][:, -h:]
+
+    path = forecast_path(base, task_id)
+    db = init_forecast_db(path)
+    try:
+        db.execute("BEGIN IMMEDIATE;")
+        db.execute(
+            "INSERT OR REPLACE INTO forecasts("
+            "model,thread,window,task_id,loss,params,preds,fl1,fl2,factors,states"
+            ") VALUES(?,?,?,?,?,?,?,?,?,?,?)",
+            (
+                model_string, thread, window, int(task_id),
+                float(loss) if np.isfinite(loss) else None,
+                ser(params), ser(p), ser(fl1), ser(fl2), ser(f), ser(s),
+            ),
+        )
+        db.commit()
+        return path
+    except Exception:
+        db.rollback()
+        raise
+    finally:
+        db.close()
+
+
+def merge_forecast_shards(
+    base: str,
+    task_ids: Sequence[int],
+    out: Optional[str] = None,
+    delete_shards: bool = False,
+) -> str:
+    """Fold shards into the first, rename to _merged
+    (databaseoperations.jl:295-364)."""
+    if out is None:
+        out = base.replace(".sqlite3", "_merged.sqlite3")
+    task_ids = list(task_ids)
+    src_path = forecast_path(base, task_ids[0])
+    for task_id in task_ids[1:]:
+        shard = forecast_path(base, task_id)
+        if not os.path.isfile(shard):
+            continue
+        src = sqlite3.connect(src_path, timeout=10.0)
+        new = sqlite3.connect(shard, timeout=10.0)
+        rows = new.execute(
+            "SELECT model,thread,window,task_id,loss,params,preds,fl1,fl2,factors,states "
+            "FROM forecasts WHERE task_id = ?", (int(task_id),)
+        ).fetchall()
+        for row in rows:
+            src.execute(
+                "INSERT OR REPLACE INTO forecasts("
+                "model,thread,window,task_id,loss,params,preds,fl1,fl2,factors,states"
+                ") VALUES(?,?,?,?,?,?,?,?,?,?,?)", row
+            )
+        src.commit()
+        new.close()
+        src.close()
+    os.replace(src_path, out)
+    if delete_shards:
+        for task_id in task_ids:
+            shard = forecast_path(base, task_id)
+            if os.path.isfile(shard):
+                os.remove(shard)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# warm-start / parameter-reuse reads (databaseoperations.jl:5-72)
+# ---------------------------------------------------------------------------
+
+def _merged_db_path(results_folder: str, model_name: str, window_type: str) -> str:
+    # results_folder is ".../results/thread_id__X/<model>/"; the sibling model's
+    # DB lives at ".../results/thread_id__X/<model_name>/db/" (databaseoperations.jl:8)
+    results_dir = os.path.dirname(results_folder.rstrip("/"))
+    return os.path.join(results_dir, model_name, "db", f"forecasts_{window_type}_merged.sqlite3")
+
+
+def read_task_params(db_path: str, task_id: int) -> Optional[np.ndarray]:
+    if not os.path.isfile(db_path):
+        return None
+    db = sqlite3.connect(db_path, timeout=10.0)
+    try:
+        row = db.execute(
+            "SELECT params FROM forecasts WHERE task_id = ?", (int(task_id),)
+        ).fetchone()
+    finally:
+        db.close()
+    if row is None:
+        return None
+    return deser(row[0]).reshape(-1)
+
+
+def read_static_params_from_db(spec, task_id: int, all_params: np.ndarray,
+                               window_type: str = "expanding") -> np.ndarray:
+    """Warm-start MSED params from the simpler static model's merged DB for the
+    same task (databaseoperations.jl:5-34)."""
+    from ..models.api import get_static_model_type
+    from ..models.params import initialize_with_static_params
+
+    if not spec.is_msed:
+        return all_params
+    static_name = get_static_model_type(spec)
+    db_path = _merged_db_path(spec.results_location, static_name, window_type)
+    static_params = read_task_params(db_path, task_id)
+    if static_params is None:
+        return all_params
+    all_params = np.asarray(all_params, dtype=np.float64).copy()
+    all_params[:, 0] = initialize_with_static_params(spec, all_params[:, 0], static_params)
+    return all_params
+
+
+def read_params_from_db(spec, task_id: int, all_params: np.ndarray,
+                        window_type: str = "expanding") -> np.ndarray:
+    """Reuse this model's own past fitted params when reestimate=false
+    (databaseoperations.jl:36-72)."""
+    db_path = _merged_db_path(spec.results_location, spec.model_string, window_type)
+    params = read_task_params(db_path, task_id)
+    if params is None:
+        return all_params
+    all_params = np.asarray(all_params, dtype=np.float64).copy()
+    all_params[:, 0] = params
+    return all_params
+
+
+# ---------------------------------------------------------------------------
+# legacy CSV export (databaseoperations.jl:391-661)
+# ---------------------------------------------------------------------------
+
+def _legacy_path(results_folder, model_string, thread_id, window_type, kind):
+    return os.path.join(
+        results_folder,
+        f"{model_string}__thread_id__{thread_id}__{window_type}_window_{kind}.csv",
+    )
+
+
+def _write_csv(path: str, rows: np.ndarray) -> str:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    np.savetxt(path, rows, delimiter=",", fmt="%.18g")
+    return path
+
+
+def _export_wide(db_path, results_folder, model_string, thread_id, tasks,
+                 window_type, column, kind):
+    """(origin, target, values...) long format, sorted by target then origin."""
+    rows = []
+    db = sqlite3.connect(db_path, timeout=10.0)
+    try:
+        for task_id in tasks:
+            row = db.execute(
+                f"SELECT task_id, {column} FROM forecasts WHERE task_id = ?",
+                (int(task_id),),
+            ).fetchone()
+            if row is None:
+                continue
+            P = deser(row[1])
+            K, H = P.shape
+            for h in range(H):
+                rows.append([float(task_id), float(task_id + h + 1)] + list(P[:, h]))
+    finally:
+        db.close()
+    arr = np.asarray(rows, dtype=np.float64)
+    if arr.size:
+        arr = arr[np.lexsort((arr[:, 1],))]
+        arr = arr[np.lexsort((arr[:, 0],))]
+    return _write_csv(_legacy_path(results_folder, model_string, thread_id, window_type, kind), arr)
+
+
+def _export_params(db_path, results_folder, model_string, thread_id, tasks, window_type):
+    rows = []
+    db = sqlite3.connect(db_path, timeout=10.0)
+    try:
+        for task_id in tasks:
+            row = db.execute(
+                "SELECT task_id, params FROM forecasts WHERE task_id = ?", (int(task_id),)
+            ).fetchone()
+            if row is None:
+                continue
+            p = deser(row[1]).reshape(-1)
+            rows.append([float(task_id)] + list(p))
+    finally:
+        db.close()
+    arr = np.asarray(rows, dtype=np.float64)
+    if arr.size:
+        arr = arr[np.argsort(arr[:, 0], kind="stable")]
+    return _write_csv(
+        _legacy_path(results_folder, model_string, thread_id, window_type, "fitted_params"), arr
+    )
+
+
+def export_all_csv(spec, thread_id: str, tasks: Sequence[int],
+                   window_type: str = "expanding") -> dict:
+    """forecasts / fitted_params / fl1 / fl2 / factors / states CSVs in the
+    reference's legacy layout (databaseoperations.jl:654-661)."""
+    folder = spec.results_location
+    db_path = os.path.join(folder, "db", f"forecasts_{window_type}_merged.sqlite3")
+    ms = spec.model_string
+    return {
+        "forecasts": _export_wide(db_path, folder, ms, thread_id, tasks, window_type, "preds", "forecasts"),
+        "fitted_params": _export_params(db_path, folder, ms, thread_id, tasks, window_type),
+        "fl1": _export_wide(db_path, folder, ms, thread_id, tasks, window_type, "fl1", "fl1"),
+        "fl2": _export_wide(db_path, folder, ms, thread_id, tasks, window_type, "fl2", "fl2"),
+        "factors": _export_wide(db_path, folder, ms, thread_id, tasks, window_type, "factors", "factors"),
+        "states": _export_wide(db_path, folder, ms, thread_id, tasks, window_type, "states", "states"),
+    }

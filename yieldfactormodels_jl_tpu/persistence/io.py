@@ -1,0 +1,31 @@
+"""In-sample result CSVs (parity with /root/reference/src/io.jl:4-31)."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def save_results(spec, results: dict, loss: float, params, thread_id: str,
+                 data_type: str) -> None:
+    """Write filtered factors/states, fitted ŷ, loading columns, loss, params."""
+    folder = spec.results_location
+    os.makedirs(folder, exist_ok=True)
+    ms = spec.model_string
+
+    def path(suffix):
+        return os.path.join(folder, f"{ms}__thread_id__{thread_id}__{suffix}.csv")
+
+    factors = np.asarray(results["factors"], dtype=np.float64)
+    states = np.asarray(results["states"], dtype=np.float64)
+    np.savetxt(path(f"factors_filtered_{data_type}"),
+               np.concatenate([factors, states], axis=0).T, delimiter=",")
+    np.savetxt(path(f"fit_filtered_{data_type}"),
+               np.asarray(results["preds"], dtype=np.float64).T, delimiter=",")
+    np.savetxt(path(f"factor_loadings_1_filtered_{data_type}"),
+               np.asarray(results["factor_loadings_1"], dtype=np.float64).T, delimiter=",")
+    np.savetxt(path(f"factor_loadings_2_filtered_{data_type}"),
+               np.asarray(results["factor_loadings_2"], dtype=np.float64).T, delimiter=",")
+    np.savetxt(path("loss"), np.asarray([loss], dtype=np.float64), delimiter=",")
+    np.savetxt(path("out_params"), np.asarray(params, dtype=np.float64), delimiter=",")

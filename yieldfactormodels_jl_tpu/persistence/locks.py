@@ -1,0 +1,36 @@
+"""Filesystem coordination primitives.
+
+Parity with /root/reference/src/forecasting.jl:53-79: the entire multi-process
+"communication backend" of the reference is atomic ``mkdir`` task locks plus
+idempotent shard files — a crash-only design that fits preemptible TPU jobs,
+so it is kept as the cross-host (DCN-level) coordination layer here while
+within-host parallelism moves onto the device mesh.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Optional
+
+
+def acquire_task_lock(lockroot: str, window_type: str, task_id: int) -> Optional[str]:
+    """Atomic mkdir lock; returns the lock dir if acquired, None if held."""
+    lockdir = os.path.join(lockroot, window_type, f"task_{task_id}.lock")
+    os.makedirs(os.path.dirname(lockdir), exist_ok=True)
+    try:
+        os.mkdir(lockdir)
+        return lockdir
+    except FileExistsError:
+        return None
+
+
+def release_task_lock(lockdir: Optional[str]) -> None:
+    """Best-effort removal (forecasting.jl:73-79)."""
+    if not lockdir:
+        return
+    try:
+        if os.path.isdir(lockdir):
+            shutil.rmtree(lockdir, ignore_errors=True)
+    except OSError:
+        pass
