@@ -134,18 +134,33 @@ def _step(spec: ModelSpec, kp: KalmanParams, Z_const, d_const, state: KalmanStat
     return KalmanState(beta_next, P_next), outs
 
 
+def measurement_setup(spec: ModelSpec, kp: KalmanParams, dtype):
+    """(Z_const, d_const) for the constant-measurement families; (None, None)
+    for TVλ whose Z is state-dependent.  Shared by the joint-form filter here,
+    the univariate kernel (ops/univariate_kf.py) and the associative-scan
+    filter so the likelihood kernels can never diverge on loadings setup."""
+    mats = spec.maturities_array
+    if spec.family == "kalman_dns":
+        return dns_loadings(kp.gamma, mats).astype(dtype), None
+    if spec.family == "kalman_afns":
+        Z = afns_loadings(kp.gamma, mats, spec.M).astype(dtype)
+        return Z, yield_adjustment(kp.gamma, kp.Omega_state, mats, spec.M)
+    return None, None
+
+
+def loglik_contrib_mask(start, end, T):
+    """The loss convention shared by every kalman loglik kernel: recursion over
+    t = 1..T−1 skipping the first innovation ⇒ contributing steps are
+    start+1 .. end−2 (0-based) — kalman/filter.jl:182-209."""
+    t_idx = jnp.arange(T)
+    return (t_idx >= start + 1) & (t_idx <= end - 2)
+
+
 def _scan_filter(spec: ModelSpec, params, data, start, end, state0: KalmanState | None = None):
     """Run the filter over all T columns of ``data`` (N, T).  ``start``/``end``
     may be traced scalars; columns outside [start, end) are treated as missing."""
     kp = unpack_kalman(spec, params)
-    Z_const = None
-    d_const = None
-    if spec.family == "kalman_dns":
-        Z_const = dns_loadings(kp.gamma, spec.maturities_array).astype(params.dtype)
-    elif spec.family == "kalman_afns":
-        mats = spec.maturities_array
-        Z_const = afns_loadings(kp.gamma, mats, spec.M).astype(params.dtype)
-        d_const = yield_adjustment(kp.gamma, kp.Omega_state, mats, spec.M)
+    Z_const, d_const = measurement_setup(spec, kp, params.dtype)
     if state0 is None:
         state0 = init_state(spec, kp)
     T = data.shape[1]
@@ -175,8 +190,7 @@ def get_loss(spec: ModelSpec, params, data, start=0, end=None):
     if end is None:
         end = T
     _, _, _, outs = _scan_filter(spec, params, data, start, end)
-    t_idx = jnp.arange(T)
-    contrib = (t_idx >= start + 1) & (t_idx <= end - 2)
+    contrib = loglik_contrib_mask(start, end, T)
     loglik = jnp.sum(jnp.where(contrib, outs["ll"], 0.0))
     return jnp.where(jnp.isfinite(loglik), loglik, -jnp.inf)
 
@@ -192,8 +206,7 @@ def get_loss_array(spec: ModelSpec, params, data, start=0, end=None, K: int = 1)
     T = data.shape[1]
     if end is None:
         end = T
-    t_idx = jnp.arange(T)
-    contrib = (t_idx >= start + 1) & (t_idx <= end - 2)
+    contrib = loglik_contrib_mask(start, end, T)
     acc = jnp.zeros((T,), dtype=data.dtype)
     state = None
     for _ in range(K):

@@ -25,8 +25,6 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..models import kalman as K
-from ..models.afns import afns_loadings, yield_adjustment
-from ..models.loadings import dns_loadings
 from ..models.params import unpack_kalman
 from ..models.specs import ModelSpec
 
@@ -117,15 +115,11 @@ def filter_means_covs(spec: ModelSpec, params, data, start=0, end=None):
     Returns (m (T, Ms) = E[x_t | y_{1:t}], P (T, Ms, Ms)).
     """
     kp = unpack_kalman(spec, params)
-    mats = spec.maturities_array
-    if spec.family == "kalman_afns":
-        Z = afns_loadings(kp.gamma, mats, spec.M)
-        d = yield_adjustment(kp.gamma, kp.Omega_state, mats, spec.M)
-    elif spec.family == "kalman_dns":
-        Z = dns_loadings(kp.gamma, mats)
-        d = jnp.zeros((spec.N,), dtype=Z.dtype)
-    else:
+    Z, d = K.measurement_setup(spec, kp, params.dtype)
+    if Z is None:
         raise ValueError("associative-scan filter requires a constant measurement matrix")
+    if d is None:
+        d = jnp.zeros((spec.N,), dtype=Z.dtype)
     state0 = K.init_state(spec, kp)
     T = data.shape[1]
     if end is None:
