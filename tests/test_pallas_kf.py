@@ -1,0 +1,79 @@
+"""Pallas fused batched Kalman loglik vs the XLA univariate kernel.
+
+Runs in interpret mode on CPU (the kernel compiles to Mosaic on real TPU;
+bench.py cross-checks there).  Agreement target: same f32 arithmetic, only
+accumulation-order differences.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from yieldfactormodels_jl_tpu import create_model
+from yieldfactormodels_jl_tpu.ops import pallas_kf, univariate_kf
+
+MATS = tuple(np.array([3, 6, 9, 12, 24, 36, 48, 60, 84, 120, 180, 240, 360]) / 12.0)
+
+
+def _params(spec, B, rng):
+    p = np.zeros((B, spec.n_params), dtype=np.float32)
+    lo, hi = spec.layout["gamma"]
+    p[:, lo:hi] = np.log(0.4) + 0.2 * rng.standard_normal((B, hi - lo))
+    lo, hi = spec.layout["obs_var"]
+    p[:, lo:hi] = 0.01
+    Ms = spec.state_dim
+    k = spec.layout["chol"][0]
+    for j in range(Ms):
+        for i in range(j + 1):
+            p[:, k] = 0.1 if i == j else 0.01
+            k += 1
+    lo, hi = spec.layout["delta"]
+    p[:, lo:hi] = 0.2 * rng.standard_normal((B, Ms))
+    lo, hi = spec.layout["phi"]
+    ph = 0.9 * np.eye(Ms)
+    p[:, lo:hi] = ph.reshape(-1)
+    return p
+
+
+@pytest.mark.parametrize("code", ["1C", "AFNS3", "AFNS5"])
+def test_matches_univariate(code, rng):
+    spec, _ = create_model(code, MATS, float_type="float32")
+    B, T = 6, 36
+    p = _params(spec, B, rng)
+    data = (0.5 * rng.standard_normal((len(MATS), T)) + 4).astype(np.float32)
+    data[:, -3:] = np.nan          # forecast tail -> predict-only
+    data[2, 10] = np.nan           # interior partial NaN -> column missing
+    start, end = 2, T - 1
+    ref = jax.vmap(lambda q: univariate_kf.get_loss(spec, q, data, start, end))(
+        jnp.asarray(p))
+    got = pallas_kf.batched_loglik(spec, p, data, start, end, interpret=True)
+    assert got.shape == (B,)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=5e-4, atol=1e-2)
+
+
+def test_full_window_default(rng):
+    spec, _ = create_model("1C", MATS, float_type="float32")
+    p = _params(spec, 3, rng)
+    data = (0.5 * rng.standard_normal((len(MATS), 30)) + 4).astype(np.float32)
+    ref = jax.vmap(lambda q: univariate_kf.get_loss(spec, q, data))(jnp.asarray(p))
+    got = pallas_kf.batched_loglik(spec, p, data, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=5e-4, atol=1e-2)
+
+
+def test_invalid_params_give_neg_inf(rng):
+    spec, _ = create_model("1C", MATS, float_type="float32")
+    p = _params(spec, 2, rng)
+    p[1, :] = np.nan
+    data = (0.5 * rng.standard_normal((len(MATS), 20)) + 4).astype(np.float32)
+    got = np.asarray(pallas_kf.batched_loglik(spec, p, data, interpret=True))
+    assert np.isfinite(got[0])
+    assert got[1] == -np.inf
+
+
+def test_unsupported_family_raises(rng):
+    spec, _ = create_model("TVλ", MATS, float_type="float32")
+    with pytest.raises(ValueError):
+        pallas_kf.batched_loglik(spec, np.zeros((2, spec.n_params)),
+                                 np.zeros((len(MATS), 10)), interpret=True)

@@ -144,7 +144,8 @@ def measurement_setup(spec: ModelSpec, kp: KalmanParams, dtype):
         return dns_loadings(kp.gamma, mats).astype(dtype), None
     if spec.family == "kalman_afns":
         Z = afns_loadings(kp.gamma, mats, spec.M).astype(dtype)
-        return Z, yield_adjustment(kp.gamma, kp.Omega_state, mats, spec.M)
+        d = yield_adjustment(kp.gamma, kp.Omega_state, mats, spec.M)
+        return Z, d.astype(dtype)
     return None, None
 
 

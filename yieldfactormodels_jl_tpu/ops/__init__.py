@@ -1,3 +1,13 @@
 from . import linalg
 
-__all__ = ["linalg"]
+__all__ = ["linalg", "assoc_scan", "particle", "pallas_kf", "univariate_kf"]
+
+
+def __getattr__(name):
+    # lazy: pallas/associative-scan/particle modules import jax.experimental
+    # machinery that should not load unless used
+    if name in __all__:
+        import importlib
+
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(name)
