@@ -1,0 +1,100 @@
+"""Shared panel/parameter builders for the BASELINE.md benchmark configs.
+
+Synthetic Liu–Wu-shaped monthly panels (N=20 maturities, T=360 months) from
+stationary DNS/AFNS DGPs — the same shapes bench.py uses, factored out for
+the five-config suite in run_all.py.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+N_MATURITIES = 20
+T_MONTHS = 360
+
+MATURITIES_M = np.array([3, 6, 9, 12, 15, 18, 21, 24, 30, 36, 48, 60, 72, 84,
+                         96, 108, 120, 180, 240, 360], dtype=np.float64)
+MATURITIES = MATURITIES_M / 12.0
+
+
+def dns_panel(seed=0, lam=0.5, T=T_MONTHS):
+    """3-factor DNS DGP panel (N, T)."""
+    rng = np.random.default_rng(seed)
+    tau = lam * MATURITIES
+    Z = np.ones((N_MATURITIES, 3))
+    Z[:, 1] = (1 - np.exp(-tau)) / tau
+    Z[:, 2] = Z[:, 1] - np.exp(-tau)
+    Phi = np.diag([0.98, 0.94, 0.9])
+    delta = np.array([0.08, -0.06, 0.03])
+    x = np.linalg.solve(np.eye(3) - Phi, delta)
+    data = np.zeros((N_MATURITIES, T))
+    for t in range(T):
+        x = delta + Phi @ x + 0.05 * rng.standard_normal(3)
+        data[:, t] = Z @ x + 0.02 * rng.standard_normal(N_MATURITIES)
+    return data + 4.0
+
+
+def afns5_panel(seed=0, T=T_MONTHS):
+    """5-factor AFNS (AFGNS) DGP panel (N, T)."""
+    rng = np.random.default_rng(seed)
+    lam1, lam2 = 0.5, 0.15
+    Z = np.ones((N_MATURITIES, 5))
+    for col, lam in ((1, lam1), (3, lam2)):
+        tau = lam * MATURITIES
+        Z[:, col] = (1 - np.exp(-tau)) / tau
+        Z[:, col + 1] = Z[:, col] - np.exp(-tau)
+    Phi = np.diag([0.98, 0.94, 0.9, 0.92, 0.88])
+    delta = np.array([0.08, -0.06, 0.03, -0.02, 0.01])
+    x = np.linalg.solve(np.eye(5) - Phi, delta)
+    data = np.zeros((N_MATURITIES, T))
+    for t in range(T):
+        x = delta + Phi @ x + 0.05 * rng.standard_normal(5)
+        data[:, t] = Z @ x + 0.02 * rng.standard_normal(N_MATURITIES)
+    return data + 4.0
+
+
+def dns_params(spec):
+    """Plausible constrained DNS ('1C') parameter vector."""
+    p = np.zeros(spec.n_params)
+    lo, hi = spec.layout["gamma"]
+    p[lo:hi] = math.log(0.5 - 1e-2)
+    lo, hi = spec.layout["obs_var"]
+    p[lo:hi] = 4e-4
+    k = spec.layout["chol"][0]
+    for j in range(spec.state_dim):
+        for i in range(j + 1):
+            p[k] = 0.05 + 0.01 * i if i == j else 0.002
+            k += 1
+    lo, hi = spec.layout["delta"]
+    p[lo:hi] = [0.08, -0.06, 0.03][: hi - lo] + [0.0] * max(0, hi - lo - 3)
+    lo, hi = spec.layout["phi"]
+    p[lo:hi] = np.diag([0.98, 0.94, 0.9][: spec.state_dim]).reshape(-1)
+    return p
+
+
+def afns5_params(spec):
+    """Plausible constrained AFNS5 parameter vector."""
+    p = np.zeros(spec.n_params)
+    p[0:2] = [math.log(0.5), math.log(0.15)]
+    lo, hi = spec.layout["obs_var"]
+    p[lo:hi] = 4e-4
+    k = spec.layout["chol"][0]
+    for j in range(5):
+        for i in range(j + 1):
+            p[k] = 0.05 + 0.01 * i if i == j else 0.002
+            k += 1
+    lo, hi = spec.layout["delta"]
+    p[lo:hi] = [4.0, -1.0, 0.5, -0.3, 0.2]
+    lo, hi = spec.layout["phi"]
+    p[lo:hi] = np.diag([0.98, 0.94, 0.9, 0.92, 0.88]).reshape(-1)
+    return p
+
+
+def jitter_starts(p, n_starts, seed=1, scale=0.05):
+    """(S, P) stack of jittered copies of ``p`` (multi-start initialization)."""
+    rng = np.random.default_rng(seed)
+    s = np.tile(p, (n_starts, 1))
+    s += scale * rng.standard_normal(s.shape) * np.maximum(np.abs(p), 0.01)[None, :]
+    return s

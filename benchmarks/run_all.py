@@ -1,0 +1,261 @@
+"""The five BASELINE.md benchmark configs, measured device-vs-CPU.
+
+Workloads (full scale, from BASELINE.json):
+  1. dns3-mle        3-factor DNS, single-start MLE (LBFGS)
+  2. afns5-mle64     5-factor AFNS, multi-start MLE, 64 starts
+  3. afns5-sv-pf     AFNS + stochastic-volatility errors, 1,000 particle-filter
+                     draws (1,000 particles each)
+  4. rolling-240     240 expanding windows × 2 starts re-estimation + 12-step
+                     forecasts
+  5. bootstrap-2000  2,000 moving-block resamples × 16-point λ grid
+
+Protocol: every config runs the SAME jitted code path on the device and on a
+single CPU core (``taskset -c 0``, JAX CPU backend) — a generous stand-in for
+the reference's 1-thread Julia loop (its per-step CPU oracle is measured by
+bench.py).  CPU runs use a documented 1/k-scale workload and are extrapolated
+linearly; device numbers are full scale, steady state (2nd run, compile
+cached).  Results: one JSON line per config, merged into
+``benchmarks/results.json`` by the orchestrator:
+
+    python benchmarks/run_all.py              # orchestrate device + cpu
+    python benchmarks/run_all.py --side device --configs all
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+
+# (config, cpu_scale) — cpu runs workload/scale and extrapolates ×scale
+CONFIGS = [
+    ("dns3-mle", 1),
+    ("afns5-mle64", 16),
+    ("afns5-sv-pf", 100),
+    ("rolling-240", 24),
+    ("bootstrap-2000", 20),
+]
+
+
+def _run_config(name: str, scale: int):
+    """Returns (wall_seconds, work_descr).  ``scale`` divides the batch axis."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    for p in (HERE, ROOT):
+        if p not in sys.path:
+            sys.path.insert(0, p)
+    import common
+
+    from yieldfactormodels_jl_tpu import create_model
+    from yieldfactormodels_jl_tpu.estimation import optimize
+    from yieldfactormodels_jl_tpu.estimation.bootstrap import bootstrap_lambda_grid
+    from yieldfactormodels_jl_tpu.models import api
+    from yieldfactormodels_jl_tpu.models.params import untransform_params
+    from yieldfactormodels_jl_tpu.ops.particle import particle_filter_loglik
+
+    def steady(fn):
+        """Run twice (compile + steady state), hard-synced; time the 2nd."""
+        np.asarray(jax.block_until_ready(fn()))
+        t0 = time.perf_counter()
+        out = np.asarray(jax.block_until_ready(fn()))
+        return time.perf_counter() - t0, out
+
+    if name == "dns3-mle":
+        spec, _ = create_model("1C", tuple(common.MATURITIES), float_type="float32")
+        data = common.dns_panel()
+        p0 = common.dns_params(spec)
+
+        def job():
+            _, ll, best, _ = optimize.estimate(spec, data, p0[:, None],
+                                               max_iters=200)
+            return np.asarray([ll])
+
+        wall, out = steady(job)
+        return wall, f"1 start x 200 LBFGS iters, ll={out[0]:.1f}"
+
+    if name == "afns5-mle64":
+        spec, _ = create_model("AFNS5", tuple(common.MATURITIES), float_type="float32")
+        data = common.afns5_panel()
+        S = max(1, 64 // scale)
+        starts = common.jitter_starts(common.afns5_params(spec), S).T  # (P, S)
+
+        def job():
+            _, ll, best, _ = optimize.estimate(spec, data, starts, max_iters=100)
+            return np.asarray([ll])
+
+        wall, out = steady(job)
+        return wall, f"{S} starts x 100 LBFGS iters, ll={out[0]:.1f}"
+
+    if name == "afns5-sv-pf":
+        spec, _ = create_model("AFNS5", tuple(common.MATURITIES), float_type="float32")
+        data = jnp.asarray(common.afns5_panel(), dtype=spec.dtype)
+        D = max(1, 1000 // scale)
+        # chunk the draw axis: 1000 draws x 1000 particles won't fit HBM at
+        # once (the per-step K gain alone is draws x particles x Ms x N)
+        CH = min(D, 50)
+        D = (D // CH) * CH
+        draws = common.jitter_starts(common.afns5_params(spec), D, scale=0.02)
+        draws = jnp.asarray(draws, dtype=spec.dtype).reshape(D // CH, CH, -1)
+        keys = jax.random.split(jax.random.PRNGKey(0), D).reshape(D // CH, CH, -1)
+        # chunks dispatched as a python loop of jitted calls: lax.map over the
+        # chunk axis faults the TPU runtime here, and chunks ≳250 draws crash
+        # the worker outright, so CH=50 is the stable envelope
+        inner = jax.jit(jax.vmap(
+            lambda p, k: particle_filter_loglik(spec, p, data, k,
+                                                n_particles=1000)))
+
+        def fn(ds, ks):
+            return jnp.concatenate([inner(ds[i], ks[i])
+                                    for i in range(ds.shape[0])])
+
+        # warm/compile on one chunk, then time a single full pass (a second
+        # full pass would double a ~15 min device run for no extra signal)
+        np.asarray(jax.block_until_ready(inner(draws[0], keys[0])))
+        t0 = time.perf_counter()
+        out = np.asarray(jax.block_until_ready(fn(draws, keys)))
+        wall = time.perf_counter() - t0
+        n_fin = int(np.isfinite(out).sum())
+        return wall, f"{D} draws x 1000 particles, finite {n_fin}/{D}"
+
+    if name == "rolling-240":
+        spec, _ = create_model("1C", tuple(common.MATURITIES), float_type="float32")
+        data = common.dns_panel()
+        T = data.shape[1]
+        W = max(1, 240 // scale)
+        S = 2
+        ends = np.linspace(T - 240, T, 240, endpoint=False, dtype=np.int64) + 1
+        ends = ends[-W:]
+        raw0 = np.asarray(untransform_params(
+            spec, jnp.asarray(common.dns_params(spec), dtype=spec.dtype)))
+        starts2 = common.jitter_starts(raw0, S, scale=0.02)
+        horizon = 12
+        nan_pad = np.full((data.shape[0], horizon), np.nan, dtype=np.float32)
+        data_ext = jnp.asarray(np.concatenate([data.astype(np.float32), nan_pad], axis=1))
+
+        predict_w = jax.jit(jax.vmap(
+            lambda p, end: api.predict(
+                spec,
+                p,
+                jnp.where(jnp.arange(data_ext.shape[1])[None, :] < end,
+                          data_ext, jnp.nan))))
+
+        def job():
+            params_ws, losses = optimize.estimate_windows(
+                spec, data, jnp.asarray(starts2, dtype=spec.dtype),
+                jnp.zeros((W,), dtype=jnp.int32), jnp.asarray(ends),
+                max_iters=50)
+            best = jnp.argmin(losses, axis=1)
+            best_p = jax.vmap(lambda ps, j: ps[j])(params_ws, best)
+            from yieldfactormodels_jl_tpu.models.params import transform_params
+            cons = jax.vmap(lambda p: transform_params(spec, p))(best_p)
+            preds = predict_w(cons, jnp.asarray(ends))["preds"]
+            return np.asarray(preds)
+
+        wall, out = steady(job)
+        return wall, f"{W} windows x {S} starts x 50 iters + {horizon}-step forecasts"
+
+    if name == "bootstrap-2000":
+        spec, _ = create_model("NS", tuple(common.MATURITIES), float_type="float32")
+        data = common.dns_panel()
+        R = max(1, 2000 // scale)
+        G = 16
+        grid = np.linspace(0.1, 1.2, G)
+        p = np.zeros(spec.n_params, dtype=np.float32)
+        p[1:4] = [0.08, -0.06, 0.03]
+        p[4:13] = np.diag([0.9, 0.9, 0.9]).reshape(-1)
+
+        def job():
+            losses, lo, hi, freq = bootstrap_lambda_grid(
+                spec, p, data, grid, n_resamples=R, block_len=12)
+            return np.asarray(losses)
+
+        wall, out = steady(job)
+        return wall, f"{R} resamples x {G} lambdas = {R * G} filter passes"
+
+    raise ValueError(name)
+
+
+def _side_main(side: str, configs):
+    for name, cpu_scale in CONFIGS:
+        if configs != "all" and name not in configs:
+            continue
+        scale = 1 if side == "device" else cpu_scale
+        wall, descr = _run_config(name, scale)
+        print(json.dumps({"config": name, "side": side, "wall_s": round(wall, 3),
+                          "scale": scale, "work": descr}), flush=True)
+
+
+def _orchestrate(configs):
+    """Device subprocess (axon TPU) + pinned single-core CPU subprocess."""
+    me = os.path.abspath(__file__)
+    results = {}
+
+    def collect(cmd, env, timeout, tag):
+        proc = subprocess.run(cmd, env=env, timeout=timeout,
+                              capture_output=True, text=True, cwd=ROOT)
+        if proc.returncode != 0:
+            sys.stderr.write(f"# {tag} failed rc={proc.returncode}:\n"
+                             f"{proc.stderr[-1500:]}\n")
+        for line in proc.stdout.splitlines():
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            results.setdefault(rec["config"], {})[rec["side"]] = rec
+
+    cpu_env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
+    cpu_env.update({"JAX_PLATFORMS": "cpu", "OMP_NUM_THREADS": "1",
+                    "OPENBLAS_NUM_THREADS": "1"})
+    # one subprocess per (config, side): a failure (OOM etc.) can't take the
+    # remaining configs down with it
+    names = [n for n, _ in CONFIGS] if configs == "all" else configs.split(",")
+    for name in names:
+        collect([sys.executable, me, "--side", "device", "--configs", name],
+                dict(os.environ), 3000, f"device:{name}")
+        collect(["taskset", "-c", "0", sys.executable, me,
+                 "--side", "cpu", "--configs", name], cpu_env, 6000, f"cpu:{name}")
+
+    merged = []
+    for name, _scale in CONFIGS:
+        if name not in results:
+            continue
+        rec = {"config": name}
+        dev = results[name].get("device")
+        cpu = results[name].get("cpu")
+        if dev:
+            rec["device_wall_s"] = dev["wall_s"]
+            rec["work"] = dev["work"]
+        if cpu:
+            rec["cpu_scale"] = cpu["scale"]
+            rec["cpu_wall_s_scaled"] = cpu["wall_s"]
+            rec["cpu_wall_s_est"] = round(cpu["wall_s"] * cpu["scale"], 3)
+        if dev and cpu and dev["wall_s"] > 0:
+            rec["speedup_vs_1core"] = round(
+                cpu["wall_s"] * cpu["scale"] / dev["wall_s"], 2)
+        merged.append(rec)
+        print(json.dumps(rec))
+    out_path = os.path.join(HERE, "results.json")
+    with open(out_path, "w") as f:
+        json.dump(merged, f, indent=2)
+    sys.stderr.write(f"# wrote {out_path}\n")
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--side", choices=["device", "cpu"], default=None)
+    ap.add_argument("--configs", default="all",
+                    help="'all' or comma-separated config names")
+    a = ap.parse_args()
+    cfgs = a.configs if a.configs == "all" else a.configs.split(",")
+    if a.side:
+        _side_main(a.side, cfgs)
+    else:
+        _orchestrate(a.configs)

@@ -1,0 +1,97 @@
+"""Kalman engine selection: all four engines agree through the public API."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import yieldfactormodels_jl_tpu as yfm
+from yieldfactormodels_jl_tpu.models import api
+
+MATS = tuple(np.array([3, 12, 24, 60, 120, 240, 360]) / 12.0)
+
+
+@pytest.fixture
+def dns_case(rng):
+    spec, _ = yfm.create_model("1C", MATS, float_type="float64")
+    p = np.zeros(spec.n_params)
+    p[0] = np.log(0.45)
+    p[1] = 4e-4
+    k = 2
+    for j in range(3):
+        for i in range(j + 1):
+            p[k] = 0.05 if i == j else 0.004
+            k += 1
+    p[8:11] = [0.1, -0.05, 0.02]
+    p[11:20] = (0.92 * np.eye(3)).reshape(-1)
+    data = 0.4 * rng.standard_normal((len(MATS), 50)) + 4.0
+    return spec, jnp.asarray(p), jnp.asarray(data)
+
+
+def test_all_engines_agree(dns_case):
+    spec, p, data = dns_case
+    vals = {e: float(api.get_loss(spec, p, data, 1, 48, engine=e))
+            for e in yfm.KALMAN_ENGINES}
+    base = vals["univariate"]
+    assert np.isfinite(base)
+    for e, v in vals.items():
+        np.testing.assert_allclose(v, base, rtol=1e-7, err_msg=e)
+
+
+def test_process_wide_engine_setting(dns_case):
+    spec, p, data = dns_case
+    base = float(api.get_loss(spec, p, data))
+    try:
+        yfm.set_kalman_engine("sqrt")
+        assert yfm.kalman_engine() == "sqrt"
+        np.testing.assert_allclose(float(api.get_loss(spec, p, data)), base,
+                                   rtol=1e-7)
+    finally:
+        yfm.set_kalman_engine("univariate")
+    with pytest.raises(ValueError):
+        yfm.set_kalman_engine("bogus")
+    with pytest.raises(ValueError):
+        api.get_loss(spec, p, data, engine="Sqrt")  # per-call typo must raise
+
+
+def test_engine_switch_clears_jitted_estimation_caches(dns_case):
+    """set_kalman_engine must invalidate the lru-cached jitted losses in the
+    estimation layer, or a process-wide switch silently keeps running the old
+    traced engine."""
+    spec, p, data = dns_case
+    from yieldfactormodels_jl_tpu.estimation import optimize
+
+    optimize._jitted_loss(spec, data.shape[1])  # populate the lru cache
+    assert optimize._jitted_loss.cache_info().currsize >= 1
+    try:
+        yfm.set_kalman_engine("sqrt")
+        assert optimize._jitted_loss.cache_info().currsize == 0
+    finally:
+        yfm.set_kalman_engine("univariate")
+
+
+def test_sqrt_engine_neg_inf_on_invalid_factorization(dns_case, rng):
+    """Non-stationary Φ ⇒ indefinite P0 ⇒ −Inf sentinel (not a silently
+    altered prior)."""
+    spec, p, data = dns_case
+    bad = np.asarray(p).copy()
+    lo, hi = spec.layout["phi"]
+    bad[lo:hi] = (1.05 * np.eye(spec.state_dim)).reshape(-1)  # explosive
+    v = float(api.get_loss(spec, jnp.asarray(bad), data, engine="sqrt"))
+    assert v == -np.inf
+
+
+def test_assoc_falls_back_for_tvl(rng):
+    spec, _ = yfm.create_model("TVλ", MATS, float_type="float64")
+    p = np.zeros(spec.n_params)
+    p[0] = 4e-4
+    k = 1
+    for j in range(4):
+        for i in range(j + 1):
+            p[k] = 0.05 if i == j else 0.002
+            k += 1
+    p[11:15] = [0.1, -0.05, 0.02, np.log(0.45)]
+    p[15:31] = (0.9 * np.eye(4)).reshape(-1)
+    data = 0.4 * rng.standard_normal((len(MATS), 30)) + 4.0
+    a = float(api.get_loss(spec, jnp.asarray(p), jnp.asarray(data), engine="assoc"))
+    u = float(api.get_loss(spec, jnp.asarray(p), jnp.asarray(data), engine="univariate"))
+    np.testing.assert_allclose(a, u, rtol=1e-12)

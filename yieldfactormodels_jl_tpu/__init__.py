@@ -20,7 +20,8 @@ The reference contains zero native (C++/CUDA) components (SURVEY.md §2); the
 native layer of this framework is XLA itself plus optional Pallas kernels.
 """
 
-from .config import default_dtype, set_default_dtype
+from .config import (default_dtype, set_default_dtype,
+                     kalman_engine, set_kalman_engine, KALMAN_ENGINES)
 from .models.specs import ModelSpec
 from .models.registry import create_model, MODEL_CODES
 from .models import api as model_api
@@ -70,6 +71,9 @@ __all__ = [
     "load_data",
     "default_dtype",
     "set_default_dtype",
+    "kalman_engine",
+    "set_kalman_engine",
+    "KALMAN_ENGINES",
 ]
 
 __version__ = "0.1.0"

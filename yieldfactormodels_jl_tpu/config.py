@@ -13,6 +13,14 @@ import jax.numpy as jnp
 
 _DEFAULT_DTYPE = jnp.float32
 
+#: Kalman loglik engine used by ``api.get_loss``:
+#:   "univariate"  sequential-observation scalar updates (production default)
+#:   "sqrt"        Potter square-root form — PSD-by-construction in f32
+#:   "joint"       textbook joint update with per-step Cholesky
+#:   "assoc"       parallel-in-time associative scan (constant-Z families)
+KALMAN_ENGINES = ("univariate", "sqrt", "joint", "assoc")
+_KALMAN_ENGINE = "univariate"
+
 
 def default_dtype():
     return _DEFAULT_DTYPE
@@ -21,3 +29,33 @@ def default_dtype():
 def set_default_dtype(dtype) -> None:
     global _DEFAULT_DTYPE
     _DEFAULT_DTYPE = jnp.dtype(dtype)
+
+
+def kalman_engine() -> str:
+    return _KALMAN_ENGINE
+
+
+def set_kalman_engine(name: str) -> None:
+    """Select the Kalman loglik kernel (process-wide; per-call override via
+    ``api.get_loss(..., engine=...)``).
+
+    The choice is read at trace time, so the estimation layer's lru-cached
+    jitted losses would otherwise keep running the engine they were traced
+    with — those caches are cleared here so the next call re-traces."""
+    global _KALMAN_ENGINE
+    if name not in KALMAN_ENGINES:
+        raise ValueError(f"unknown kalman engine {name!r}; pick from {KALMAN_ENGINES}")
+    _KALMAN_ENGINE = name
+    try:  # drop stale traced executables (no-op if estimation never imported)
+        import sys
+
+        opt = sys.modules.get("yieldfactormodels_jl_tpu.estimation.optimize")
+        if opt is not None:
+            for fn_name in ("_jitted_loss", "_jitted_batch_loss",
+                            "_jitted_multistart_lbfgs", "_jitted_group_opt",
+                            "_jitted_window_multistart"):
+                fn = getattr(opt, fn_name, None)
+                if fn is not None and hasattr(fn, "cache_clear"):
+                    fn.cache_clear()
+    except Exception:
+        pass

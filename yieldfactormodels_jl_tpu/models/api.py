@@ -25,16 +25,35 @@ def _engine(spec: ModelSpec):
     return static_model
 
 
-def get_loss(spec: ModelSpec, params, data, start=0, end=None, K: int = 1):
+def get_loss(spec: ModelSpec, params, data, start=0, end=None, K: int = 1,
+             engine: str | None = None):
     if spec.is_kalman:
-        # Production path is the univariate (sequential-observation) kernel:
-        # algebraically identical to the joint form for the diagonal Ω_obs all
-        # models here use, but Cholesky-free — rank-1 FMAs that stay in true
-        # f32 on TPU where the joint form's batched N×N Cholesky/matmuls drop
-        # to bf16 MXU passes (≈33× faster AND more precise on TPU; see
-        # ops/univariate_kf.py and tests/test_univariate_kf.py).
+        # Default production path is the univariate (sequential-observation)
+        # kernel: algebraically identical to the joint form for the diagonal
+        # Ω_obs all models here use, but Cholesky-free — rank-1 FMAs that stay
+        # in true f32 on TPU where the joint form's batched N×N Cholesky/
+        # matmuls drop to bf16 MXU passes (≈33× faster AND more precise on
+        # TPU; see ops/univariate_kf.py).  Alternatives (config.KALMAN_ENGINES)
+        # are trace-time choices: "sqrt" (Potter, PSD-by-construction f32),
+        # "joint" (textbook), "assoc" (parallel-in-time; constant-Z families —
+        # falls back to univariate for TVλ).
+        from .. import config
         from ..ops import univariate_kf
 
+        name = engine or config.kalman_engine()
+        if name not in config.KALMAN_ENGINES:
+            raise ValueError(
+                f"unknown kalman engine {name!r}; pick from {config.KALMAN_ENGINES}")
+        if name == "sqrt":
+            from ..ops import sqrt_kf
+
+            return sqrt_kf.get_loss(spec, params, data, start, end)
+        if name == "joint":
+            return kalman.get_loss(spec, params, data, start, end)
+        if name == "assoc" and spec.family != "kalman_tvl":
+            from ..ops import assoc_scan
+
+            return assoc_scan.get_loss(spec, params, data, start, end)
         return univariate_kf.get_loss(spec, params, data, start, end)
     return _engine(spec).get_loss(spec, params, data, start, end, K)
 
