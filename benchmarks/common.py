@@ -1,8 +1,8 @@
 """Shared panel/parameter builders for the BASELINE.md benchmark configs.
 
 Synthetic Liu–Wu-shaped monthly panels (N=20 maturities, T=360 months) from
-stationary DNS/AFNS DGPs — the same shapes bench.py uses, factored out for
-the five-config suite in run_all.py.
+stationary DNS/AFNS DGPs — the same shapes the repo-root ``bench.py`` uses,
+factored out for the five-config suite in run_all.py.
 """
 
 from __future__ import annotations

@@ -12,7 +12,8 @@ Workloads (full scale, from BASELINE.json):
 Protocol: every config runs the SAME jitted code path on the device and on a
 single CPU core (``taskset -c 0``, JAX CPU backend) — a generous stand-in for
 the reference's 1-thread Julia loop (its per-step CPU oracle is measured by
-bench.py).  CPU runs use a documented 1/k-scale workload and are extrapolated
+the repo-root ``bench.py``).  CPU runs use a documented 1/k-scale workload and
+are extrapolated
 linearly; device numbers are full scale, steady state (2nd run, compile
 cached).  Results: one JSON line per config, merged into
 ``benchmarks/results.json`` by the orchestrator:
@@ -98,16 +99,15 @@ def _run_config(name: str, scale: int):
         spec, _ = create_model("AFNS5", tuple(common.MATURITIES), float_type="float32")
         data = jnp.asarray(common.afns5_panel(), dtype=spec.dtype)
         D = max(1, 1000 // scale)
-        # chunk the draw axis: 1000 draws x 1000 particles won't fit HBM at
-        # once (the per-step K gain alone is draws x particles x Ms x N)
-        CH = min(D, 50)
+        # chunk the draw axis: 1000 draws x 1000 particles at once exhausts
+        # HBM; 250-draw chunks are the stable envelope
+        CH = min(D, 250)
         D = (D // CH) * CH
         draws = common.jitter_starts(common.afns5_params(spec), D, scale=0.02)
         draws = jnp.asarray(draws, dtype=spec.dtype).reshape(D // CH, CH, -1)
         keys = jax.random.split(jax.random.PRNGKey(0), D).reshape(D // CH, CH, -1)
-        # chunks dispatched as a python loop of jitted calls: lax.map over the
-        # chunk axis faults the TPU runtime here, and chunks ≳250 draws crash
-        # the worker outright, so CH=50 is the stable envelope
+        # chunks dispatched as a python loop of jitted calls (lax.map over the
+        # chunk axis faults the TPU runtime here)
         inner = jax.jit(jax.vmap(
             lambda p, k: particle_filter_loglik(spec, p, data, k,
                                                 n_particles=1000)))
@@ -148,11 +148,12 @@ def _run_config(name: str, scale: int):
                           data_ext, jnp.nan))))
 
         def job():
-            params_ws, losses = optimize.estimate_windows(
+            params_ws, lls = optimize.estimate_windows(
                 spec, data, jnp.asarray(starts2, dtype=spec.dtype),
                 jnp.zeros((W,), dtype=jnp.int32), jnp.asarray(ends),
                 max_iters=50)
-            best = jnp.argmin(losses, axis=1)
+            # estimate_windows returns log-likelihoods — higher is better
+            best = jnp.argmax(jnp.where(jnp.isfinite(lls), lls, -jnp.inf), axis=1)
             best_p = jax.vmap(lambda ps, j: ps[j])(params_ws, best)
             from yieldfactormodels_jl_tpu.models.params import transform_params
             cons = jax.vmap(lambda p: transform_params(spec, p))(best_p)
@@ -199,8 +200,12 @@ def _orchestrate(configs):
     results = {}
 
     def collect(cmd, env, timeout, tag):
-        proc = subprocess.run(cmd, env=env, timeout=timeout,
-                              capture_output=True, text=True, cwd=ROOT)
+        try:
+            proc = subprocess.run(cmd, env=env, timeout=timeout,
+                                  capture_output=True, text=True, cwd=ROOT)
+        except subprocess.TimeoutExpired:
+            sys.stderr.write(f"# {tag} timed out after {timeout}s\n")
+            return
         if proc.returncode != 0:
             sys.stderr.write(f"# {tag} failed rc={proc.returncode}:\n"
                              f"{proc.stderr[-1500:]}\n")

@@ -60,11 +60,17 @@ def test_engine_switch_clears_jitted_estimation_caches(dns_case):
     spec, p, data = dns_case
     from yieldfactormodels_jl_tpu.estimation import optimize
 
-    optimize._jitted_loss(spec, data.shape[1])  # populate the lru cache
+    from yieldfactormodels_jl_tpu.estimation import bootstrap
+    from yieldfactormodels_jl_tpu.parallel import mesh  # registers its caches
+
+    optimize._jitted_loss(spec, data.shape[1])       # populate lru caches
+    bootstrap._jitted_grid_loss(spec, data.shape[1])
     assert optimize._jitted_loss.cache_info().currsize >= 1
+    assert bootstrap._jitted_grid_loss.cache_info().currsize >= 1
     try:
         yfm.set_kalman_engine("sqrt")
         assert optimize._jitted_loss.cache_info().currsize == 0
+        assert bootstrap._jitted_grid_loss.cache_info().currsize == 0
     finally:
         yfm.set_kalman_engine("univariate")
 

@@ -1,0 +1,36 @@
+"""Stage timer / trace wrappers (SURVEY.md §5.1 subsystem)."""
+
+import time
+
+from yieldfactormodels_jl_tpu.utils.profiling import StageTimer, annotate, device_trace
+
+
+def test_stage_timer_accumulates():
+    t = StageTimer()
+    for _ in range(3):
+        with t.stage("est"):
+            time.sleep(0.01)
+    assert t.counts["est"] == 3
+    assert t.totals["est"] >= 0.03
+    assert abs(t.mean("est") - t.totals["est"] / 3) < 1e-12
+    assert "est:" in t.report()
+    assert t.mean("never") == 0.0
+
+
+def test_device_trace_noop_and_annotation():
+    with device_trace(None):  # no logdir -> must be a pure no-op
+        x = 1
+    with annotate("region"):
+        x += 1
+    assert x == 2
+
+
+def test_device_trace_writes_profile(tmp_path):
+    import jax.numpy as jnp
+
+    logdir = str(tmp_path / "prof")
+    with device_trace(logdir):
+        jnp.ones((4, 4)).sum().block_until_ready()
+    import os
+
+    assert os.path.isdir(logdir) and os.listdir(logdir)

@@ -21,6 +21,19 @@ _DEFAULT_DTYPE = jnp.float32
 KALMAN_ENGINES = ("univariate", "sqrt", "joint", "assoc")
 _KALMAN_ENGINE = "univariate"
 
+# lru-cached builders of jitted losses register here (at import time) so an
+# engine switch can invalidate every cache that traced api.get_loss — no
+# hand-maintained list of distant private names
+_ENGINE_CACHES: list = []
+
+
+def register_engine_cache(fn):
+    """Register an ``lru_cache``-wrapped builder whose traces read the engine
+    choice; returns ``fn`` so it can be used as a decorator."""
+    if hasattr(fn, "cache_clear"):
+        _ENGINE_CACHES.append(fn)
+    return fn
+
 
 def default_dtype():
     return _DEFAULT_DTYPE
@@ -46,16 +59,5 @@ def set_kalman_engine(name: str) -> None:
     if name not in KALMAN_ENGINES:
         raise ValueError(f"unknown kalman engine {name!r}; pick from {KALMAN_ENGINES}")
     _KALMAN_ENGINE = name
-    try:  # drop stale traced executables (no-op if estimation never imported)
-        import sys
-
-        opt = sys.modules.get("yieldfactormodels_jl_tpu.estimation.optimize")
-        if opt is not None:
-            for fn_name in ("_jitted_loss", "_jitted_batch_loss",
-                            "_jitted_multistart_lbfgs", "_jitted_group_opt",
-                            "_jitted_window_multistart"):
-                fn = getattr(opt, fn_name, None)
-                if fn is not None and hasattr(fn, "cache_clear"):
-                    fn.cache_clear()
-    except Exception:
-        pass
+    for fn in _ENGINE_CACHES:  # drop stale traced executables
+        fn.cache_clear()

@@ -17,6 +17,7 @@ import numpy as np
 
 from ..models import api
 from ..models.specs import ModelSpec
+from ..config import register_engine_cache
 
 
 def moving_block_indices(key, T: int, block_len: int, n_resamples: int):
@@ -29,6 +30,7 @@ def moving_block_indices(key, T: int, block_len: int, n_resamples: int):
     return idx[:, :T]
 
 
+@register_engine_cache
 @lru_cache(maxsize=32)
 def _jitted_grid_loss(spec: ModelSpec, T: int):
     def one(lam_driver, idx, params, data):

@@ -34,6 +34,7 @@ import optax
 from ..models import api
 from ..models.params import transform_params, untransform_params, get_new_initial_params
 from ..models.specs import ModelSpec
+from ..config import register_engine_cache
 from .neldermead import nelder_mead
 
 
@@ -55,6 +56,7 @@ def _finite_objective(spec: ModelSpec, data, raw_params, start, end, penalty=1e1
     return jnp.where(jnp.isfinite(v), v, penalty)
 
 
+@register_engine_cache
 @lru_cache(maxsize=128)
 def _jitted_loss(spec: ModelSpec, T: int):
     """Loss jitted once per (spec, data length); start/end stay traced so every
@@ -62,6 +64,7 @@ def _jitted_loss(spec: ModelSpec, T: int):
     return jax.jit(lambda p, data, start, end: api.get_loss(spec, p, data, start, end))
 
 
+@register_engine_cache
 @lru_cache(maxsize=128)
 def _jitted_batch_loss(spec: ModelSpec, T: int):
     return jax.jit(
@@ -204,6 +207,7 @@ def try_initializations(spec: ModelSpec, best_params, data, max_tries: int = 0,
 # estimate: multi-start LBFGS (optimization.jl:329-410)
 # ---------------------------------------------------------------------------
 
+@register_engine_cache
 @lru_cache(maxsize=64)
 def _jitted_multistart_lbfgs(spec: ModelSpec, T: int, max_iters: int,
                              g_tol: float, f_abstol: float):
@@ -252,6 +256,7 @@ def estimate(spec: ModelSpec, data, all_params, start=0, end=None,
 # estimate_steps: block-coordinate descent (optimization.jl:137-295)
 # ---------------------------------------------------------------------------
 
+@register_engine_cache
 @lru_cache(maxsize=256)
 def _jitted_group_opt(spec: ModelSpec, T: int, inds: Tuple[int, ...],
                       kind: str, opts_items: tuple):
@@ -349,6 +354,7 @@ def estimate_steps(spec: ModelSpec, data, all_params, param_groups: Sequence[str
 # batched workloads: windows × starts in one device program
 # ---------------------------------------------------------------------------
 
+@register_engine_cache
 @lru_cache(maxsize=64)
 def _jitted_window_multistart(spec: ModelSpec, T: int, max_iters: int,
                               g_tol: float, f_abstol: float):

@@ -38,6 +38,7 @@ from .models.specs import ModelSpec
 from .parallel.multihost import sweep_stale_locks
 from .persistence import database as db
 from .persistence.locks import acquire_task_lock, release_task_lock
+from .utils.profiling import StageTimer
 
 
 def _forecast_db_base(spec: ModelSpec, window_type: str) -> str:
@@ -157,7 +158,7 @@ def run_forecast_window_database(
     if all_params.ndim == 1:
         all_params = all_params[:, None]
 
-    est_total, est_count = 0.0, 0
+    timer = StageTimer()
     for task_id in tasks:
         if os.path.isfile(db.forecast_path(base, task_id)):
             continue
@@ -168,11 +169,10 @@ def run_forecast_window_database(
             cur = db.read_static_params_from_db(spec, task_id, all_params,
                                                 window_type=window_type)
             if reestimate:
-                t0 = time.perf_counter()
-                loss, params = _estimate_for_window(
-                    spec, data, task_id, cur, param_groups, max_group_iters, group_tol)
-                est_total += time.perf_counter() - t0
-                est_count += 1
+                with timer.stage("estimation"):
+                    loss, params = _estimate_for_window(
+                        spec, data, task_id, cur, param_groups, max_group_iters,
+                        group_tol)
             else:
                 params = db.read_params_from_db(spec, task_id, cur,
                                                 window_type=window_type)[:, 0]
@@ -185,9 +185,9 @@ def run_forecast_window_database(
             db.save_oos_forecast_sharded(base, spec.model_string, thread_id,
                                          window_type, task_id, results, loss,
                                          params, forecast_horizon=forecast_horizon)
-            if printing and est_count:
-                print(f"Thread {thread_id}: {est_count} estimations, "
-                      f"avg {est_total / est_count:.2f}s/task")
+            if printing and timer.counts["estimation"]:
+                print(f"Thread {thread_id}: {timer.counts['estimation']} estimations, "
+                      f"avg {timer.mean('estimation'):.2f}s/task")
         finally:
             release_task_lock(lockdir)
 

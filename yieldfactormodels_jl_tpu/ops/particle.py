@@ -59,22 +59,38 @@ def _systematic_resample(key, weights, n):
     return jnp.searchsorted(cum, positions)
 
 
-def _kf_particle_step(Z, d, Phi, delta, Omega_state, beta, P, y, R_diag, obs):
-    """One measurement+propagate Kalman step with diagonal obs covariance."""
+def _kf_particle_step(Z, d, Phi, delta, Omega_state, beta, P, y, r, obs):
+    """Measurement+propagate Kalman step for ALL particles at once.
+
+    ``beta (Pn, Ms)``, ``P (Pn, Ms, Ms)``, ``r (Pn,)`` the per-particle scalar
+    observation variance σ²e^{h}.  Because Ω_obs = r·I is diagonal, the update
+    runs as N sequential *scalar* innovations (the same univariate
+    decomposition as ops/univariate_kf.py) — rank-1 FMAs over the particle
+    axis, no per-particle N×N Cholesky.  Algebraically identical posterior and
+    log-likelihood; a non-PD innovation variance yields −Inf for that particle
+    (which logsumexp then zero-weights) instead of the silently-garbled value
+    the factored form would produce."""
     N = Z.shape[0]
-    Ms = Phi.shape[0]
-    y_pred = Z @ beta + d
-    v = (y - y_pred) * obs
-    F = Z @ P @ Z.T + jnp.diag(R_diag)
-    cho = jnp.linalg.cholesky(F)
-    cho = jnp.where(jnp.all(jnp.isfinite(cho)), jnp.nan_to_num(cho), jnp.eye(N, dtype=F.dtype))
-    Fi_v = jax.scipy.linalg.cho_solve((cho, True), v)
-    Kt = jax.scipy.linalg.cho_solve((cho, True), Z @ P)
-    beta_next = delta + Phi @ (beta + Kt.T @ v * obs)
-    P_next = Phi @ ((jnp.eye(Ms, dtype=P.dtype) - Kt.T @ Z * obs) @ P) @ Phi.T + Omega_state
-    logdet = 2.0 * jnp.sum(jnp.log(jnp.diagonal(cho)))
-    loglik = -0.5 * (logdet + v @ Fi_v + N * _LOG_2PI)
-    return beta_next, P_next, loglik
+    ll = jnp.zeros(r.shape, dtype=P.dtype)
+    ok = jnp.ones(r.shape, dtype=bool)
+    b_u, P_u = beta, P
+    for i in range(N):  # N is static; unrolled rank-1 updates
+        z = Z[i]
+        zP = P_u @ z                                  # (Pn, Ms)
+        f = zP @ z + r                                # (Pn,)
+        ok = ok & (f > 0) & jnp.isfinite(f)
+        fsafe = jnp.where(f > 0, f, 1.0)
+        v = y[i] - d[i] - b_u @ z                     # (Pn,)
+        Kg = zP / fsafe[:, None]
+        b_u = b_u + Kg * v[:, None]
+        P_u = P_u - Kg[:, :, None] * zP[:, None, :]
+        ll = ll - 0.5 * (jnp.log(fsafe) + v * v / fsafe + _LOG_2PI)
+    P_u = 0.5 * (P_u + jnp.swapaxes(P_u, -1, -2))     # symmetry insurance
+    beta_m = beta + (b_u - beta) * obs
+    P_m = P + (P_u - P) * obs
+    beta_next = delta[None, :] + beta_m @ Phi.T
+    P_next = jnp.einsum("ij,pjk,lk->pil", Phi, P_m, Phi) + Omega_state[None]
+    return beta_next, P_next, jnp.where(ok, ll, -jnp.inf)
 
 
 def particle_filter_loglik(
@@ -103,8 +119,6 @@ def particle_filter_loglik(
     h0 = jnp.zeros((Pn,), dtype=params.dtype)
 
     T = data.shape[1]
-    step_kf = jax.vmap(_kf_particle_step, in_axes=(None, None, None, None, None, 0, 0, None, 0, None))
-
     log_uniform = -jnp.log(jnp.asarray(float(Pn), dtype=params.dtype))
 
     def body(st: PFState, inp):
@@ -113,9 +127,10 @@ def particle_filter_loglik(
         h_new = sv_phi * st.h + sv_sigma * jax.random.normal(k_prop, (Pn,), dtype=st.h.dtype)
         obs = jnp.all(jnp.isfinite(y))
         ysafe = jnp.where(jnp.isfinite(y), y, 0.0)
-        R_diag = kp.obs_var * jnp.exp(h_new)[:, None] * jnp.ones((Pn, Z.shape[0]), dtype=st.h.dtype)
-        beta, P, ll = step_kf(Z, d, kp.Phi, kp.delta, kp.Omega_state,
-                              st.beta, st.P, ysafe, R_diag, obs.astype(st.h.dtype))
+        r = kp.obs_var * jnp.exp(h_new)
+        beta, P, ll = _kf_particle_step(Z, d, kp.Phi, kp.delta, kp.Omega_state,
+                                        st.beta, st.P, ysafe, r,
+                                        obs.astype(st.h.dtype))
         contributes = obs & (t_idx > 0)  # reference skips t == 1 (1-based)
         # accumulate onto the carried normalized log-weights: the step's
         # likelihood contribution is log Σ_i W_{t-1,i} exp(ll_i)

@@ -27,6 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..estimation import optimize as opt
 from ..models import api
 from ..models.specs import ModelSpec
+from ..config import register_engine_cache
 
 
 def make_mesh(n_devices: Optional[int] = None, axis_name: str = "batch") -> Mesh:
@@ -48,6 +49,7 @@ def pad_to_multiple(arr, multiple: int, axis: int = 0):
     return np.pad(np.asarray(arr), pad_widths, mode="edge"), n
 
 
+@register_engine_cache
 @lru_cache(maxsize=64)
 def _sharded_batch_loss(spec: ModelSpec, T: int, mesh: Mesh, axis_name: str):
     batch_sharding = NamedSharding(mesh, P(axis_name, None))
@@ -79,6 +81,7 @@ def batch_loss_sharded(spec: ModelSpec, params_batch, data, mesh: Optional[Mesh]
     return out[:n]
 
 
+@register_engine_cache
 @lru_cache(maxsize=64)
 def _sharded_multistart(spec: ModelSpec, T: int, mesh: Mesh, axis_name: str,
                         max_iters: int, g_tol: float, f_abstol: float):

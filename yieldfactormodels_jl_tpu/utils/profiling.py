@@ -1,0 +1,64 @@
+"""Profiling / stage-timing utilities (SURVEY.md §5.1).
+
+The reference's only timing signal is ``@elapsed`` around per-window
+re-estimation with a printed running mean (forecasting.jl:144-149,188-192).
+Here that becomes a reusable stage timer plus an optional wrapper over
+``jax.profiler`` for real device traces (viewable in TensorBoard/Perfetto).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Dict, Iterator, Optional
+
+
+class StageTimer:
+    """Accumulates wall-clock per named stage; prints reference-style running
+    means.  Thread-compatible with the forecasting loop's usage pattern."""
+
+    def __init__(self):
+        self.totals: Dict[str, float] = defaultdict(float)
+        self.counts: Dict[str, int] = defaultdict(int)
+
+    @contextlib.contextmanager
+    def stage(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.totals[name] += time.perf_counter() - t0
+            self.counts[name] += 1
+
+    def mean(self, name: str) -> float:
+        c = self.counts[name]
+        return self.totals[name] / c if c else 0.0
+
+    def report(self) -> str:
+        lines = [f"{name}: {self.totals[name]:.3f}s total, "
+                 f"{self.mean(name):.3f}s avg over {self.counts[name]}"
+                 for name in sorted(self.totals)]
+        return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def device_trace(logdir: Optional[str]) -> Iterator[None]:
+    """``jax.profiler.trace`` scope when ``logdir`` is given, no-op otherwise —
+    so call sites can thread a flag through without branching."""
+    if not logdir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(logdir):
+        yield
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named region in a device trace (``jax.profiler.TraceAnnotation``)."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
