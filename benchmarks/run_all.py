@@ -228,9 +228,21 @@ def _orchestrate(configs):
         collect(["taskset", "-c", "0", sys.executable, me,
                  "--side", "cpu", "--configs", name], cpu_env, 6000, f"cpu:{name}")
 
+    out_path = os.path.join(HERE, "results.json")
+    # merge over any previously recorded entries so a timed-out/failed config
+    # doesn't erase its last successful measurement
+    previous = {}
+    if os.path.isfile(out_path):
+        try:
+            previous = {r["config"]: r for r in json.load(open(out_path))}
+        except (json.JSONDecodeError, KeyError, TypeError):
+            previous = {}
     merged = []
     for name, _scale in CONFIGS:
         if name not in results:
+            if name in previous:
+                merged.append(previous[name])
+                print(json.dumps(previous[name]))
             continue
         rec = {"config": name}
         dev = results[name].get("device")
@@ -247,7 +259,6 @@ def _orchestrate(configs):
                 cpu["wall_s"] * cpu["scale"] / dev["wall_s"], 2)
         merged.append(rec)
         print(json.dumps(rec))
-    out_path = os.path.join(HERE, "results.json")
     with open(out_path, "w") as f:
         json.dump(merged, f, indent=2)
     sys.stderr.write(f"# wrote {out_path}\n")

@@ -29,9 +29,14 @@ _ENGINE_CACHES: list = []
 
 def register_engine_cache(fn):
     """Register an ``lru_cache``-wrapped builder whose traces read the engine
-    choice; returns ``fn`` so it can be used as a decorator."""
-    if hasattr(fn, "cache_clear"):
-        _ENGINE_CACHES.append(fn)
+    choice; returns ``fn`` so it can be used as a decorator.  Must sit ABOVE
+    ``@lru_cache`` (i.e. receive the cached wrapper) — anything else is a
+    decorator-order mistake that would silently leave stale traces alive."""
+    if not hasattr(fn, "cache_clear"):
+        raise TypeError(
+            "register_engine_cache must wrap an lru_cache-decorated function; "
+            "put @register_engine_cache above @lru_cache")
+    _ENGINE_CACHES.append(fn)
     return fn
 
 

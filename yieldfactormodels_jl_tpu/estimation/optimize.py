@@ -375,7 +375,8 @@ def estimate_windows(spec: ModelSpec, data, raw_starts, window_starts, window_en
     docstring), so this replaces the reference's per-origin process farm
     (forecasting.jl:120-199) with a (W, S) batch on the device.
 
-    Returns (params (W, S, P) unconstrained, losses (W, S)).
+    Returns (params (W, S, P) unconstrained, logliks (W, S)) — higher is
+    better; pick per-window starts with argmax.
     """
     data = jnp.asarray(data, dtype=spec.dtype)
     runner = _jitted_window_multistart(spec, data.shape[1], max_iters, g_tol, f_abstol)

@@ -25,7 +25,6 @@ from __future__ import annotations
 
 import os
 import secrets
-import time
 from typing import Optional, Sequence
 
 import jax.numpy as jnp
