@@ -171,3 +171,43 @@ def test_read_static_params_from_db_roundtrip(tmp_path):
     # tail [ω, δ, Φ] overwritten with the static fit (paramteroperations.jl:124-128)
     np.testing.assert_allclose(out[2:, 0], static_params)
     np.testing.assert_allclose(out[:2, 0], 0.0)
+
+
+def test_crash_recovery_stale_lock(tmp_path):
+    """A SIGKILL'd worker leaves a stale lock dir that would permanently skip
+    its task (the reference's known weakness, SURVEY.md §5.3); the TTL sweep +
+    rerun must complete the backtest anyway."""
+    import time as _time
+
+    spec = _spec(tmp_path)
+    data = _panel(T=36)
+    init = np.zeros((spec.n_params, 1))
+    # simulate a worker killed mid-task 31: lock dir exists, no shard written
+    lockroot = os.path.join(spec.results_location, "db", "locks")
+    stale = os.path.join(lockroot, "expanding", "task_31.lock")
+    os.makedirs(stale)
+    old = _time.time() - 7200
+    os.utime(stale, (old, old))
+
+    # without a sweep the task is skipped -> no merged db
+    run_forecast_window_database(
+        spec, data, "1", 30, 1, 4, "expanding", init,
+        param_groups=[], reestimate=False, printing=False)
+    merged = os.path.join(str(tmp_path), "db", "forecasts_expanding_merged.sqlite3")
+    assert not os.path.isfile(merged)
+    base = os.path.join(str(tmp_path), "db", "forecasts_expanding.sqlite3")
+    assert db.forecast_path(base, 31).endswith("_31.sqlite3")
+    assert not os.path.isfile(db.forecast_path(base, 31))
+    assert os.path.isfile(db.forecast_path(base, 30))  # other tasks DID run
+
+    # rerun with the TTL sweep (crash recovery): completes and merges
+    run_forecast_window_database(
+        spec, data, "1", 30, 1, 4, "expanding", init,
+        param_groups=[], reestimate=False, printing=False,
+        stale_lock_ttl=3600.0)
+    assert os.path.isfile(merged)
+    conn = sqlite3.connect(merged)
+    tasks = [r[0] for r in conn.execute(
+        "SELECT task_id FROM forecasts ORDER BY task_id").fetchall()]
+    conn.close()
+    assert tasks == list(range(30, 37))

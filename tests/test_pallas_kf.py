@@ -18,8 +18,9 @@ MATS = tuple(np.array([3, 6, 9, 12, 24, 36, 48, 60, 84, 120, 180, 240, 360]) / 1
 
 def _params(spec, B, rng):
     p = np.zeros((B, spec.n_params), dtype=np.float32)
-    lo, hi = spec.layout["gamma"]
-    p[:, lo:hi] = np.log(0.4) + 0.2 * rng.standard_normal((B, hi - lo))
+    if "gamma" in spec.layout:  # TVλ has no γ slot (λ is the 4th state)
+        lo, hi = spec.layout["gamma"]
+        p[:, lo:hi] = np.log(0.4) + 0.2 * rng.standard_normal((B, hi - lo))
     lo, hi = spec.layout["obs_var"]
     p[:, lo:hi] = 0.01
     Ms = spec.state_dim
@@ -36,7 +37,7 @@ def _params(spec, B, rng):
     return p
 
 
-@pytest.mark.parametrize("code", ["1C", "AFNS3", "AFNS5"])
+@pytest.mark.parametrize("code", ["1C", "AFNS3", "AFNS5", "TVλ"])
 def test_matches_univariate(code, rng):
     spec, _ = create_model(code, MATS, float_type="float32")
     B, T = 6, 36
@@ -73,7 +74,7 @@ def test_invalid_params_give_neg_inf(rng):
 
 
 def test_unsupported_family_raises(rng):
-    spec, _ = create_model("TVλ", MATS, float_type="float32")
+    spec, _ = create_model("SD-NS", MATS, float_type="float32")
     with pytest.raises(ValueError):
         pallas_kf.batched_loglik(spec, np.zeros((2, spec.n_params)),
                                  np.zeros((len(MATS), 10)), interpret=True)

@@ -61,6 +61,16 @@ def init_state(spec: ModelSpec, kp: KalmanParams) -> KalmanState:
     return KalmanState(beta0, P0)
 
 
+def tvl_dz2_dlam(lam, ztau, maturities, exact: bool):
+    """dZ₂/dλ for the TVλ EKF Jacobian — the single source of truth shared by
+    this module and the Pallas kernel (ops/pallas_kf.py).  ``exact=False``
+    reproduces the reference's formula (kalman/filter.jl:43), whose second
+    term uses e^{-λτ} where the true derivative has (1 − e^{-λτ})."""
+    if exact:
+        return ztau / lam - (1.0 - ztau) / (lam * lam * maturities)
+    return ztau / lam - ztau / (lam * lam * maturities)
+
+
 def _tvl_measurement(spec: ModelSpec, beta, maturities):
     """Z (N×4) with the analytic EKF Jacobian in column 4, and ŷ = Z[:, :3]β[:3]
     (kalman/filter.jl:31-47, tvλdns.jl:53-64)."""
@@ -68,11 +78,7 @@ def _tvl_measurement(spec: ModelSpec, beta, maturities):
     z2, z3 = dns_slope_curvature(lam, maturities)
     z = jnp.exp(-lam * maturities)
     dlam_db4 = lam - LAMBDA_FLOOR
-    if spec.exact_jacobian:
-        dz2_dlam = z / lam - (1.0 - z) / (lam * lam * maturities)
-    else:
-        # reference formula (kalman/filter.jl:43)
-        dz2_dlam = z / lam - z / (lam * lam * maturities)
+    dz2_dlam = tvl_dz2_dlam(lam, z, maturities, spec.exact_jacobian)
     dz3_extra = maturities * z  # (kalman/filter.jl:44)
     jac = ((beta[1] + beta[2]) * dz2_dlam + beta[2] * dz3_extra) * dlam_db4
     ones = jnp.ones_like(z2)

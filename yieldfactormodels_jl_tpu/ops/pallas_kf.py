@@ -1,8 +1,10 @@
 """Pallas TPU kernel: fused batched univariate Kalman log-likelihood.
 
 This is the hand-scheduled version of ``ops/univariate_kf.get_loss`` for the
-linear-measurement Kalman families (``kalman_dns``, ``kalman_afns``) — the
-SURVEY.md §7 stretch goal ("Pallas kernel for the fused filter step").  The
+Kalman families — constant-measurement (``kalman_dns``, ``kalman_afns``) and
+the TVλ EKF, whose state-dependent loading row is recomputed lane-locally
+inside the kernel — the SURVEY.md §7 stretch goal ("Pallas kernel for the
+fused filter step").  The
 XLA path is already fast; what Pallas adds is *layout control*: the batch axis
 is laid out across the full (8 sublanes × 128 lanes) VPU tile, and every
 per-draw quantity (Z, Φ, δ, Ω, β, P) lives in VMEM as a stack of such tiles,
@@ -35,7 +37,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ..models.kalman import init_state, loglik_contrib_mask, measurement_setup
+from ..models.kalman import (init_state, loglik_contrib_mask,
+                             measurement_setup, tvl_dz2_dlam)
+from ..models.loadings import LAMBDA_FLOOR as _FLOOR, dns_slope_curvature
 from ..models.params import unpack_kalman
 from ..models.specs import ModelSpec
 
@@ -45,10 +49,17 @@ _SUB, _LANE = 8, 128
 TILE = _SUB * _LANE  # draws per grid program
 
 
-def _kernel(N: int, Ms: int, T: int,
+def _kernel(N: int, Ms: int, T: int, tvl: bool, exact_jac: bool, mats,
             Zr, dr, phir, deltar, omr, ovarr, b0r, p0r, datar, maskr, outr):
-    """One grid program = TILE draws.  Tile-stacked refs, scalar data/masks."""
-    f32 = Zr.dtype
+    """One grid program = TILE draws.  Tile-stacked refs, scalar data/masks.
+
+    ``tvl`` switches to the EKF for the TVλ family: the loading row z_i is
+    recomputed per step from the lane-local predicted state (λ = 1e-2 +
+    e^{β₄}, Jacobian column as kalman/filter.jl:38-46), and the fixed-
+    linearization effective observation y_eff = y + jac·β₄ replaces y
+    (ops/univariate_kf.py derivation).  ``mats`` are the static maturities.
+    """
+    f32 = phir.dtype
     ovar = ovarr[0]
 
     beta0 = tuple(b0r[m] for m in range(Ms))
@@ -61,6 +72,10 @@ def _kernel(N: int, Ms: int, T: int,
         obs_s = maskr[t, 0] > 0.5   # in-window scalar
         con_s = maskr[t, 1] > 0.5   # loglik-contributing scalar
 
+        if tvl:  # lane-local decay rate and Jacobian factor from β_pred
+            lam = _FLOOR + jnp.exp(beta[3])
+            dlam = lam - _FLOOR
+
         # ---- N sequential scalar measurement updates (rank-1, lane-local) --
         b = list(beta)
         Pm = list(P)
@@ -71,15 +86,28 @@ def _kernel(N: int, Ms: int, T: int,
             y_i = datar[t, i]
             fin_i = jnp.isfinite(y_i)
             finite_s = jnp.logical_and(finite_s, fin_i)
-            z = tuple(Zr[i * Ms + m] for m in range(Ms))
+            if tvl:
+                tau = mats[i]  # static python float
+                z2, z3 = dns_slope_curvature(lam, tau)
+                ztau = z2 - z3  # e^{-λτ} via the DNS identity Z₃ = Z₂ − e^{-λτ}
+                dz2 = tvl_dz2_dlam(lam, ztau, tau, exact_jac)
+                jac = ((beta[1] + beta[2]) * dz2 + beta[2] * tau * ztau) * dlam
+                z = (jnp.ones((_SUB, _LANE), dtype=f32), z2, z3, jac)
+                # y_eff = y − h(β_pred) + z·β_pred = y + jac·β₄_pred
+                y_eff = y_i + jac * beta[3]
+                d_i = jnp.zeros((), f32)
+            else:
+                z = tuple(Zr[i * Ms + m] for m in range(Ms))
+                y_eff = y_i
+                d_i = dr[i]
             zP = [sum(z[k] * Pm[k * Ms + m] for k in range(Ms)) for m in range(Ms)]
             f = sum(zP[m] * z[m] for m in range(Ms)) + ovar
             ok = ok & (f > 0) & jnp.isfinite(f)
             fsafe = jnp.where(f > 0, f, jnp.ones((), f32))
-            pred = sum(z[m] * b[m] for m in range(Ms)) + dr[i]
+            pred = sum(z[m] * b[m] for m in range(Ms)) + d_i
             # NaN y_i ⇒ whole column is treated missing (blended out below);
             # a zero innovation keeps the discarded arithmetic finite.
-            v = jnp.where(fin_i, y_i - pred, jnp.zeros((), f32))
+            v = jnp.where(fin_i, y_eff - pred, jnp.zeros_like(pred))
             K = [zP[m] / fsafe for m in range(Ms)]
             b = [b[m] + K[m] * v for m in range(Ms)]
             Pm = [Pm[k * Ms + m] - K[k] * zP[m]
@@ -129,16 +157,18 @@ def batched_loglik(spec: ModelSpec, params_batch, data, start=0, end=None,
                    interpret: bool | None = None):
     """Gaussian loglik for a batch of parameter draws — Pallas fused kernel.
 
-    Numerically equivalent to ``vmap(univariate_kf.get_loss)`` for the
-    constant-measurement Kalman families.  ``interpret`` defaults to True off
+    Numerically equivalent to ``vmap(univariate_kf.get_loss)`` for every
+    Kalman family (constant-measurement DNS/AFNS and the TVλ EKF, whose
+    loading row is recomputed in-kernel).  ``interpret`` defaults to True off
     TPU so tests run on CPU; on TPU the kernel compiles to Mosaic.
     """
-    if spec.family not in ("kalman_dns", "kalman_afns"):
-        raise ValueError(f"pallas kernel supports linear-measurement kalman "
-                         f"families, not {spec.family!r}")
+    if spec.family not in ("kalman_dns", "kalman_afns", "kalman_tvl"):
+        raise ValueError(f"pallas kernel supports the kalman families, "
+                         f"not {spec.family!r}")
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
 
+    tvl = spec.family == "kalman_tvl"
     f32 = jnp.float32
     params_batch = jnp.asarray(params_batch, dtype=f32)
     B = params_batch.shape[0]
@@ -149,9 +179,13 @@ def batched_loglik(spec: ModelSpec, params_batch, data, start=0, end=None,
         end = T
 
     kp = jax.vmap(partial(unpack_kalman, spec))(params_batch)
-    Z, d = jax.vmap(lambda k: measurement_setup(spec, k, f32))(kp)
-    if d is None:
-        d = jnp.zeros((B, N), dtype=f32)
+    if tvl:  # state-dependent measurement: Z/d are built inside the kernel
+        Z = jnp.zeros((B, 1), dtype=f32)
+        d = jnp.zeros((B, 1), dtype=f32)
+    else:
+        Z, d = jax.vmap(lambda k: measurement_setup(spec, k, f32))(kp)
+        if d is None:
+            d = jnp.zeros((B, N), dtype=f32)
     state0 = jax.vmap(partial(init_state, spec))(kp)
 
     t_idx = jnp.arange(T)
@@ -160,8 +194,8 @@ def batched_loglik(spec: ModelSpec, params_batch, data, start=0, end=None,
     masks = jnp.stack([observed, contrib], axis=1).astype(f32)
 
     args = [
-        _lay(Z.astype(f32), B, nb),                    # (N·Ms, nb·8, 128)
-        _lay(d.astype(f32), B, nb),                    # (N, ...)
+        _lay(Z.astype(f32), B, nb),                    # (N·Ms, nb·8, 128); (1, ...) TVλ dummy
+        _lay(d.astype(f32), B, nb),                    # (N, ...); (1, ...) TVλ dummy
         _lay(kp.Phi.astype(f32), B, nb),               # (Ms·Ms, ...)
         _lay(kp.delta.astype(f32), B, nb),             # (Ms, ...)
         _lay(kp.Omega_state.astype(f32), B, nb),       # (Ms·Ms, ...)
@@ -176,11 +210,14 @@ def batched_loglik(spec: ModelSpec, params_batch, data, start=0, end=None,
         return pl.BlockSpec((D, _SUB, _LANE), lambda g: (0, g, 0),
                             memory_space=pltpu.VMEM)
 
+    z_rows = 1 if tvl else N * Ms
+    d_rows = 1 if tvl else N
     smem = pl.BlockSpec(memory_space=pltpu.SMEM)
     out = pl.pallas_call(
-        partial(_kernel, N, Ms, T),
+        partial(_kernel, N, Ms, T, tvl, spec.exact_jacobian,
+                tuple(float(m) for m in spec.maturities)),
         grid=(nb,),
-        in_specs=[tile_spec(N * Ms), tile_spec(N), tile_spec(Ms * Ms),
+        in_specs=[tile_spec(z_rows), tile_spec(d_rows), tile_spec(Ms * Ms),
                   tile_spec(Ms), tile_spec(Ms * Ms), tile_spec(1),
                   tile_spec(Ms), tile_spec(Ms * Ms), smem, smem],
         out_specs=pl.BlockSpec((_SUB, _LANE), lambda g: (g, 0),
