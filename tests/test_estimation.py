@@ -114,3 +114,29 @@ def test_estimate_windows_batched(maturities, yields_panel):
     l_mask = float(SM.get_loss(spec, p0, jnp.asarray(yields_panel), start=10, end=70))
     l_trunc = float(SM.get_loss(spec, p0, jnp.asarray(yields_panel[:, 10:70])))
     np.testing.assert_allclose(l_mask, l_trunc, rtol=1e-9)
+
+
+def test_estimate_steps_raises_on_structurally_broken_objective(maturities):
+    """Overflow-scale data makes every loglik eval −Inf (v² overflows) ⇒ the
+    objective is the penalty everywhere; the reference rethrows errors on the
+    first group iteration (optimization.jl:244-250) — here that surfaces as a
+    RuntimeError, not a silent penalty 'optimum'."""
+    import pytest
+
+    spec, _ = create_model("1C", tuple(maturities), float_type="float64")
+    data = np.full((len(maturities), 30), 1e200)
+    starts = np.full((spec.n_params, 1), 0.5)
+    groups = ["1"] * spec.n_params
+    with pytest.raises(RuntimeError, match="structurally incompatible"):
+        opt.estimate_steps(spec, data, starts, groups, max_group_iters=1)
+
+
+def test_estimate_steps_reports_real_convergence(maturities, yields_panel):
+    spec, _ = create_model("NS", tuple(maturities), float_type="float64")
+    truth = _static_truth(spec)
+    groups = ["1"] * 4 + ["2"] * 9  # non-(δ,Φ) / (δ,Φ) split
+    _, ll, _, conv = opt.estimate_steps(
+        spec, yields_panel, truth[:, None], groups, max_group_iters=6)
+    assert isinstance(conv, opt.Convergence)
+    assert np.isfinite(ll)
+    assert 1 <= conv.iterations <= 6

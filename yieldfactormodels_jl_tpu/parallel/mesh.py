@@ -98,6 +98,7 @@ def _sharded_multistart(spec: ModelSpec, T: int, mesh: Mesh, axis_name: str,
         in_shardings=(batch_sharding, repl, repl, repl),
         out_shardings=(NamedSharding(mesh, P(axis_name, None)),
                        NamedSharding(mesh, P(axis_name)),
+                       NamedSharding(mesh, P(axis_name)),
                        NamedSharding(mesh, P(axis_name))),
     )
 
@@ -120,6 +121,6 @@ def multistart_sharded(spec: ModelSpec, raw_starts, data, mesh: Optional[Mesh] =
     padded, n = pad_to_multiple(np.asarray(raw_starts), n_dev, axis=0)
     fn = _sharded_multistart(spec, data.shape[1], mesh, axis_name,
                              max_iters, g_tol, f_abstol)
-    xs, fs, its = fn(jnp.asarray(padded, dtype=spec.dtype), data,
-                     jnp.asarray(start), jnp.asarray(end))
+    xs, fs, its, convs = fn(jnp.asarray(padded, dtype=spec.dtype), data,
+                            jnp.asarray(start), jnp.asarray(end))
     return xs[:n], -fs[:n]
