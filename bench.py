@@ -144,13 +144,15 @@ def main():
     dev_data = jnp.asarray(data, dtype=spec.dtype)
     dev_batch = jnp.asarray(batch, dtype=spec.dtype)
 
-    def timed(fn):
+    def timed(fn, arg=None):
         """fn: jitted batch function (B, n_params) -> (B,)."""
-        out = jax.block_until_ready(fn(dev_batch))  # compile + warm
+        if arg is None:
+            arg = dev_batch
+        out = jax.block_until_ready(fn(arg))  # compile + warm
         reps = 3
         t0 = time.perf_counter()
         for _ in range(reps):
-            out = fn(dev_batch)
+            out = fn(arg)
         jax.block_until_ready(out)
         return (time.perf_counter() - t0) / reps, out
 
@@ -173,6 +175,44 @@ def main():
             out_pallas, pallas_rate = None, f"failed ({type(e).__name__})"
     else:
         out_pallas, pallas_rate = None, "skipped (interpret)"
+    # ---- gradient engines: value+grad per eval (the MLE hot path) ----
+    # fused = differentiable Pallas kernel (ops/pallas_kf_grad); reference
+    # point = vmapped jax.value_and_grad through the univariate scan.
+    from yieldfactormodels_jl_tpu.estimation.optimize import fused_objectives
+    from yieldfactormodels_jl_tpu.models.params import untransform_params
+    from yieldfactormodels_jl_tpu.ops import univariate_kf
+
+    raw_batch = jax.jit(jax.vmap(lambda c: untransform_params(spec, c)))(dev_batch)
+    grad_ctx = ""
+    try:
+        if jax.devices()[0].platform != "tpu":
+            raise RuntimeError("fused grad kernel is interpret-mode off-TPU; skipping")
+        _, fused_vag = fused_objectives(spec, dev_data, 0, dev_data.shape[1])
+        t_fused_vg, (fv, fg) = timed(jax.jit(fused_vag), arg=raw_batch)
+
+        def vmap_vag(X):
+            def single(r):
+                from yieldfactormodels_jl_tpu.models.params import transform_params
+                v = -univariate_kf.get_loss(spec, transform_params(spec, r), dev_data)
+                return jnp.where(jnp.isfinite(v), v, 1e12)
+            return jax.vmap(jax.value_and_grad(single))(X)
+
+        t_vmap_vg, (vv, vg) = timed(jax.jit(vmap_vag), arg=raw_batch)
+        bg = np.isfinite(np.asarray(fv)) & (np.asarray(fv) < 1e12) & \
+            np.isfinite(np.asarray(vv)) & (np.asarray(vv) < 1e12)
+        # elementwise comparison is meaningless here: both f32 paths carry
+        # cancellation noise ~1e-4 of the ~1e7 gradient norms.  Agreement =
+        # per-lane direction (cosine) + norm ratio (what L-BFGS consumes).
+        fgb, vgb = np.asarray(fg)[bg], np.asarray(vg)[bg]
+        fn_, vn_ = np.linalg.norm(fgb, axis=1), np.linalg.norm(vgb, axis=1)
+        cos = np.sum(fgb * vgb, axis=1) / np.maximum(fn_ * vn_, 1e-12)
+        vg_agree = bool(bg.any()) and bool(
+            (cos.min() > 0.999) and np.all(np.abs(fn_ / np.maximum(vn_, 1e-12) - 1) < 0.05))
+        grad_ctx = (f"; grad evals/s: fused {BATCH / t_fused_vg:.2f} | "
+                    f"vmap-AD {BATCH / t_vmap_vg:.2f}; grads agree: {vg_agree}")
+    except Exception as e:  # never kill the bench line
+        grad_ctx = f"; grad bench failed ({type(e).__name__}: {e})"
+
     n_finite = int(np.isfinite(np.asarray(out)).sum())
     # the joint form runs its matmuls/Cholesky through bf16 MXU passes on TPU
     # f32, so cross-check with a loose tolerance on the finite intersection
@@ -206,7 +246,7 @@ def main():
           f"api/univariate {dev_evals_per_sec:.2f} | joint {BATCH / t_joint:.2f} "
           f"| pallas {pallas_rate} evals/s; kernels agree: joint={agree} "
           f"pallas={pallas_agree}; finite: {n_finite}/{BATCH}; "
-          f"cpu ll sample {ll_cpu:.2f}", file=sys.stderr)
+          f"cpu ll sample {ll_cpu:.2f}{grad_ctx}", file=sys.stderr)
 
 
 def _orchestrate():

@@ -98,3 +98,51 @@ def test_estimate_reports_real_convergence(yields_panel):
     _, _, _, conv1 = opt.estimate(spec, data, starts, max_iters=2,
                                   g_tol=1e-14, f_abstol=0.0, objective="vmap")
     assert conv1.iterations <= 2
+
+
+def test_fused_estimate_composition_interpret(yields_panel):
+    """Wiring smoke test for the fused MLE paths (estimate / estimate_windows
+    with objective='fused') in interpret mode: tiny shapes, few iterations —
+    asserts the composition runs, improves the objective, and returns sane
+    shapes.  (Kernel-level numerics: tests/test_pallas_grad.py; hardware
+    performance: bench.py.)"""
+    mats = tuple(np.array([3, 36, 120, 360]) / 12.0)
+    spec, _ = create_model("1C", mats, float_type="float32")
+    data = np.asarray(yields_panel[:4, :10], dtype=np.float32)
+
+    p = np.zeros(spec.n_params)
+    lo, hi = spec.layout["gamma"]; p[lo:hi] = 0.5
+    lo, hi = spec.layout["obs_var"]; p[lo:hi] = 0.01
+    Ms = spec.state_dim
+    k = spec.layout["chol"][0]
+    for j in range(Ms):
+        for i in range(j + 1):
+            p[k] = 0.1 if i == j else 0.01
+            k += 1
+    lo, hi = spec.layout["phi"]; p[lo:hi] = (0.9 * np.eye(Ms)).reshape(-1)
+    starts = np.stack([p, p * 1.02], axis=1)  # (P, S=2) constrained, stationary
+
+    init, ll, best, conv = opt.estimate(spec, data, starts, max_iters=2,
+                                        objective="fused")
+    assert np.isfinite(ll)
+    assert best.shape == (spec.n_params,)
+    assert isinstance(conv, opt.Convergence)
+
+    # fused rolling windows: (W=2 windows) x (S=2 starts) in one program
+    from yieldfactormodels_jl_tpu.models.params import untransform_params
+    raw = np.stack([np.asarray(untransform_params(spec, jnp.asarray(c)))
+                    for c in starts.T], axis=0)
+    xs, lls = opt.estimate_windows(
+        spec, data, np.nan_to_num(raw), np.array([0, 2]), np.array([10, 9]),
+        max_iters=2, objective="fused")
+    assert xs.shape == (2, 2, spec.n_params)
+    assert lls.shape == (2, 2)
+    assert np.all(np.isfinite(np.asarray(lls)))
+
+    # cross-check the fused window losses against the univariate loss at the
+    # returned parameters (same window masks, same algebra)
+    from yieldfactormodels_jl_tpu.ops import univariate_kf
+    from yieldfactormodels_jl_tpu.models.params import transform_params
+    p00 = transform_params(spec, jnp.asarray(np.asarray(xs)[1, 0]))
+    ref = float(univariate_kf.get_loss(spec, p00, jnp.asarray(data), 2, 9))
+    np.testing.assert_allclose(float(lls[1, 0]), ref, rtol=2e-3)

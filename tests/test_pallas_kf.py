@@ -78,3 +78,20 @@ def test_unsupported_family_raises(rng):
     with pytest.raises(ValueError):
         pallas_kf.batched_loglik(spec, np.zeros((2, spec.n_params)),
                                  np.zeros((len(MATS), 10)), interpret=True)
+
+
+def test_per_lane_windows_match_univariate(rng):
+    """Per-draw [start, end) windows (the fused rolling-window batch path)."""
+    spec, _ = create_model("1C", MATS, float_type="float32")
+    B, T = 4, 30
+    p = _params(spec, B, rng)
+    data = (0.5 * rng.standard_normal((len(MATS), T)) + 4).astype(np.float32)
+    starts = np.array([0, 3, 5, 0])
+    ends = np.array([30, 25, 28, 18])
+    ref = jnp.stack([univariate_kf.get_loss(spec, jnp.asarray(p[i]), data,
+                                            int(starts[i]), int(ends[i]))
+                     for i in range(B)])
+    got = pallas_kf.batched_loglik(spec, p, data, interpret=True,
+                                   starts=starts, ends=ends)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=5e-4, atol=1e-2)

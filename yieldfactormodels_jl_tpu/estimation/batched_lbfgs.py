@@ -49,7 +49,9 @@ def batched_lbfgs(value_and_grad: Callable[[jax.Array], Tuple[jax.Array, jax.Arr
                   max_backtracks: int = 25,
                   armijo_c1: float = 1e-4,
                   shrink: float = 0.5,
-                  invalid_above: float | None = None) -> BatchedLBFGSResult:
+                  invalid_above: float | None = None,
+                  value_fn: Callable[[jax.Array], jax.Array] | None = None
+                  ) -> BatchedLBFGSResult:
     """Minimize S objectives simultaneously; every eval is one batched call.
 
     ``value_and_grad``: (S, P) → ((S,), (S, P)), finite-valued (clamp ±Inf/NaN
@@ -57,6 +59,10 @@ def batched_lbfgs(value_and_grad: Callable[[jax.Array], Tuple[jax.Array, jax.Arr
     ``invalid_above``: objective values ≥ this are the non-finite-loss penalty
     plateau; rows sitting there are never reported ``converged`` (the clamp
     zeroes their gradients, which would otherwise look like an optimum).
+    ``value_fn``: optional value-only objective for the Armijo probes — the
+    backtracking loop needs no gradients, so with a fused-kernel objective the
+    probes run the forward-only kernel (no checkpoint writes, no adjoint) and
+    only the accepted point pays for a gradient.
     """
     S, P = x0.shape
     dtype = x0.dtype
@@ -109,35 +115,37 @@ def batched_lbfgs(value_and_grad: Callable[[jax.Array], Tuple[jax.Array, jax.Arr
     def valid_row(f):
         return jnp.isfinite(f) & (f < invalid_above)
 
+    probe_value = value_fn if value_fn is not None else (
+        lambda X: value_and_grad(X)[0])
+
     def linesearch(x, f, g, d, skip):
         """Per-start Armijo backtracking; each probe is ONE batched eval.
         ``skip`` rows are treated as pre-accepted so frozen starts cannot
-        force the full backtracking budget on every outer iteration."""
+        force the full backtracking budget on every outer iteration.  Probes
+        are value-only; one gradient eval happens at the accepted points."""
         slope = dot(g, d)  # (S,) should be negative
         alpha = jnp.ones((S,), dtype=dtype)
         accepted = skip
-        # carry the best probe so far for rows that never accept
-        x_new, f_new, g_new = x, f, g
+        x_new = x
 
         def body(carry):
-            alpha, accepted, x_new, f_new, g_new, k = carry
+            alpha, accepted, x_new, k = carry
             probe = x + alpha[:, None] * d
-            fp, gp = value_and_grad(probe)
+            fp = probe_value(probe)
             ok = fp <= f + armijo_c1 * alpha * slope
             take = ok & ~accepted
             x_new = jnp.where(take[:, None], probe, x_new)
-            f_new = jnp.where(take, fp, f_new)
-            g_new = jnp.where(take[:, None], gp, g_new)
             accepted = accepted | ok
             alpha = jnp.where(accepted, alpha, alpha * shrink)
-            return alpha, accepted, x_new, f_new, g_new, k + 1
+            return alpha, accepted, x_new, k + 1
 
         def cond(carry):
-            _, accepted, *_, k = carry
+            _, accepted, _, k = carry
             return (~jnp.all(accepted)) & (k < max_backtracks)
 
-        alpha, accepted, x_new, f_new, g_new, _ = jax.lax.while_loop(
-            cond, body, (alpha, accepted, x_new, f_new, g_new, 0))
+        alpha, accepted, x_new, _ = jax.lax.while_loop(
+            cond, body, (alpha, accepted, x_new, 0))
+        f_new, g_new = value_and_grad(x_new)
         return x_new, f_new, g_new, accepted
 
     class Carry(NamedTuple):

@@ -62,6 +62,12 @@ def compute_loss(spec: ModelSpec, data, raw_params, start=0, end=None):
     return -api.get_loss(spec, constrained, data, start, end)
 
 
+#: objective values at/above this sit on the non-finite-loss penalty plateau.
+#: Strictly below the 1e12 penalty because float32 rounds 1e12 down to
+#: 999_999_995_904 — comparing against 1e12 exactly would never fire in f32.
+_PENALTY_THRESH = 0.999e12
+
+
 def _finite_objective(spec: ModelSpec, data, raw_params, start, end, penalty=1e12):
     """Objective with ±Inf/NaN clamped to a large finite penalty so line
     searches and Adam keep moving (the reference's Optim handles Inf natively;
@@ -227,31 +233,46 @@ def try_initializations(spec: ModelSpec, best_params, data, max_tries: int = 0,
 _FUSED_FAMILIES = ("kalman_dns", "kalman_afns")
 
 
-def fused_value_and_grad(spec: ModelSpec, data, start, end, penalty=1e12):
-    """Batched MLE objective X (S, P)-raw → (f (S,), g (S, P)) through the
-    differentiable Pallas kernel (ops/pallas_kf_grad): ONE fused kernel launch
-    evaluates all S objectives, one adjoint launch all S gradients.  This is
-    the gradient engine for ``estimate(..., objective="fused")``; it replaces
-    the reference's per-eval ForwardDiff filter replay (optimization.jl:
-    329-410) with a single on-chip program over the whole start batch."""
+def fused_objectives(spec: ModelSpec, data, start, end, penalty=1e12,
+                     win_starts=None, win_ends=None):
+    """Batched MLE objectives through the fused Pallas kernels: returns
+    (value_fn, value_and_grad) with X (B, P)-raw → f (B,) / (f, g (B, P)).
+
+    ONE forward kernel launch evaluates all B objectives (used for every
+    Armijo probe), one forward+adjoint launch pair produces all B gradients
+    (used once per accepted L-BFGS point).  This replaces the reference's
+    per-eval ForwardDiff filter replay (optimization.jl:329-410) with on-chip
+    programs over the whole batch.  ``win_starts``/``win_ends``: optional
+    per-row estimation windows — a rolling-window × start batch shares one
+    program (see ops/pallas_kf_grad)."""
+    from ..ops.pallas_kf import batched_loglik
     from ..ops.pallas_kf_grad import batched_loglik_diff
+
+    def clamp(v):
+        return jnp.where(jnp.isfinite(v), v, penalty)
+
+    def value_fn(X):
+        cb = jax.vmap(lambda r: transform_params(spec, r))(X)
+        return clamp(-batched_loglik(spec, cb, data, start, end,
+                                     starts=win_starts, ends=win_ends))
 
     def f(X):
         cb = jax.vmap(lambda r: transform_params(spec, r))(X)
-        v = -batched_loglik_diff(spec, cb, data, start, end)
-        return jnp.where(jnp.isfinite(v), v, penalty)
+        return clamp(-batched_loglik_diff(spec, cb, data, start, end,
+                                          starts=win_starts, ends=win_ends))
 
     def vag(X):
         vals, pullback = jax.vjp(f, X)
         (grads,) = pullback(jnp.ones_like(vals))
         return vals, jnp.where(jnp.isfinite(grads), grads, 0.0)
 
-    return vag
+    return value_fn, vag
 
 
 def vmapped_value_and_grad(spec: ModelSpec, data, start, end, penalty=1e12):
     """Fallback batched objective: vmapped value_and_grad of the lax.scan
-    loss — same signature as :func:`fused_value_and_grad`."""
+    loss — same signature as the value_and_grad half of
+    :func:`fused_objectives`."""
     def single(p):
         return _finite_objective(spec, data, p, start, end, penalty)
 
@@ -280,9 +301,9 @@ def _resolve_objective(spec: ModelSpec, objective: str) -> str:
 def _jitted_fused_multistart(spec: ModelSpec, T: int, max_iters: int,
                              g_tol: float, f_abstol: float):
     def run(X0, data, start, end):
-        vag = fused_value_and_grad(spec, data, start, end)
+        value_fn, vag = fused_objectives(spec, data, start, end)
         res = batched_lbfgs(vag, X0, max_iters, g_tol=g_tol, f_abstol=f_abstol,
-                            invalid_above=1e12)
+                            invalid_above=_PENALTY_THRESH, value_fn=value_fn)
         return res.x, res.f, res.iters, res.converged
 
     return jax.jit(run)
@@ -339,9 +360,10 @@ def estimate(spec: ModelSpec, data, all_params, start=0, end=None,
         print(f"✓ Best LL = {lls[j]} from starting point {j + 1}/{len(lls)}")
     best = transform_params(spec, jnp.asarray(np.asarray(xs)[j], dtype=spec.dtype))
     init = transform_params(spec, jnp.asarray(raw[j], dtype=spec.dtype))
-    # a start parked on the 1e12 penalty plateau has zero clamped gradients —
-    # that is an invalid run, not a converged one
-    valid_j = np.isfinite(lls[j]) and fs[j] < 1e12
+    # a start parked on the penalty plateau has zero clamped gradients — that
+    # is an invalid run, not a converged one (threshold below the f32-rounded
+    # penalty: float32(1e12) ≈ 0.99999999e12)
+    valid_j = np.isfinite(lls[j]) and fs[j] < _PENALTY_THRESH
     conv = Convergence(bool(np.asarray(convs)[j]) and valid_j,
                        int(np.asarray(its)[j]))
     return np.asarray(init), float(lls[j]), np.asarray(best), conv
@@ -416,9 +438,6 @@ def estimate_steps(spec: ModelSpec, data, all_params, param_groups: Sequence[str
         raw[:, 0] *= 0.95
         ll0 = float(loss_at(jnp.asarray(raw[:, 0], dtype=spec.dtype)))
 
-    # objective values ≥ the penalty mean "no finite likelihood was seen"
-    _PENALTY = 1e12
-
     results = []
     for j in range(n_starts):
         p = jnp.asarray(raw[:, j], dtype=spec.dtype)
@@ -437,7 +456,7 @@ def estimate_steps(spec: ModelSpec, data, all_params, param_groups: Sequence[str
                     continue
                 runner = _jitted_group_opt(spec, T, inds, kind, tuple(sorted(opts.items())))
                 p, f_g, _, _ = runner(p, data, jnp.asarray(start), jnp.asarray(end))
-                obj_broken = float(f_g) >= _PENALTY  # clamped ⇒ never saw finite
+                obj_broken = float(f_g) >= _PENALTY_THRESH  # clamped ⇒ never saw finite
                 if first_group_of_run:
                     first_group_of_run = False
                     if obj_broken and j == 0 and not np.isfinite(ll0):
@@ -495,19 +514,52 @@ def _jitted_window_multistart(spec: ModelSpec, T: int, max_iters: int,
     return jax.jit(over_windows)
 
 
+@register_engine_cache
+@lru_cache(maxsize=64)
+def _jitted_fused_windows(spec: ModelSpec, T: int, max_iters: int,
+                          g_tol: float, f_abstol: float):
+    def run(X0, data, win_starts, win_ends):
+        value_fn, vag = fused_objectives(spec, data, 0, T,
+                                         win_starts=win_starts,
+                                         win_ends=win_ends)
+        res = batched_lbfgs(vag, X0, max_iters, g_tol=g_tol, f_abstol=f_abstol,
+                            invalid_above=_PENALTY_THRESH, value_fn=value_fn)
+        return res.x, res.f, res.iters, res.converged
+
+    return jax.jit(run)
+
+
 def estimate_windows(spec: ModelSpec, data, raw_starts, window_starts, window_ends,
-                     max_iters: int = 1000, g_tol: float = 1e-6, f_abstol: float = 1e-6):
+                     max_iters: int = 1000, g_tol: float = 1e-6, f_abstol: float = 1e-6,
+                     objective: str = "auto"):
     """Re-estimate over W rolling windows × S starts in ONE jitted program.
 
     Masked windows are exactly equivalent to truncation (see models/kalman.py
     docstring), so this replaces the reference's per-origin process farm
-    (forecasting.jl:120-199) with a (W, S) batch on the device.
+    (forecasting.jl:120-199) with a (W, S) batch on the device.  With
+    ``objective="fused"`` (auto on TPU for constant-measurement Kalman
+    families) the whole (W·S) batch runs one natively-batched L-BFGS whose
+    every eval is a single per-lane-windowed Pallas kernel launch.
 
     Returns (params (W, S, P) unconstrained, logliks (W, S)) — higher is
     better; pick per-window starts with argmax.
     """
     data = jnp.asarray(data, dtype=spec.dtype)
-    runner = _jitted_window_multistart(spec, data.shape[1], max_iters, g_tol, f_abstol)
+    T = data.shape[1]
+    kind = _resolve_objective(spec, objective)
+    if kind == "fused":
+        raw_starts = jnp.asarray(raw_starts, dtype=spec.dtype)
+        S, Pn = raw_starts.shape
+        ws = jnp.asarray(window_starts)
+        we = jnp.asarray(window_ends)
+        W = ws.shape[0]
+        X0 = jnp.tile(raw_starts[None], (W, 1, 1)).reshape(W * S, Pn)
+        starts_vec = jnp.repeat(ws, S)
+        ends_vec = jnp.repeat(we, S)
+        runner = _jitted_fused_windows(spec, T, max_iters, g_tol, f_abstol)
+        xs, fs, its, convs = runner(X0, data, starts_vec, ends_vec)
+        return xs.reshape(W, S, Pn), -fs.reshape(W, S)
+    runner = _jitted_window_multistart(spec, T, max_iters, g_tol, f_abstol)
     xs, fs, its, convs = runner(
         jnp.asarray(raw_starts, dtype=spec.dtype),
         data,

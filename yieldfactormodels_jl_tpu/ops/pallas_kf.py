@@ -49,8 +49,31 @@ _SUB, _LANE = 8, 128
 TILE = _SUB * _LANE  # draws per grid program
 
 
-def _kernel(N: int, Ms: int, T: int, tvl: bool, exact_jac: bool, mats,
-            Zr, dr, phir, deltar, omr, ovarr, b0r, p0r, datar, maskr, outr):
+def window_masks(windowed, f32, maskr, winr, t):
+    """Per-step (in-window, loglik-contributing) masks — the single source of
+    truth shared by the value kernel and the adjoint kernels (pallas_kf_grad):
+    scalar SMEM rows for a shared window, or per-lane tiles computed from the
+    loop index when each draw carries its own [start, end).  The contributing
+    convention start+1 .. end−2 mirrors models.kalman.loglik_contrib_mask."""
+    if windowed:
+        ts = jnp.asarray(t, dtype=f32)
+        w_lo, w_hi = winr[0], winr[1]
+        return (ts >= w_lo) & (ts < w_hi), (ts >= w_lo + 1) & (ts <= w_hi - 2)
+    return maskr[t, 0] > 0.5, maskr[t, 1] > 0.5
+
+
+def window_array(starts, ends, B, f32):
+    """(B, 2) per-draw [start, end) tile input; zeros when not windowed."""
+    if starts is None:
+        return jnp.zeros((B, 2), dtype=f32)
+    return jnp.stack([jnp.asarray(starts, dtype=f32).reshape(B),
+                      jnp.asarray(ends, dtype=f32).reshape(B)], axis=1)
+
+
+def _kernel(N: int, Ms: int, T: int, tvl: bool, exact_jac: bool,
+            windowed: bool, mats,
+            Zr, dr, phir, deltar, omr, ovarr, b0r, p0r, datar, maskr, winr,
+            outr):
     """One grid program = TILE draws.  Tile-stacked refs, scalar data/masks.
 
     ``tvl`` switches to the EKF for the TVλ family: the loading row z_i is
@@ -58,6 +81,12 @@ def _kernel(N: int, Ms: int, T: int, tvl: bool, exact_jac: bool, mats,
     e^{β₄}, Jacobian column as kalman/filter.jl:38-46), and the fixed-
     linearization effective observation y_eff = y + jac·β₄ replaces y
     (ops/univariate_kf.py derivation).  ``mats`` are the static maturities.
+
+    ``windowed``: per-LANE estimation windows — ``winr`` holds (start, end)
+    tiles and the in-window/contributing masks are computed per draw from the
+    loop index, so a whole batch of rolling-window origins (each its own
+    [start, end)) runs as one fused program.  Otherwise the shared scalar
+    masks in SMEM apply to every lane.
     """
     f32 = phir.dtype
     ovar = ovarr[0]
@@ -69,8 +98,7 @@ def _kernel(N: int, Ms: int, T: int, tvl: bool, exact_jac: bool, mats,
     def step(t, carry):
         beta, P, ll = carry
 
-        obs_s = maskr[t, 0] > 0.5   # in-window scalar
-        con_s = maskr[t, 1] > 0.5   # loglik-contributing scalar
+        obs_s, con_s = window_masks(windowed, f32, maskr, winr, t)
 
         if tvl:  # lane-local decay rate and Jacobian factor from β_pred
             lam = _FLOOR + jnp.exp(beta[3])
@@ -154,13 +182,19 @@ def _lay(x, B, nb):
 
 
 def batched_loglik(spec: ModelSpec, params_batch, data, start=0, end=None,
-                   interpret: bool | None = None):
+                   interpret: bool | None = None, starts=None, ends=None):
     """Gaussian loglik for a batch of parameter draws — Pallas fused kernel.
 
     Numerically equivalent to ``vmap(univariate_kf.get_loss)`` for every
     Kalman family (constant-measurement DNS/AFNS and the TVλ EKF, whose
     loading row is recomputed in-kernel).  ``interpret`` defaults to True off
     TPU so tests run on CPU; on TPU the kernel compiles to Mosaic.
+
+    ``starts``/``ends``: optional (B,) per-draw estimation windows — each draw
+    gets its own [start, end) mask computed in-kernel, so a whole batch of
+    rolling-window origins runs as one fused program (the reference's
+    per-origin process farm, forecasting.jl:120-199, collapsed into one
+    launch).  When given, the scalar ``start``/``end`` are ignored.
     """
     if spec.family not in ("kalman_dns", "kalman_afns", "kalman_tvl"):
         raise ValueError(f"pallas kernel supports the kalman families, "
@@ -177,6 +211,7 @@ def batched_loglik(spec: ModelSpec, params_batch, data, start=0, end=None,
     T = data.shape[1]
     if end is None:
         end = T
+    windowed = starts is not None
 
     kp = jax.vmap(partial(unpack_kalman, spec))(params_batch)
     if tvl:  # state-dependent measurement: Z/d are built inside the kernel
@@ -192,6 +227,7 @@ def batched_loglik(spec: ModelSpec, params_batch, data, start=0, end=None,
     observed = (t_idx >= start) & (t_idx < end)
     contrib = loglik_contrib_mask(start, end, T)
     masks = jnp.stack([observed, contrib], axis=1).astype(f32)
+    win = window_array(starts, ends, B, f32)
 
     args = [
         _lay(Z.astype(f32), B, nb),                    # (N·Ms, nb·8, 128); (1, ...) TVλ dummy
@@ -204,6 +240,7 @@ def batched_loglik(spec: ModelSpec, params_batch, data, start=0, end=None,
         _lay(state0.P.astype(f32), B, nb),             # (Ms·Ms, ...)
         jnp.asarray(data, dtype=f32).T,                # (T, N) shared
         masks,                                         # (T, 2) shared
+        _lay(win, B, nb),                              # (2, ...) per-lane window
     ]
 
     def tile_spec(D):
@@ -214,12 +251,13 @@ def batched_loglik(spec: ModelSpec, params_batch, data, start=0, end=None,
     d_rows = 1 if tvl else N
     smem = pl.BlockSpec(memory_space=pltpu.SMEM)
     out = pl.pallas_call(
-        partial(_kernel, N, Ms, T, tvl, spec.exact_jacobian,
+        partial(_kernel, N, Ms, T, tvl, spec.exact_jacobian, windowed,
                 tuple(float(m) for m in spec.maturities)),
         grid=(nb,),
         in_specs=[tile_spec(z_rows), tile_spec(d_rows), tile_spec(Ms * Ms),
                   tile_spec(Ms), tile_spec(Ms * Ms), tile_spec(1),
-                  tile_spec(Ms), tile_spec(Ms * Ms), smem, smem],
+                  tile_spec(Ms), tile_spec(Ms * Ms), smem, smem,
+                  tile_spec(2)],
         out_specs=pl.BlockSpec((_SUB, _LANE), lambda g: (g, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((nb * _SUB, _LANE), f32),

@@ -51,7 +51,8 @@ from jax.experimental.pallas import tpu as pltpu
 from ..models.kalman import init_state, loglik_contrib_mask, measurement_setup
 from ..models.params import unpack_kalman
 from ..models.specs import ModelSpec
-from .pallas_kf import _LANE, _SUB, TILE, _lay
+from .pallas_kf import (_LANE, _SUB, TILE, _lay, window_array,
+                        window_masks)
 
 _LOG_2PI = math.log(2.0 * math.pi)
 
@@ -123,9 +124,9 @@ def _full_step(N, Ms, Z, d, phi, delta, om, ovar, y_scal, obs_s, beta, P):
 # forward kernel: value + segment checkpoints
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(N, Ms, T, S, nC,
+def _fwd_kernel(N, Ms, T, S, nC, windowed,
                 Zr, dr, phir, deltar, omr, ovarr, b0r, p0r, datar, maskr,
-                outr, chkr):
+                winr, outr, chkr):
     f32 = phir.dtype
     D = Ms + Ms * Ms
     ovar = ovarr[0]
@@ -150,8 +151,7 @@ def _fwd_kernel(N, Ms, T, S, nC,
             c = t // S
             chkr[pl.ds(c * D, D)] = jnp.stack(list(beta) + list(P))
 
-        obs_s = maskr[t, 0] > 0.5
-        con_s = maskr[t, 1] > 0.5
+        obs_s, con_s = window_masks(windowed, f32, maskr, winr, t)
         y_scal = [datar[t, i] for i in range(N)]
         b_u, _, P_u, ll_step, fin_all, cache = _inner_chain(
             N, Ms, Z, d, ovar, y_scal, list(beta), list(P))
@@ -178,8 +178,8 @@ def _fwd_kernel(N, Ms, T, S, nC,
 # backward kernel: segment recompute + per-step adjoints
 # ---------------------------------------------------------------------------
 
-def _bwd_kernel(N, Ms, T, S, nC,
-                Zr, dr, phir, deltar, omr, ovarr, datar, maskr, chkr, gr,
+def _bwd_kernel(N, Ms, T, S, nC, windowed,
+                Zr, dr, phir, deltar, omr, ovarr, datar, maskr, winr, chkr, gr,
                 gZr, gdr, gphir, gdeltar, gomr, govarr, gb0r, gp0r, segr):
     f32 = phir.dtype
     D = Ms + Ms * Ms
@@ -200,8 +200,7 @@ def _bwd_kernel(N, Ms, T, S, nC,
     def step_adjoint(t, beta, P, bbar_n, Pbar_n, acc):
         """Adjoint of one step given its incoming primal state (β, P)."""
         (gZ, gd, gphi, gdelta, gom, govar) = acc
-        obs_s = maskr[t, 0] > 0.5
-        con_s = maskr[t, 1] > 0.5
+        obs_s, con_s = window_masks(windowed, f32, maskr, winr, t)
         y_scal = [datar[t, i] for i in range(N)]
         b_u, P_u_unsym, P_u_sym, _, fin_all, cache = _inner_chain(
             N, Ms, Z, d, ovar, y_scal, list(beta), list(P))
@@ -308,7 +307,8 @@ def _bwd_kernel(N, Ms, T, S, nC,
             valid = t < T
             segr[pl.ds(s * D, D)] = jnp.stack(list(beta) + list(P))
             y_scal = [datar[jnp.minimum(t, T - 1), i] for i in range(N)]
-            obs_s = maskr[jnp.minimum(t, T - 1), 0] > 0.5
+            obs_s, _ = window_masks(windowed, f32, maskr, winr,
+                                     jnp.minimum(t, T - 1))
             (b_next, P_next), _ = _full_step(N, Ms, Z, d, phi, delta, om,
                                              ovar, y_scal, obs_s, beta, P)
             beta = tuple(jnp.where(valid, b_next[m], beta[m]) for m in range(Ms))
@@ -365,15 +365,16 @@ def _unlay(flat, B, shape):
     return flat.reshape(D, -1).T[:B].reshape((B,) + shape)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(0, 1))
-def _core(spec, interpret, Z, d, Phi, delta, Om, ovar, beta0, P0, data, masks):
-    out, _ = _core_fwd(spec, interpret, Z, d, Phi, delta, Om, ovar, beta0,
-                       P0, data, masks)
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _core(spec, interpret, windowed, Z, d, Phi, delta, Om, ovar, beta0, P0,
+          data, masks, win):
+    out, _ = _core_fwd(spec, interpret, windowed, Z, d, Phi, delta, Om, ovar,
+                       beta0, P0, data, masks, win)
     return out
 
 
-def _call_fwd(spec, interpret, Z, d, Phi, delta, Om, ovar, beta0, P0,
-              data, masks):
+def _call_fwd(spec, interpret, windowed, Z, d, Phi, delta, Om, ovar, beta0, P0,
+              data, masks, win):
     f32 = Phi.dtype  # compute dtype (f32 on TPU; f64 allowed in interpret mode)
     B = Z.shape[0]
     nb = -(-B // TILE)
@@ -386,7 +387,8 @@ def _call_fwd(spec, interpret, Z, d, Phi, delta, Om, ovar, beta0, P0,
             _lay(Phi.astype(f32), B, nb), _lay(delta.astype(f32), B, nb),
             _lay(Om.astype(f32), B, nb), _lay(ovar.astype(f32), B, nb),
             _lay(beta0.astype(f32), B, nb), _lay(P0.astype(f32), B, nb),
-            jnp.asarray(data, dtype=f32).T, masks.astype(f32)]
+            jnp.asarray(data, dtype=f32).T, masks.astype(f32),
+            _lay(win.astype(f32), B, nb)]
 
     def tile_spec(Drows):
         return pl.BlockSpec((Drows, _SUB, _LANE), lambda gidx: (0, gidx, 0),
@@ -394,11 +396,12 @@ def _call_fwd(spec, interpret, Z, d, Phi, delta, Om, ovar, beta0, P0,
 
     smem = pl.BlockSpec(memory_space=pltpu.SMEM)
     out, chk = pl.pallas_call(
-        partial(_fwd_kernel, N, Ms, T, S, nC),
+        partial(_fwd_kernel, N, Ms, T, S, nC, windowed),
         grid=(nb,),
         in_specs=[tile_spec(N * Ms), tile_spec(N), tile_spec(Ms * Ms),
                   tile_spec(Ms), tile_spec(Ms * Ms), tile_spec(1),
-                  tile_spec(Ms), tile_spec(Ms * Ms), smem, smem],
+                  tile_spec(Ms), tile_spec(Ms * Ms), smem, smem,
+                  tile_spec(2)],
         out_specs=(pl.BlockSpec((_SUB, _LANE), lambda gidx: (gidx, 0),
                                 memory_space=pltpu.VMEM),
                    tile_spec(nC * D)),
@@ -411,16 +414,17 @@ def _call_fwd(spec, interpret, Z, d, Phi, delta, Om, ovar, beta0, P0,
     return out.reshape(-1)[:B], (args, chk, B, nb)
 
 
-def _core_fwd(spec, interpret, Z, d, Phi, delta, Om, ovar, beta0, P0,
-              data, masks):
-    ll, (args, chk, B, nb) = _call_fwd(spec, interpret, Z, d, Phi, delta, Om,
-                                       ovar, beta0, P0, data, masks)
+def _core_fwd(spec, interpret, windowed, Z, d, Phi, delta, Om, ovar, beta0, P0,
+              data, masks, win):
+    ll, (args, chk, B, nb) = _call_fwd(spec, interpret, windowed, Z, d, Phi,
+                                       delta, Om, ovar, beta0, P0, data,
+                                       masks, win)
     shapes = (Z.shape, d.shape, Phi.shape, delta.shape, Om.shape, ovar.shape,
-              beta0.shape, P0.shape, data.shape, masks.shape)
+              beta0.shape, P0.shape, data.shape, masks.shape, win.shape)
     return ll, (args, chk, B, nb, ll, shapes)
 
 
-def _core_bwd(spec, interpret, res, g):
+def _core_bwd(spec, interpret, windowed, res, g):
     args, chk, B, nb, ll, shapes = res
     f32 = args[2].dtype
     N, Ms = spec.N, spec.state_dim
@@ -441,11 +445,11 @@ def _core_bwd(spec, interpret, res, g):
     out_tile = tile_spec
     smem = pl.BlockSpec(memory_space=pltpu.SMEM)
     grads = pl.pallas_call(
-        partial(_bwd_kernel, N, Ms, T, S, nC),
+        partial(_bwd_kernel, N, Ms, T, S, nC, windowed),
         grid=(nb,),
         in_specs=[tile_spec(N * Ms), tile_spec(N), tile_spec(Ms * Ms),
                   tile_spec(Ms), tile_spec(Ms * Ms), tile_spec(1),
-                  smem, smem, tile_spec(nC * D),
+                  smem, smem, tile_spec(2), tile_spec(nC * D),
                   pl.BlockSpec((_SUB, _LANE), lambda gidx: (gidx, 0),
                                memory_space=pltpu.VMEM)],
         out_specs=(out_tile(N * Ms), out_tile(N), out_tile(Ms * Ms),
@@ -459,9 +463,9 @@ def _core_bwd(spec, interpret, res, g):
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(args[0], args[1], args[2], args[3], args[4], args[5], args[8], args[9],
-      chk, g_tile)
+      args[10], chk, g_tile)
 
-    (zsh, dsh, psh, desh, osh, ovsh, b0sh, p0sh, datash, msh) = shapes
+    (zsh, dsh, psh, desh, osh, ovsh, b0sh, p0sh, datash, msh, wsh) = shapes
     gZ = _unlay(grads[0], B, zsh[1:])
     gd = _unlay(grads[1], B, dsh[1:])
     gPhi = _unlay(grads[2], B, psh[1:])
@@ -471,7 +475,8 @@ def _core_bwd(spec, interpret, res, g):
     gb0 = _unlay(grads[6], B, b0sh[1:])
     gP0 = _unlay(grads[7], B, p0sh[1:])
     return (gZ, gd, gPhi, gdelta, gOm, govar, gb0, gP0,
-            jnp.zeros(datash, dtype=f32), jnp.zeros(msh, dtype=f32))
+            jnp.zeros(datash, dtype=f32), jnp.zeros(msh, dtype=f32),
+            jnp.zeros(wsh, dtype=f32))
 
 
 _core.defvjp(_core_fwd, _core_bwd)
@@ -482,7 +487,8 @@ _core.defvjp(_core_fwd, _core_bwd)
 # ---------------------------------------------------------------------------
 
 def batched_loglik_diff(spec: ModelSpec, params_batch, data, start=0, end=None,
-                        interpret: bool | None = None, dtype=None):
+                        interpret: bool | None = None, dtype=None,
+                        starts=None, ends=None):
     """Differentiable fused-kernel loglik: (B, n_params) → (B,).
 
     ``jax.grad`` flows through the hand-derived adjoint kernel for the state-
@@ -491,6 +497,11 @@ def batched_loglik_diff(spec: ModelSpec, params_batch, data, start=0, end=None,
     ``dtype`` defaults to f32 (the TPU compute type); f64 is accepted in
     interpret mode for tight test comparisons against ``jax.grad`` of the
     algebraically identical ``univariate_kf.get_loss``.
+
+    ``starts``/``ends``: optional (B,) per-draw estimation windows (see
+    ``pallas_kf.batched_loglik``) — lets a whole rolling-window × multi-start
+    batch share one differentiable program.  Scalar ``start``/``end`` are
+    ignored when given.
     """
     if spec.family not in ("kalman_dns", "kalman_afns"):
         raise ValueError(f"differentiable pallas kernel supports the "
@@ -520,7 +531,9 @@ def batched_loglik_diff(spec: ModelSpec, params_batch, data, start=0, end=None,
     observed = (t_idx >= start) & (t_idx < end)
     contrib = loglik_contrib_mask(start, end, T)
     masks = jnp.stack([observed, contrib], axis=1).astype(f32)
+    windowed = starts is not None
+    win = window_array(starts, ends, B, f32)
 
     tensors = precompute(params_batch)
-    return _core(spec, interpret, *tensors, jnp.asarray(data, dtype=f32),
-                 masks)
+    return _core(spec, interpret, windowed, *tensors,
+                 jnp.asarray(data, dtype=f32), masks, win)
