@@ -221,6 +221,19 @@ def main():
         np.asarray(out)[both], np.asarray(out_joint)[both], rtol=2e-2)
     dev_evals_per_sec = BATCH / dev_time
 
+    # ---- roofline accounting (BASELINE.md "MFU / roofline") ----
+    # univariate filter, per draw per time step (Ms = state dim, N = obs):
+    #   per observation: zP = Pz (2Ms²) + f (2Ms) + K (Ms) + β (2Ms)
+    #                    + P -= K zPᵀ (2Ms²) + ll (≈6)  ≈ 4Ms² + 5Ms + 6
+    #   transition: Φβ (2Ms²) + ΦPΦᵀ (4Ms³) + +Ω (Ms²) + symmetrize (2Ms²)
+    Ms = spec.state_dim
+    per_obs = 4 * Ms * Ms + 5 * Ms + 6
+    per_step = N_MATURITIES * per_obs + 4 * Ms**3 + 5 * Ms * Ms + 2 * Ms
+    flops_per_eval = per_step * T_MONTHS
+
+    def gflops(rate):
+        return rate * flops_per_eval / 1e9
+
     platform = jax.devices()[0].platform
     if out_pallas is not None:
         bp = np.isfinite(np.asarray(out)) & np.isfinite(np.asarray(out_pallas))
@@ -246,7 +259,14 @@ def main():
           f"api/univariate {dev_evals_per_sec:.2f} | joint {BATCH / t_joint:.2f} "
           f"| pallas {pallas_rate} evals/s; kernels agree: joint={agree} "
           f"pallas={pallas_agree}; finite: {n_finite}/{BATCH}; "
-          f"cpu ll sample {ll_cpu:.2f}{grad_ctx}", file=sys.stderr)
+          f"cpu ll sample {ll_cpu:.2f}{grad_ctx}; "
+          f"roofline: {flops_per_eval/1e6:.3f} MFLOP/eval -> "
+          f"univariate {gflops(dev_evals_per_sec):.1f} | "
+          f"joint {gflops(BATCH / t_joint):.1f} | "
+          f"pallas "
+          f"{gflops(BATCH / t_pallas) if out_pallas is not None else float('nan'):.1f}"
+          f" GFLOP/s achieved (VPU-class work; see BASELINE.md)",
+          file=sys.stderr)
 
 
 def _orchestrate():

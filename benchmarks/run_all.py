@@ -12,10 +12,9 @@ Workloads (full scale, from BASELINE.json):
 Protocol: every config runs the SAME jitted code path on the device and on a
 single CPU core (``taskset -c 0``, JAX CPU backend) — a generous stand-in for
 the reference's 1-thread Julia loop (its per-step CPU oracle is measured by
-the repo-root ``bench.py``).  CPU runs use a documented 1/k-scale workload and
-are extrapolated
-linearly; device numbers are full scale, steady state (2nd run, compile
-cached).  Results: one JSON line per config, merged into
+the repo-root ``bench.py``).  CPU baselines are MEASURED at full scale
+(cpu_scale=1 — no extrapolation); device numbers are full scale, steady state
+(2nd run, compile cached).  Results: one JSON line per config, merged into
 ``benchmarks/results.json`` by the orchestrator:
 
     python benchmarks/run_all.py              # orchestrate device + cpu
@@ -34,13 +33,15 @@ import time
 HERE = os.path.dirname(os.path.abspath(__file__))
 ROOT = os.path.dirname(HERE)
 
-# (config, cpu_scale) — cpu runs workload/scale and extrapolates ×scale
+# (config, cpu_scale) — cpu_scale=1 everywhere: CPU baselines are MEASURED at
+# full scale on the pinned core (VERDICT round 1, item 5 — no extrapolation).
+# The scale machinery remains for quick ad-hoc runs via --cpu-scale.
 CONFIGS = [
     ("dns3-mle", 1),
-    ("afns5-mle64", 16),
-    ("afns5-sv-pf", 100),
-    ("rolling-240", 24),
-    ("bootstrap-2000", 20),
+    ("afns5-mle64", 1),
+    ("afns5-sv-pf", 1),
+    ("rolling-240", 1),
+    ("bootstrap-2000", 1),
 ]
 
 
@@ -103,7 +104,8 @@ def _run_config(name: str, scale: int):
         # HBM; 250-draw chunks are the stable envelope
         CH = min(D, 250)
         D = (D // CH) * CH
-        draws = common.jitter_starts(common.afns5_params(spec), D, scale=0.02)
+        draws = common.stationary_draws(spec, common.afns5_params(spec), D,
+                                        scale=0.02)
         draws = jnp.asarray(draws, dtype=spec.dtype).reshape(D // CH, CH, -1)
         keys = jax.random.split(jax.random.PRNGKey(0), D).reshape(D // CH, CH, -1)
         # chunks dispatched as a python loop of jitted calls (lax.map over the
@@ -167,7 +169,7 @@ def _run_config(name: str, scale: int):
         spec, _ = create_model("NS", tuple(common.MATURITIES), float_type="float32")
         data = common.dns_panel()
         R = max(1, 2000 // scale)
-        G = 16
+        G = 64  # λ-decay grid resolution for model selection (BASELINE.md #5)
         grid = np.linspace(0.1, 1.2, G)
         p = np.zeros(spec.n_params, dtype=np.float32)
         p[1:4] = [0.08, -0.06, 0.03]

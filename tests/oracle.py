@@ -248,6 +248,73 @@ def msed_lambda_filter(params_struct, maturities, data, scale_grad=False,
     return preds
 
 
+def _neural_score_fd(gamma18, beta, y, maturities, transform_bool, eps=1e-6):
+    """∇_γ −‖y − Z(γ)β‖² for the neural model via central finite differences —
+    shares no AD machinery with the library (β treated as a constant, matching
+    the reference's ForwardDiff.value. detach, filter.jl:173-175)."""
+    def obj(gam):
+        Z = neural_loadings(gam, maturities, transform_bool)
+        v = y - Z @ beta
+        return -float(v @ v)
+
+    g = np.zeros(18)
+    for i in range(18):
+        e = np.zeros(18)
+        e[i] = eps
+        g[i] = (obj(gamma18 + e) - obj(gamma18 - e)) / (2.0 * eps)
+    return g
+
+
+def msed_neural_filter(params_struct, maturities, data, transform_bool,
+                       scale_grad=False, forget_factor=0.98,
+                       dtype_eps=np.finfo(np.float64).eps):
+    """Per-step neural MSED loop (models/filter.jl:52-91 with the two-MLP
+    loadings of mseneural.jl:137-163).  ``params_struct``: dict with A (18,)
+    and B (18,) (or None for random-walk dynamics) already expanded through
+    the duplicator, omega (18,), delta (3,), Phi (3,3)."""
+    A = params_struct["A"]
+    B = params_struct["B"]
+    omega = params_struct["omega"]
+    delta = params_struct["delta"]
+    Phi = params_struct["Phi"]
+    mu = (np.eye(3) - Phi) @ delta
+    nu = np.zeros_like(omega) if B is None else (1 - B) * omega
+
+    gamma = omega.copy()
+    beta = delta.copy()
+    ewma = np.zeros_like(gamma)
+    count = 0
+
+    N, T = data.shape
+    preds = np.zeros((N, T))
+    for t in range(T):
+        y = data[:, t]
+        if np.isnan(y[0]):
+            if B is not None:
+                gamma = nu + B * gamma
+            beta = mu + Phi @ beta
+            Z = neural_loadings(gamma, maturities, transform_bool)
+            preds[:, t] = Z @ beta
+            continue
+        Z = neural_loadings(gamma, maturities, transform_bool)
+        beta = _ols(Z, y)
+        g = _neural_score_fd(gamma, beta, y, maturities, transform_bool)
+        if scale_grad:
+            ewma = forget_factor * ewma + (1 - forget_factor) * g * g
+            count += 1
+            denom = 1 - forget_factor ** count
+            g = g / (np.sqrt(ewma / denom) + dtype_eps)
+        gamma = gamma + g * A
+        Z = neural_loadings(gamma, maturities, transform_bool)
+        beta = _ols(Z, y)
+        if B is not None:
+            gamma = nu + B * gamma
+            Z = neural_loadings(gamma, maturities, transform_bool)
+        beta = mu + Phi @ beta
+        preds[:, t] = Z @ beta
+    return preds
+
+
 def msed_loss_from_preds(preds, data):
     N, T = data.shape
     mse = 0.0
@@ -272,6 +339,59 @@ def static_filter(gamma_Z, delta, Phi, data):
             beta = mu + Phi @ _ols(Z, y)
         preds[:, t] = Z @ beta
     return preds
+
+
+# ---------------------------------------------------------------------------
+# AFNS3 closed-form yield adjustment (Christensen–Diebold–Rudebusch)
+# ---------------------------------------------------------------------------
+
+def afns3_yield_adjustment_cdr(lam, Omega, maturities):
+    """Closed-form AFNS3 yield-adjustment term −A(τ)/τ for a general state
+    covariance Ω — the Christensen–Diebold–Rudebusch (2011) formula,
+    independently re-derived here by symbolic integration of
+    A(τ) = ½∫₀^τ B(s)ᵀΩB(s) ds with the bond-price loadings written from the
+    model primitives (B₁ = −s, B₂ = −(1−e^{−λs})/λ, B₃ = s·e^{−λs} + B₂ —
+    NOT the library's _price_loadings), so a sign error there cannot cancel.
+
+    Returns the per-maturity adjustment α(τ) = −A(τ)/τ (the quantity
+    models/afns.py:yield_adjustment evaluates by quadrature).
+    """
+    tau = np.asarray(maturities, dtype=np.float64)
+    L = lam
+    e1 = np.exp(-L * tau)
+    e2 = np.exp(-2.0 * L * tau)
+
+    # ∫₀^τ B_i B_j ds / τ, from sympy integration of the primitives above
+    I11 = tau ** 2 / 3.0
+    I22 = (1.0 / L**2
+           - 3.0 / (2.0 * L**3 * tau)
+           + 2.0 * e1 / (L**3 * tau)
+           - e2 / (2.0 * L**3 * tau))
+    I33 = ((-2.0 * L**2 * tau**2
+            + 4.0 * L * tau / e2
+            - 6.0 * L * tau
+            + 8.0 * (L * tau + 2.0) / e1
+            - 11.0 / e2
+            - 5.0) * e2 / (4.0 * L**3 * tau))
+    I12 = ((L**2 * tau**2 / e1 / 2.0
+            + L * tau
+            - 1.0 / e1
+            + 1.0) * e1 / (L**3 * tau))
+    I13 = (tau / (2.0 * L)
+           + tau * e1 / L
+           + 3.0 * e1 / L**2
+           - 3.0 / (L**3 * tau)
+           + 3.0 * e1 / (L**3 * tau))
+    I23 = ((4.0 * L * tau / e2
+            - 2.0 * L * tau
+            + 4.0 * (L * tau + 3.0) / e1
+            - 9.0 / e2
+            - 3.0) * e2 / (4.0 * L**3 * tau))
+
+    O = np.asarray(Omega, dtype=np.float64)
+    total = (O[0, 0] * I11 + O[1, 1] * I22 + O[2, 2] * I33
+             + 2.0 * O[0, 1] * I12 + 2.0 * O[0, 2] * I13 + 2.0 * O[1, 2] * I23)
+    return -0.5 * total
 
 
 # ---------------------------------------------------------------------------

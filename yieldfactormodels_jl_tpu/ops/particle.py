@@ -35,7 +35,7 @@ _LOG_2PI = math.log(2.0 * math.pi)
 
 class PFState(NamedTuple):
     beta: jnp.ndarray   # (P, Ms) per-particle predicted state
-    P: jnp.ndarray      # (P, Ms, Ms)
+    S: jnp.ndarray      # (P, Ms, Ms) lower square-root factor, P_cov = S Sᵀ
     h: jnp.ndarray      # (P,) log-vol
     logw: jnp.ndarray   # (P,) normalized log-weights (logsumexp == 0)
     key: jnp.ndarray
@@ -59,38 +59,72 @@ def _systematic_resample(key, weights, n):
     return jnp.searchsorted(cum, positions)
 
 
-def _kf_particle_step(Z, d, Phi, delta, Omega_state, beta, P, y, r, obs):
-    """Measurement+propagate Kalman step for ALL particles at once.
+def _batched_cholesky(P, Ms: int, floor: float = 1e-12):
+    """Unrolled Cholesky–Banachiewicz of (..., Ms, Ms) PSD matrices — pure
+    elementwise VPU arithmetic over the particle axis (no LAPACK batching,
+    no data-dependent control flow).  Diagonal pivots are floored so a
+    rounding-level indefiniteness cannot emit NaN; inputs here are
+    PSD-by-construction (S Sᵀ products plus a PD Ω), so the floor only ever
+    absorbs last-ulp noise."""
+    L = [[None] * Ms for _ in range(Ms)]
+    for i in range(Ms):
+        for j in range(i + 1):
+            s = P[..., i, j]
+            for k in range(j):
+                s = s - L[i][k] * L[j][k]
+            if i == j:
+                L[i][i] = jnp.sqrt(jnp.maximum(s, floor))
+            else:
+                L[i][j] = s / L[j][j]
+    rows = [jnp.stack([L[i][j] if j <= i else jnp.zeros_like(P[..., 0, 0])
+                       for j in range(Ms)], axis=-1) for i in range(Ms)]
+    return jnp.stack(rows, axis=-2)
 
-    ``beta (Pn, Ms)``, ``P (Pn, Ms, Ms)``, ``r (Pn,)`` the per-particle scalar
-    observation variance σ²e^{h}.  Because Ω_obs = r·I is diagonal, the update
-    runs as N sequential *scalar* innovations (the same univariate
-    decomposition as ops/univariate_kf.py) — rank-1 FMAs over the particle
-    axis, no per-particle N×N Cholesky.  Algebraically identical posterior and
-    log-likelihood; a non-PD innovation variance yields −Inf for that particle
-    (which logsumexp then zero-weights) instead of the silently-garbled value
-    the factored form would produce."""
-    N = Z.shape[0]
-    ll = jnp.zeros(r.shape, dtype=P.dtype)
-    ok = jnp.ones(r.shape, dtype=bool)
-    b_u, P_u = beta, P
-    for i in range(N):  # N is static; unrolled rank-1 updates
-        z = Z[i]
-        zP = P_u @ z                                  # (Pn, Ms)
-        f = zP @ z + r                                # (Pn,)
-        ok = ok & (f > 0) & jnp.isfinite(f)
+
+def _kf_particle_step(Z, d, Phi, delta, chol_Om, beta, S, y, r, obs):
+    """Square-root measurement+propagate Kalman step for ALL particles.
+
+    ``beta (Pn, Ms)``, ``S (Pn, Ms, Ms)`` the lower factor of the predicted
+    covariance (P = S Sᵀ), ``r (Pn,)`` the per-particle scalar observation
+    variance σ²e^{h}.  Because Ω_obs = r·I is diagonal, the update runs as N
+    sequential *scalar* Potter square-root updates (the univariate
+    decomposition of ops/sqrt_kf._potter_update, vectorized across the
+    particle axis): φ = Sᵀz, f = φᵀφ + r, so the innovation variance is a sum
+    of squares plus r — **strictly positive by construction**, which is what
+    keeps every particle's likelihood finite in f32 where the plain
+    P-propagating form loses ~18% of draws to rank-1 downdate drift
+    (VERDICT round 1, item 3).  The time update re-factors
+    Φ S_m (Φ S_m)ᵀ + Ω with an unrolled elementwise Cholesky."""
+    sqrt_r = jnp.sqrt(jnp.maximum(r, 0.0))
+
+    def obs_update(carry, zy):
+        b_u, S_u, ll, ok = carry
+        z, y_i, d_i = zy
+        phi = S_u.swapaxes(-1, -2) @ z                # (Pn, Ms) = Sᵀz
+        f = jnp.sum(phi * phi, axis=-1) + r           # (Pn,) > 0 always
         fsafe = jnp.where(f > 0, f, 1.0)
-        v = y[i] - d[i] - b_u @ z                     # (Pn,)
-        Kg = zP / fsafe[:, None]
-        b_u = b_u + Kg * v[:, None]
-        P_u = P_u - Kg[:, :, None] * zP[:, None, :]
+        ok = ok & jnp.isfinite(f)
+        v = y_i - d_i - b_u @ z                       # (Pn,)
+        Sphi = jnp.einsum("pij,pj->pi", S_u, phi)     # = P z
+        b_u = b_u + Sphi * (v / fsafe)[:, None]
+        alpha = 1.0 / (fsafe + sqrt_r * jnp.sqrt(fsafe))
+        S_u = S_u - alpha[:, None, None] * (Sphi[:, :, None] * phi[:, None, :])
         ll = ll - 0.5 * (jnp.log(fsafe) + v * v / fsafe + _LOG_2PI)
-    P_u = 0.5 * (P_u + jnp.swapaxes(P_u, -1, -2))     # symmetry insurance
+        return (b_u, S_u, ll, ok), None
+
+    # scan (not unroll) over the N observations: 20x smaller XLA graph, which
+    # keeps device compile times sane inside the outer T-step scan
+    (b_u, S_u, ll, ok), _ = jax.lax.scan(
+        obs_update,
+        (beta, S, jnp.zeros(r.shape, dtype=S.dtype), jnp.isfinite(r)),
+        (Z, y, d))
     beta_m = beta + (b_u - beta) * obs
-    P_m = P + (P_u - P) * obs
+    S_m = S + (S_u - S) * obs
     beta_next = delta[None, :] + beta_m @ Phi.T
-    P_next = jnp.einsum("ij,pjk,lk->pil", Phi, P_m, Phi) + Omega_state[None]
-    return beta_next, P_next, jnp.where(ok, ll, -jnp.inf)
+    A = jnp.einsum("ij,pjk->pik", Phi, S_m)           # Φ S_m
+    P_next = A @ A.swapaxes(-1, -2) + (chol_Om @ chol_Om.T)[None]
+    S_next = _batched_cholesky(P_next, Phi.shape[0])
+    return beta_next, S_next, jnp.where(ok, ll, -jnp.inf)
 
 
 def particle_filter_loglik(
@@ -114,9 +148,20 @@ def particle_filter_loglik(
     Z, d = _measurement(spec, kp)
     state0 = K.init_state(spec, kp)
     Pn = n_particles
+    Ms = spec.state_dim
+    dtype = params.dtype
+    # factor P0 and Ω once (sqrt_kf.get_loss conventions): a failed
+    # factorization is the draw-level −Inf sentinel
+    P0s = 0.5 * (state0.P + state0.P.T) + 1e-9 * jnp.eye(Ms, dtype=dtype)
+    S0 = jnp.linalg.cholesky(P0s)
+    Om = 0.5 * (kp.Omega_state + kp.Omega_state.T) + 1e-12 * jnp.eye(Ms, dtype=dtype)
+    chol_Om = jnp.linalg.cholesky(Om)
+    fac_ok = jnp.all(jnp.isfinite(S0)) & jnp.all(jnp.isfinite(chol_Om))
+    S0 = jnp.where(jnp.isfinite(S0), S0, jnp.eye(Ms, dtype=dtype) * 1e-3)
+    chol_Om = jnp.where(jnp.isfinite(chol_Om), chol_Om, jnp.zeros_like(chol_Om))
     beta0 = jnp.broadcast_to(state0.beta, (Pn,) + state0.beta.shape)
-    P0 = jnp.broadcast_to(state0.P, (Pn,) + state0.P.shape)
-    h0 = jnp.zeros((Pn,), dtype=params.dtype)
+    S0b = jnp.broadcast_to(S0, (Pn, Ms, Ms))
+    h0 = jnp.zeros((Pn,), dtype=dtype)
 
     T = data.shape[1]
     log_uniform = -jnp.log(jnp.asarray(float(Pn), dtype=params.dtype))
@@ -128,8 +173,8 @@ def particle_filter_loglik(
         obs = jnp.all(jnp.isfinite(y))
         ysafe = jnp.where(jnp.isfinite(y), y, 0.0)
         r = kp.obs_var * jnp.exp(h_new)
-        beta, P, ll = _kf_particle_step(Z, d, kp.Phi, kp.delta, kp.Omega_state,
-                                        st.beta, st.P, ysafe, r,
+        beta, S, ll = _kf_particle_step(Z, d, kp.Phi, kp.delta, chol_Om,
+                                        st.beta, st.S, ysafe, r,
                                         obs.astype(st.h.dtype))
         contributes = obs & (t_idx > 0)  # reference skips t == 1 (1-based)
         # accumulate onto the carried normalized log-weights: the step's
@@ -143,14 +188,14 @@ def particle_filter_loglik(
         idx = _systematic_resample(k_res, wn, Pn)
         do_resample = contributes & (ess < ess_threshold * Pn)
         beta = jnp.where(do_resample, beta[idx], beta)
-        P = jnp.where(do_resample, P[idx], P)
+        S = jnp.where(do_resample, S[idx], S)
         h_new = jnp.where(do_resample, h_new[idx], h_new)
         logw_out = jnp.where(do_resample,
                              jnp.full_like(logw_norm, log_uniform), logw_norm)
-        return PFState(beta, P, h_new, logw_out, key), step_ll
+        return PFState(beta, S, h_new, logw_out, key), step_ll
 
     t_idx = jnp.arange(T - 1)
     logw0 = jnp.full((Pn,), log_uniform, dtype=params.dtype)
-    _, lls = lax.scan(body, PFState(beta0, P0, h0, logw0, key), (data.T[:-1], t_idx))
+    _, lls = lax.scan(body, PFState(beta0, S0b, h0, logw0, key), (data.T[:-1], t_idx))
     total = jnp.sum(lls)
-    return jnp.where(jnp.isfinite(total), total, -jnp.inf)
+    return jnp.where(fac_ok & jnp.isfinite(total), total, -jnp.inf)
