@@ -16,8 +16,8 @@ along the P axis, which XLA fuses into trivial VPU code.
 
 Semantics per start match ``optimize._run_lbfgs`` (Optim.jl's
 LBFGS(BackTracking) analogue, /root/reference/src/optimization.jl:329-410):
-memory 10, Armijo backtracking with halving, max-|g| g_tol + |Δf| f_abstol
-stopping.  Converged starts freeze (their rows stop moving) while the batch
+memory 10, Armijo geometric backtracking (factor 0.8, optax's default
+granularity), max-|g| g_tol + |Δf| f_abstol stopping.  Converged starts freeze (their rows stop moving) while the batch
 keeps iterating until all starts converge or ``max_iters`` is reached —
 frozen rows ride along in the batched evals for free.
 
@@ -48,7 +48,7 @@ def batched_lbfgs(value_and_grad: Callable[[jax.Array], Tuple[jax.Array, jax.Arr
                   memory_size: int = 10,
                   max_backtracks: int = 25,
                   armijo_c1: float = 1e-4,
-                  shrink: float = 0.5,
+                  shrink: float = 0.8,
                   invalid_above: float | None = None,
                   value_fn: Callable[[jax.Array], jax.Array] | None = None
                   ) -> BatchedLBFGSResult:
