@@ -211,3 +211,56 @@ def test_crash_recovery_stale_lock(tmp_path):
         "SELECT task_id FROM forecasts ORDER BY task_id").fetchall()]
     conn.close()
     assert tasks == list(range(30, 37))
+
+
+def test_batched_window_predicts_equal_truncated_per_task(maturities, yields_panel):
+    """The fused one-program per-origin predict (masked uniform panel) must
+    equal the per-task truncated predict column-for-column over the saved
+    forecast span, for BOTH window types and a score-driven family (whose
+    masked-prefix == truncation property rests on γ₀/β₀ being transition
+    fixed points)."""
+    import jax.numpy as jnp
+    from yieldfactormodels_jl_tpu import create_model
+    from yieldfactormodels_jl_tpu.forecasting import (
+        _batched_window_predicts, _window_forecast_data)
+    from yieldfactormodels_jl_tpu.models import api
+
+    h = 5
+    for code in ("NS", "SD-NS", "1C"):
+        spec, _ = create_model(code, tuple(maturities), float_type="float64")
+        p = np.zeros(spec.n_params)
+        if code == "SD-NS":
+            p[0], p[1], p[2] = 1e-3, 0.97, np.log(0.5)
+            p[3:6] = [0.3, -0.1, 0.05]
+            p[6:15] = np.diag([0.95, 0.9, 0.85]).T.reshape(-1)
+        elif code == "NS":
+            p[0] = np.log(0.5)
+            p[1:4] = [0.3, -0.1, 0.05]
+            p[4:13] = np.diag([0.95, 0.9, 0.85]).reshape(-1)
+        else:  # 1C kalman
+            p[0] = np.log(0.5)
+            p[1] = 1e-3
+            k = 2
+            for j in range(3):
+                for i in range(j + 1):
+                    p[k] = 0.1 if i == j else 0.01
+                    k += 1
+            p[6:9] = [0.3, -0.1, 0.05]
+            p[9:18] = np.diag([0.95, 0.9, 0.85]).reshape(-1)
+        data = yields_panel[:, :40]
+        in_end, in_start = 30, 1
+        tasks = [30, 33, 40]
+        for wt in ("expanding", "moving"):
+            batched = _batched_window_predicts(
+                spec, data, tasks, wt, in_end, in_start, h,
+                np.tile(p, (len(tasks), 1)))
+            for i, tid in enumerate(tasks):
+                fdata = _window_forecast_data(spec, data, tid, wt, in_end,
+                                              in_start, h)
+                want = api.predict(spec, jnp.asarray(p), jnp.asarray(fdata))
+                for key in ("preds", "factors", "states"):
+                    np.testing.assert_allclose(
+                        np.asarray(batched[i][key])[:, -h:],
+                        np.asarray(want[key])[:, -h:],
+                        rtol=1e-9, atol=1e-12,
+                        err_msg=f"{code}/{wt}/task {tid}/{key}")
