@@ -95,3 +95,21 @@ def test_per_lane_windows_match_univariate(rng):
                                    starts=starts, ends=ends)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=5e-4, atol=1e-2)
+
+
+def test_tile_rows_variants_agree(rng):
+    """tile_rows=16/32 (wider VPU tiles for dependency-chain pipelining) must
+    be numerically identical to the default 8-row layout."""
+    spec, _ = create_model("1C", MATS, float_type="float32")
+    B, T = 5, 20
+    p = _params(spec, B, rng)
+    data = (0.5 * rng.standard_normal((len(MATS), T)) + 4).astype(np.float32)
+    base = np.asarray(pallas_kf.batched_loglik(spec, p, data, interpret=True))
+    for rows in (16, 32):
+        got = np.asarray(pallas_kf.batched_loglik(spec, p, data,
+                                                  interpret=True,
+                                                  tile_rows=rows))
+        np.testing.assert_allclose(got, base, rtol=1e-6)
+    import pytest
+    with pytest.raises(ValueError):
+        pallas_kf.batched_loglik(spec, p, data, interpret=True, tile_rows=12)

@@ -71,7 +71,7 @@ def window_array(starts, ends, B, f32):
 
 
 def _kernel(N: int, Ms: int, T: int, tvl: bool, exact_jac: bool,
-            windowed: bool, mats,
+            windowed: bool, mats, rows,
             Zr, dr, phir, deltar, omr, ovarr, b0r, p0r, datar, maskr, winr,
             outr):
     """One grid program = TILE draws.  Tile-stacked refs, scalar data/masks.
@@ -93,7 +93,7 @@ def _kernel(N: int, Ms: int, T: int, tvl: bool, exact_jac: bool,
 
     beta0 = tuple(b0r[m] for m in range(Ms))
     P0 = tuple(p0r[k] for k in range(Ms * Ms))
-    ll0 = jnp.zeros((_SUB, _LANE), dtype=f32)
+    ll0 = jnp.zeros((rows, _LANE), dtype=f32)
 
     def step(t, carry):
         beta, P, ll = carry
@@ -107,8 +107,8 @@ def _kernel(N: int, Ms: int, T: int, tvl: bool, exact_jac: bool,
         # ---- N sequential scalar measurement updates (rank-1, lane-local) --
         b = list(beta)
         Pm = list(P)
-        ll_step = jnp.zeros((_SUB, _LANE), dtype=f32)
-        ok = jnp.ones((_SUB, _LANE), dtype=jnp.bool_)
+        ll_step = jnp.zeros((rows, _LANE), dtype=f32)
+        ok = jnp.ones((rows, _LANE), dtype=jnp.bool_)
         finite_s = True
         for i in range(N):
             y_i = datar[t, i]
@@ -120,7 +120,7 @@ def _kernel(N: int, Ms: int, T: int, tvl: bool, exact_jac: bool,
                 ztau = z2 - z3  # e^{-λτ} via the DNS identity Z₃ = Z₂ − e^{-λτ}
                 dz2 = tvl_dz2_dlam(lam, ztau, tau, exact_jac)
                 jac = ((beta[1] + beta[2]) * dz2 + beta[2] * tau * ztau) * dlam
-                z = (jnp.ones((_SUB, _LANE), dtype=f32), z2, z3, jac)
+                z = (jnp.ones((rows, _LANE), dtype=f32), z2, z3, jac)
                 # y_eff = y − h(β_pred) + z·β_pred = y + jac·β₄_pred
                 y_eff = y_i + jac * beta[3]
                 d_i = jnp.zeros((), f32)
@@ -161,8 +161,8 @@ def _kernel(N: int, Ms: int, T: int, tvl: bool, exact_jac: bool,
             + sum(PA[m * Ms + k] * phir[n * Ms + k] for k in range(Ms))
             for m in range(Ms) for n in range(Ms))
 
-        neg_inf = jnp.full((_SUB, _LANE), -jnp.inf, dtype=f32)
-        zero = jnp.zeros((_SUB, _LANE), dtype=f32)
+        neg_inf = jnp.full((rows, _LANE), -jnp.inf, dtype=f32)
+        zero = jnp.zeros((rows, _LANE), dtype=f32)
         ll_t = jnp.where(jnp.logical_and(obs, con_s),
                          jnp.where(ok, ll_step, neg_inf), zero)
         return beta_next, P_next, ll + ll_t
@@ -171,18 +171,19 @@ def _kernel(N: int, Ms: int, T: int, tvl: bool, exact_jac: bool,
     outr[...] = jnp.where(jnp.isfinite(ll), ll, -jnp.inf)
 
 
-def _lay(x, B, nb):
-    """(B, ...) draw-major → (D, nb·8, 128) tile-stacked, edge-padded."""
+def _lay(x, B, nb, rows=_SUB):
+    """(B, ...) draw-major → (D, nb·rows, 128) tile-stacked, edge-padded."""
     D = int(x.size) // B
     x2 = x.reshape(B, D).T
-    pad = nb * TILE - B
+    pad = nb * rows * _LANE - B
     if pad:
         x2 = jnp.concatenate([x2, jnp.broadcast_to(x2[:, -1:], (D, pad))], axis=1)
-    return x2.reshape(D, nb * _SUB, _LANE)
+    return x2.reshape(D, nb * rows, _LANE)
 
 
 def batched_loglik(spec: ModelSpec, params_batch, data, start=0, end=None,
-                   interpret: bool | None = None, starts=None, ends=None):
+                   interpret: bool | None = None, starts=None, ends=None,
+                   tile_rows: int = _SUB):
     """Gaussian loglik for a batch of parameter draws — Pallas fused kernel.
 
     Numerically equivalent to ``vmap(univariate_kf.get_loss)`` for every
@@ -195,10 +196,17 @@ def batched_loglik(spec: ModelSpec, params_batch, data, start=0, end=None,
     rolling-window origins runs as one fused program (the reference's
     per-origin process farm, forecasting.jl:120-199, collapsed into one
     launch).  When given, the scalar ``start``/``end`` are ignored.
+
+    ``tile_rows``: sublane rows per grid program (multiple of 8).  The
+    recursion is serially dependent along T and the observation chain, so the
+    kernel is latency-bound; wider tiles (16/32) give each vector op 2–4
+    independent vregs of work to pipeline through the same dependency chain.
     """
     if spec.family not in ("kalman_dns", "kalman_afns", "kalman_tvl"):
         raise ValueError(f"pallas kernel supports the kalman families, "
                          f"not {spec.family!r}")
+    if tile_rows <= 0 or tile_rows % _SUB:
+        raise ValueError(f"tile_rows must be a positive multiple of {_SUB}")
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
 
@@ -206,7 +214,8 @@ def batched_loglik(spec: ModelSpec, params_batch, data, start=0, end=None,
     f32 = jnp.float32
     params_batch = jnp.asarray(params_batch, dtype=f32)
     B = params_batch.shape[0]
-    nb = -(-B // TILE)
+    rows = tile_rows
+    nb = -(-B // (rows * _LANE))
     N, Ms = spec.N, spec.state_dim
     T = data.shape[1]
     if end is None:
@@ -230,21 +239,21 @@ def batched_loglik(spec: ModelSpec, params_batch, data, start=0, end=None,
     win = window_array(starts, ends, B, f32)
 
     args = [
-        _lay(Z.astype(f32), B, nb),                    # (N·Ms, nb·8, 128); (1, ...) TVλ dummy
-        _lay(d.astype(f32), B, nb),                    # (N, ...); (1, ...) TVλ dummy
-        _lay(kp.Phi.astype(f32), B, nb),               # (Ms·Ms, ...)
-        _lay(kp.delta.astype(f32), B, nb),             # (Ms, ...)
-        _lay(kp.Omega_state.astype(f32), B, nb),       # (Ms·Ms, ...)
-        _lay(kp.obs_var.astype(f32), B, nb),           # (1, ...)
-        _lay(state0.beta.astype(f32), B, nb),          # (Ms, ...)
-        _lay(state0.P.astype(f32), B, nb),             # (Ms·Ms, ...)
+        _lay(Z.astype(f32), B, nb, rows),              # (N·Ms, nb·rows, 128); (1, ...) TVλ dummy
+        _lay(d.astype(f32), B, nb, rows),              # (N, ...); (1, ...) TVλ dummy
+        _lay(kp.Phi.astype(f32), B, nb, rows),         # (Ms·Ms, ...)
+        _lay(kp.delta.astype(f32), B, nb, rows),       # (Ms, ...)
+        _lay(kp.Omega_state.astype(f32), B, nb, rows), # (Ms·Ms, ...)
+        _lay(kp.obs_var.astype(f32), B, nb, rows),     # (1, ...)
+        _lay(state0.beta.astype(f32), B, nb, rows),    # (Ms, ...)
+        _lay(state0.P.astype(f32), B, nb, rows),       # (Ms·Ms, ...)
         jnp.asarray(data, dtype=f32).T,                # (T, N) shared
         masks,                                         # (T, 2) shared
-        _lay(win, B, nb),                              # (2, ...) per-lane window
+        _lay(win, B, nb, rows),                        # (2, ...) per-lane window
     ]
 
     def tile_spec(D):
-        return pl.BlockSpec((D, _SUB, _LANE), lambda g: (0, g, 0),
+        return pl.BlockSpec((D, rows, _LANE), lambda g: (0, g, 0),
                             memory_space=pltpu.VMEM)
 
     z_rows = 1 if tvl else N * Ms
@@ -252,15 +261,15 @@ def batched_loglik(spec: ModelSpec, params_batch, data, start=0, end=None,
     smem = pl.BlockSpec(memory_space=pltpu.SMEM)
     out = pl.pallas_call(
         partial(_kernel, N, Ms, T, tvl, spec.exact_jacobian, windowed,
-                tuple(float(m) for m in spec.maturities)),
+                tuple(float(m) for m in spec.maturities), rows),
         grid=(nb,),
         in_specs=[tile_spec(z_rows), tile_spec(d_rows), tile_spec(Ms * Ms),
                   tile_spec(Ms), tile_spec(Ms * Ms), tile_spec(1),
                   tile_spec(Ms), tile_spec(Ms * Ms), smem, smem,
                   tile_spec(2)],
-        out_specs=pl.BlockSpec((_SUB, _LANE), lambda g: (g, 0),
+        out_specs=pl.BlockSpec((rows, _LANE), lambda g: (g, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((nb * _SUB, _LANE), f32),
+        out_shape=jax.ShapeDtypeStruct((nb * rows, _LANE), f32),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel",)),
         interpret=interpret,
