@@ -32,6 +32,10 @@ os.environ.setdefault("MKL_NUM_THREADS", "1")
 
 import numpy as np
 
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "benchmarks"))
+import common as _common  # noqa: E402  (shared grad-agreement criterion)
+
 BATCH = int(os.environ.get("BENCH_BATCH", "1024"))
 N_MATURITIES = 20
 T_MONTHS = 360
@@ -218,14 +222,9 @@ def main():
         t_vmap_vg, (vv, vg) = timed(jax.jit(vmap_vag), arg=raw_batch)
         bg = np.isfinite(np.asarray(fv)) & (np.asarray(fv) < 1e12) & \
             np.isfinite(np.asarray(vv)) & (np.asarray(vv) < 1e12)
-        # elementwise comparison is meaningless here: both f32 paths carry
-        # cancellation noise ~1e-4 of the ~1e7 gradient norms.  Agreement =
-        # per-lane direction (cosine) + norm ratio (what L-BFGS consumes).
-        fgb, vgb = np.asarray(fg)[bg], np.asarray(vg)[bg]
-        fn_, vn_ = np.linalg.norm(fgb, axis=1), np.linalg.norm(vgb, axis=1)
-        cos = np.sum(fgb * vgb, axis=1) / np.maximum(fn_ * vn_, 1e-12)
-        vg_agree = bool(bg.any()) and bool(
-            (cos.min() > 0.999) and np.all(np.abs(fn_ / np.maximum(vn_, 1e-12) - 1) < 0.05))
+        # elementwise comparison is meaningless here (f32 cancellation noise);
+        # the shared direction+norm criterion lives in benchmarks/common.py
+        vg_agree, _ = _common.grad_agreement(np.asarray(fg)[bg], np.asarray(vg)[bg])
         grad_ctx = (f"; grad evals/s: fused {BATCH / t_fused_vg:.2f} | "
                     f"vmap-AD {BATCH / t_vmap_vg:.2f}; grads agree: {vg_agree}")
     except Exception as e:  # never kill the bench line

@@ -19,6 +19,28 @@ MATURITIES_M = np.array([3, 6, 9, 12, 15, 18, 21, 24, 30, 36, 48, 60, 72, 84,
 MATURITIES = MATURITIES_M / 12.0
 
 
+def grad_agreement(g_a, g_b, cos_min=0.999, norm_tol=0.05):
+    """Direction + magnitude agreement of two gradient batches (rows = lanes).
+
+    Elementwise f32 comparison is cancellation noise at the ~1e7 gradient
+    norms these models produce; what an L-BFGS line search actually consumes
+    is the direction (cosine) and the step scale (norm ratio).  Shared by
+    ``bench.py`` and ``hw_verify.py`` so the two harnesses can never disagree
+    about what "agrees" means.  Returns ``(ok, detail)``; an EMPTY batch (no
+    finite lanes — exactly the regression a harness exists to catch) is a
+    clean ``(False, ...)``, not a zero-size reduction crash.
+    """
+    g_a, g_b = np.asarray(g_a), np.asarray(g_b)
+    if g_a.size == 0 or g_a.shape[0] == 0:
+        return False, "no finite lanes"
+    na = np.linalg.norm(g_a, axis=1)
+    nb = np.linalg.norm(g_b, axis=1)
+    cos = np.sum(g_a * g_b, axis=1) / np.maximum(na * nb, 1e-12)
+    ratio = np.abs(na / np.maximum(nb, 1e-12) - 1)
+    ok = bool(cos.min() > cos_min) and bool(np.all(ratio < norm_tol))
+    return ok, f"cos_min {cos.min():.6f}, norm_ratio_max {ratio.max():.3f}"
+
+
 def dns_panel(seed=0, lam=0.5, T=T_MONTHS):
     """3-factor DNS DGP panel (N, T)."""
     rng = np.random.default_rng(seed)

@@ -31,6 +31,19 @@ for p in (HERE, ROOT):
     if p not in sys.path:
         sys.path.insert(0, p)
 
+# The container's sitecustomize hook re-pins JAX_PLATFORMS=axon after env
+# parsing, so a plain `JAX_PLATFORMS=cpu python hw_verify.py` would still dial
+# the TPU tunnel and wedge (the exact failure tests/conftest.py and bench.py
+# each work around).  Honor an explicit cpu request by neutralizing the axon
+# factory BEFORE any jax computation — same recipe as tests/conftest.py.
+if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from jax._src import xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+
 
 def main() -> int:
     import jax
@@ -109,10 +122,11 @@ def main() -> int:
     got = np.asarray(pallas_kf.batched_loglik(spec, p, data, starts=los,
                                               ends=his, interpret=interpret))
     both = np.isfinite(ref) & np.isfinite(got)
+    same_sentinels = bool(np.array_equal(np.isfinite(ref), np.isfinite(got)))
     check("value[1C, per-lane windows]",
-          bool(both.any()) and np.allclose(got[both], ref[both],
-                                           rtol=5e-4, atol=5e-2),
-          f"finite {int(both.sum())}/{B}")
+          bool(both.any()) and same_sentinels
+          and np.allclose(got[both], ref[both], rtol=5e-4, atol=5e-2),
+          f"finite {int(both.sum())}/{B}, sentinels_match {same_sentinels}")
 
     # ---- adjoint kernel: value + gradient direction/norm ----
     grad_cases = ((("1C", None),) if interpret else
@@ -150,15 +164,9 @@ def main() -> int:
         both = np.isfinite(ref_v) & np.isfinite(got_v)
         vals_ok = bool(both.any()) and np.allclose(got_v[both], ref_v[both],
                                                    rtol=5e-4, atol=5e-2)
-        gg, gr = g_got[both], g_ref[both]
-        ng, nr = np.linalg.norm(gg, axis=1), np.linalg.norm(gr, axis=1)
-        cos = np.sum(gg * gr, axis=1) / np.maximum(ng * nr, 1e-12)
-        grads_ok = bool(cos.min() > 0.999) and bool(
-            np.all(np.abs(ng / np.maximum(nr, 1e-12) - 1) < 0.05))
+        grads_ok, detail = common.grad_agreement(g_got[both], g_ref[both])
         tag = f"grad[{code}{', per-lane' if win else ''}]"
-        check(tag, vals_ok and grads_ok,
-              f"cos_min {cos.min():.6f}, norm_ratio_max "
-              f"{np.max(np.abs(ng/np.maximum(nr,1e-12)-1)):.3f}")
+        check(tag, vals_ok and grads_ok, detail)
 
     print(f"# platform={platform} interpret={interpret} "
           f"{'ALL PASS' if failures == 0 else f'{failures} FAILURES'}")

@@ -69,6 +69,25 @@ def test_bootstrap_lambda_grid(maturities, yields_panel):
     np.testing.assert_allclose(float(jnp.sum(freq)), 1.0, rtol=1e-6)
 
 
+def test_bootstrap_fused_matches_scan_engine(maturities, yields_panel):
+    """The MXU-fused grid loss must agree with the general scan engine on a
+    fully-observed panel — same ridge-select OLS, window, normalization."""
+    from yieldfactormodels_jl_tpu.estimation.bootstrap import (
+        _jitted_grid_loss, _jitted_grid_loss_fused)
+    spec, _ = create_model("NS", tuple(maturities), float_type="float64")
+    p = jnp.asarray(np.concatenate([
+        [np.log(0.5)], [0.3, -0.1, 0.05],
+        np.diag([0.9, 0.85, 0.8]).T.reshape(-1)]))
+    data = jnp.asarray(yields_panel)
+    T = data.shape[1]
+    grid = jnp.asarray([0.2, 0.5, 1.0])
+    gammas = jnp.log(grid - 1e-2)
+    idx = moving_block_indices(jax.random.PRNGKey(3), T, 8, 16)
+    want = np.asarray(_jitted_grid_loss(spec, T)(gammas, idx, p, data))
+    got = np.asarray(_jitted_grid_loss_fused(spec, T)(gammas, idx, p, data))
+    np.testing.assert_allclose(got, want, rtol=1e-9)
+
+
 def test_assoc_scan_matches_sequential_kalman(maturities, yields_panel):
     spec, _ = create_model("1C", tuple(maturities), float_type="float64")
     p = jnp.asarray(_dns_params())

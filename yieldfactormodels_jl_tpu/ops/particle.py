@@ -34,8 +34,8 @@ _LOG_2PI = math.log(2.0 * math.pi)
 
 
 class PFState(NamedTuple):
-    beta: jnp.ndarray   # (P, Ms) per-particle predicted state
-    S: jnp.ndarray      # (P, Ms, Ms) lower square-root factor, P_cov = S Sᵀ
+    beta: jnp.ndarray   # (Ms, P) per-particle predicted state
+    S: jnp.ndarray      # (Ms, Ms, P) lower square-root factor, P_cov = S Sᵀ
     h: jnp.ndarray      # (P,) log-vol
     logw: jnp.ndarray   # (P,) normalized log-weights (logsumexp == 0)
     key: jnp.ndarray
@@ -60,31 +60,33 @@ def _systematic_resample(key, weights, n):
 
 
 def _batched_cholesky(P, Ms: int, floor: float = 1e-12):
-    """Unrolled Cholesky–Banachiewicz of (..., Ms, Ms) PSD matrices — pure
-    elementwise VPU arithmetic over the particle axis (no LAPACK batching,
-    no data-dependent control flow).  Diagonal pivots are floored so a
+    """Unrolled Cholesky–Banachiewicz of (Ms, Ms, particles) PSD matrices —
+    pure elementwise VPU arithmetic over the trailing particle axis (no LAPACK
+    batching, no data-dependent control flow).  The matrix dims LEAD so the
+    big particle axis stays on the TPU lane dimension (a (P, 5, 5) layout
+    leaves 123 of 128 lanes idle).  Diagonal pivots are floored so a
     rounding-level indefiniteness cannot emit NaN; inputs here are
     PSD-by-construction (S Sᵀ products plus a PD Ω), so the floor only ever
     absorbs last-ulp noise."""
     L = [[None] * Ms for _ in range(Ms)]
     for i in range(Ms):
         for j in range(i + 1):
-            s = P[..., i, j]
+            s = P[i, j]
             for k in range(j):
                 s = s - L[i][k] * L[j][k]
             if i == j:
                 L[i][i] = jnp.sqrt(jnp.maximum(s, floor))
             else:
                 L[i][j] = s / L[j][j]
-    rows = [jnp.stack([L[i][j] if j <= i else jnp.zeros_like(P[..., 0, 0])
-                       for j in range(Ms)], axis=-1) for i in range(Ms)]
-    return jnp.stack(rows, axis=-2)
+    rows = [jnp.stack([L[i][j] if j <= i else jnp.zeros_like(P[0, 0])
+                       for j in range(Ms)], axis=0) for i in range(Ms)]
+    return jnp.stack(rows, axis=0)
 
 
 def _kf_particle_step(Z, d, Phi, delta, chol_Om, beta, S, y, r, obs):
     """Square-root measurement+propagate Kalman step for ALL particles.
 
-    ``beta (Pn, Ms)``, ``S (Pn, Ms, Ms)`` the lower factor of the predicted
+    ``beta (Ms, Pn)``, ``S (Ms, Ms, Pn)`` the lower factor of the predicted
     covariance (P = S Sᵀ), ``r (Pn,)`` the per-particle scalar observation
     variance σ²e^{h}.  Because Ω_obs = r·I is diagonal, the update runs as N
     sequential *scalar* Potter square-root updates (the univariate
@@ -94,21 +96,26 @@ def _kf_particle_step(Z, d, Phi, delta, chol_Om, beta, S, y, r, obs):
     keeps every particle's likelihood finite in f32 where the plain
     P-propagating form loses ~18% of draws to rank-1 downdate drift
     (VERDICT round 1, item 3).  The time update re-factors
-    Φ S_m (Φ S_m)ᵀ + Ω with an unrolled elementwise Cholesky."""
+    Φ S_m (Φ S_m)ᵀ + Ω with an unrolled elementwise Cholesky.
+
+    Layout: the particle axis is LAST everywhere so it rides the 128-wide TPU
+    lane dimension; the Ms-sized contractions are written as broadcast
+    multiplies + leading-axis sums (pure elementwise VPU work), never as
+    dot_generals over a 5-long axis."""
     sqrt_r = jnp.sqrt(jnp.maximum(r, 0.0))
 
     def obs_update(carry, zy):
         b_u, S_u, ll, ok = carry
-        z, y_i, d_i = zy
-        phi = S_u.swapaxes(-1, -2) @ z                # (Pn, Ms) = Sᵀz
-        f = jnp.sum(phi * phi, axis=-1) + r           # (Pn,) > 0 always
+        z, y_i, d_i = zy                              # z (Ms,)
+        phi = jnp.sum(S_u * z[:, None, None], axis=0)  # Sᵀz → (Ms, Pn)
+        f = jnp.sum(phi * phi, axis=0) + r            # (Pn,) > 0 always
         fsafe = jnp.where(f > 0, f, 1.0)
         ok = ok & jnp.isfinite(f)
-        v = y_i - d_i - b_u @ z                       # (Pn,)
-        Sphi = jnp.einsum("pij,pj->pi", S_u, phi)     # = P z
-        b_u = b_u + Sphi * (v / fsafe)[:, None]
+        v = y_i - d_i - jnp.sum(b_u * z[:, None], axis=0)   # (Pn,)
+        Sphi = jnp.sum(S_u * phi[None, :, :], axis=1)       # = P z → (Ms, Pn)
+        b_u = b_u + Sphi * (v / fsafe)[None, :]
         alpha = 1.0 / (fsafe + sqrt_r * jnp.sqrt(fsafe))
-        S_u = S_u - alpha[:, None, None] * (Sphi[:, :, None] * phi[:, None, :])
+        S_u = S_u - alpha[None, None, :] * (Sphi[:, None, :] * phi[None, :, :])
         ll = ll - 0.5 * (jnp.log(fsafe) + v * v / fsafe + _LOG_2PI)
         return (b_u, S_u, ll, ok), None
 
@@ -120,9 +127,11 @@ def _kf_particle_step(Z, d, Phi, delta, chol_Om, beta, S, y, r, obs):
         (Z, y, d))
     beta_m = beta + (b_u - beta) * obs
     S_m = S + (S_u - S) * obs
-    beta_next = delta[None, :] + beta_m @ Phi.T
-    A = jnp.einsum("ij,pjk->pik", Phi, S_m)           # Φ S_m
-    P_next = A @ A.swapaxes(-1, -2) + (chol_Om @ chol_Om.T)[None]
+    beta_next = delta[:, None] + jnp.sum(Phi[:, :, None] * beta_m[None, :, :],
+                                         axis=1)
+    A = jnp.sum(Phi[:, :, None, None] * S_m[None, :, :, :], axis=1)  # Φ S_m
+    P_next = (jnp.sum(A[:, None, :, :] * A[None, :, :, :], axis=2)
+              + (chol_Om @ chol_Om.T)[:, :, None])
     S_next = _batched_cholesky(P_next, Phi.shape[0])
     return beta_next, S_next, jnp.where(ok, ll, -jnp.inf)
 
@@ -159,8 +168,8 @@ def particle_filter_loglik(
     fac_ok = jnp.all(jnp.isfinite(S0)) & jnp.all(jnp.isfinite(chol_Om))
     S0 = jnp.where(jnp.isfinite(S0), S0, jnp.eye(Ms, dtype=dtype) * 1e-3)
     chol_Om = jnp.where(jnp.isfinite(chol_Om), chol_Om, jnp.zeros_like(chol_Om))
-    beta0 = jnp.broadcast_to(state0.beta, (Pn,) + state0.beta.shape)
-    S0b = jnp.broadcast_to(S0, (Pn, Ms, Ms))
+    beta0 = jnp.broadcast_to(state0.beta[:, None], (Ms, Pn))
+    S0b = jnp.broadcast_to(S0[:, :, None], (Ms, Ms, Pn))
     h0 = jnp.zeros((Pn,), dtype=dtype)
 
     T = data.shape[1]
@@ -187,8 +196,8 @@ def particle_filter_loglik(
         ess = 1.0 / jnp.sum(wn * wn)
         idx = _systematic_resample(k_res, wn, Pn)
         do_resample = contributes & (ess < ess_threshold * Pn)
-        beta = jnp.where(do_resample, beta[idx], beta)
-        S = jnp.where(do_resample, S[idx], S)
+        beta = jnp.where(do_resample, beta[:, idx], beta)
+        S = jnp.where(do_resample, S[:, :, idx], S)
         h_new = jnp.where(do_resample, h_new[idx], h_new)
         logw_out = jnp.where(do_resample,
                              jnp.full_like(logw_norm, log_uniform), logw_norm)
