@@ -92,6 +92,42 @@ def _jitted_grid_loss_fused(spec: ModelSpec, T: int):
     return jax.jit(fused)
 
 
+def lambda_to_gamma(lam):
+    """γ driver solving λ = 1e-2 + e^γ (dns.jl:55) — the one place the
+    grid's λ-parameterization lives (serial + sharded paths both call it)."""
+    return jnp.log(lam - 1e-2)
+
+
+def grid_losses(spec: ModelSpec, gammas, idx, params, data):
+    """(R, G) loss surface for resample indices ``idx`` and γ drivers
+    ``gammas`` — the engine-dispatch core of :func:`bootstrap_lambda_grid`.
+
+    The MXU-fused kernel is exact for fully-observed static-λ panels (the
+    bootstrap case — resampling a finite panel stays finite); panels with
+    missing columns take the general scan engine.  The finiteness probe
+    needs a concrete panel, so under an outer jit (tracer data) we keep the
+    general engine and stay traceable.  Exposed separately so the mesh layer
+    can shard the resample axis (parallel/mesh.py) without re-deriving the
+    engine choice.
+    """
+    T = data.shape[1]
+    if (spec.family == "static_lambda" and not isinstance(data, jax.core.Tracer)
+            and bool(np.isfinite(np.asarray(data)).all())):
+        fn = _jitted_grid_loss_fused(spec, T)
+    else:
+        fn = _jitted_grid_loss(spec, T)
+    return fn(gammas, idx, jnp.asarray(params, dtype=spec.dtype), data)
+
+
+def grid_stats(losses, n_lambdas: int):
+    """(ci_low, ci_high, selection_freq) of an (R, G) loss surface."""
+    ci_low = jnp.percentile(losses, 2.5, axis=0)
+    ci_high = jnp.percentile(losses, 97.5, axis=0)
+    winner = jnp.argmax(losses, axis=1)
+    freq = jnp.mean(winner[:, None] == jnp.arange(n_lambdas)[None, :], axis=0)
+    return ci_low, ci_high, freq
+
+
 def bootstrap_lambda_grid(
     spec: ModelSpec,
     params,
@@ -113,21 +149,7 @@ def bootstrap_lambda_grid(
     data = jnp.asarray(data, dtype=spec.dtype)
     T = data.shape[1]
     lam = jnp.asarray(lambda_grid, dtype=spec.dtype)
-    gammas = jnp.log(lam - 1e-2)
+    gammas = lambda_to_gamma(lam)
     idx = moving_block_indices(key, T, block_len, n_resamples)
-    # the MXU-fused kernel is exact for fully-observed static-λ panels (the
-    # bootstrap case — resampling a finite panel stays finite); panels with
-    # missing columns take the general scan engine.  The finiteness probe
-    # needs a concrete panel, so under an outer jit (tracer data) we keep the
-    # general engine and stay traceable.
-    if (spec.family == "static_lambda" and not isinstance(data, jax.core.Tracer)
-            and bool(np.isfinite(np.asarray(data)).all())):
-        fn = _jitted_grid_loss_fused(spec, T)
-    else:
-        fn = _jitted_grid_loss(spec, T)
-    losses = fn(gammas, idx, jnp.asarray(params, dtype=spec.dtype), data)  # (R, G)
-    ci_low = jnp.percentile(losses, 2.5, axis=0)
-    ci_high = jnp.percentile(losses, 97.5, axis=0)
-    winner = jnp.argmax(losses, axis=1)
-    freq = jnp.mean(winner[:, None] == jnp.arange(lam.shape[0])[None, :], axis=0)
-    return losses, ci_low, ci_high, freq
+    losses = grid_losses(spec, gammas, idx, params, data)  # (R, G)
+    return (losses,) + grid_stats(losses, lam.shape[0])

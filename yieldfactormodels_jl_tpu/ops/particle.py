@@ -59,27 +59,36 @@ def _systematic_resample(key, weights, n):
     return jnp.searchsorted(cum, positions)
 
 
-def _batched_cholesky(P, Ms: int, floor: float = 1e-12):
-    """Unrolled Cholesky–Banachiewicz of (Ms, Ms, particles) PSD matrices —
-    pure elementwise VPU arithmetic over the trailing particle axis (no LAPACK
-    batching, no data-dependent control flow).  The matrix dims LEAD so the
-    big particle axis stays on the TPU lane dimension (a (P, 5, 5) layout
-    leaves 123 of 128 lanes idle).  Diagonal pivots are floored so a
-    rounding-level indefiniteness cannot emit NaN; inputs here are
-    PSD-by-construction (S Sᵀ products plus a PD Ω), so the floor only ever
-    absorbs last-ulp noise."""
+def _propagate_cholesky(A, Om, Ms: int, floor: float = 1e-12):
+    """Unrolled Cholesky–Banachiewicz of P = A Aᵀ + Ω for (Ms, Ms, particles)
+    factors — pure elementwise VPU arithmetic over the trailing particle axis
+    (no LAPACK batching, no data-dependent control flow).  The matrix dims
+    LEAD so the big particle axis stays on the TPU lane dimension (a
+    (P, 5, 5) layout leaves 123 of 128 lanes idle), and each needed entry of
+    P is formed on demand as a K-term sum of (particles,) products — never as
+    the (Ms, Ms, Ms, particles) broadcast a materialized A Aᵀ would cost.
+    Diagonal pivots are floored so a rounding-level indefiniteness cannot
+    emit NaN; inputs here are PSD-by-construction (S Sᵀ products plus a PD
+    Ω), so the floor only ever absorbs last-ulp noise."""
+    def P(i, j):
+        s = Om[i, j]
+        for k in range(Ms):
+            s = s + A[i, k] * A[j, k]
+        return s
+
     L = [[None] * Ms for _ in range(Ms)]
     for i in range(Ms):
         for j in range(i + 1):
-            s = P[i, j]
+            s = P(i, j)
             for k in range(j):
                 s = s - L[i][k] * L[j][k]
             if i == j:
                 L[i][i] = jnp.sqrt(jnp.maximum(s, floor))
             else:
                 L[i][j] = s / L[j][j]
-    rows = [jnp.stack([L[i][j] if j <= i else jnp.zeros_like(P[0, 0])
-                       for j in range(Ms)], axis=0) for i in range(Ms)]
+    zero = jnp.zeros_like(A[0, 0])
+    rows = [jnp.stack([L[i][j] if j <= i else zero for j in range(Ms)], axis=0)
+            for i in range(Ms)]
     return jnp.stack(rows, axis=0)
 
 
@@ -129,10 +138,14 @@ def _kf_particle_step(Z, d, Phi, delta, chol_Om, beta, S, y, r, obs):
     S_m = S + (S_u - S) * obs
     beta_next = delta[:, None] + jnp.sum(Phi[:, :, None] * beta_m[None, :, :],
                                          axis=1)
-    A = jnp.sum(Phi[:, :, None, None] * S_m[None, :, :, :], axis=1)  # Φ S_m
-    P_next = (jnp.sum(A[:, None, :, :] * A[None, :, :, :], axis=2)
-              + (chol_Om @ chol_Om.T)[:, :, None])
-    S_next = _batched_cholesky(P_next, Phi.shape[0])
+    # A = Φ S_m entry-by-entry: Ms³ scalar×(Pn,) multiply-adds, never the
+    # (Ms, Ms, Ms, Pn) broadcast a materialized product would cost
+    Ms = Phi.shape[0]
+    A = jnp.stack([
+        jnp.stack([sum(Phi[i, j] * S_m[j, k] for j in range(Ms))
+                   for k in range(Ms)], axis=0)
+        for i in range(Ms)], axis=0)
+    S_next = _propagate_cholesky(A, chol_Om @ chol_Om.T, Ms)
     return beta_next, S_next, jnp.where(ok, ll, -jnp.inf)
 
 

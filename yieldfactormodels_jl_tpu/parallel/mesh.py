@@ -124,3 +124,79 @@ def multistart_sharded(spec: ModelSpec, raw_starts, data, mesh: Optional[Mesh] =
     xs, fs, its, convs = fn(jnp.asarray(padded, dtype=spec.dtype), data,
                             jnp.asarray(start), jnp.asarray(end))
     return xs[:n], -fs[:n]
+
+
+@register_engine_cache
+@lru_cache(maxsize=32)
+def _sharded_pf(spec: ModelSpec, T: int, mesh: Mesh, axis_name: str,
+                n_particles: int, sv_phi: float, sv_sigma: float):
+    from ..ops.particle import particle_filter_loglik
+
+    batch = NamedSharding(mesh, P(axis_name, None))
+    repl = NamedSharding(mesh, P())
+    fn = jax.vmap(
+        lambda p, k, data: particle_filter_loglik(
+            spec, p, data, k, n_particles=n_particles,
+            sv_phi=sv_phi, sv_sigma=sv_sigma),
+        in_axes=(0, 0, None))
+    return jax.jit(fn, in_shardings=(batch, batch, repl),
+                   out_shardings=NamedSharding(mesh, P(axis_name)))
+
+
+def particle_filter_sharded(spec: ModelSpec, draws, data, keys=None,
+                            mesh: Optional[Mesh] = None, n_particles: int = 1000,
+                            sv_phi: float = 0.95, sv_sigma: float = 0.2,
+                            axis_name: str = "batch"):
+    """SV particle-filter logliks for a (D, P) draw batch, draw axis sharded.
+
+    BASELINE.md config 3 at multi-chip scale: each chip runs its slice of the
+    1,000 draws (each a full n_particles filter) with zero cross-chip traffic.
+    """
+    if mesh is None:
+        mesh = make_mesh(axis_name=axis_name)
+    data = jnp.asarray(data, dtype=spec.dtype)
+    n_dev = mesh.devices.size
+    draws = np.asarray(draws)
+    if keys is None:
+        keys = jax.random.split(jax.random.PRNGKey(0), draws.shape[0])
+    padded, n = pad_to_multiple(draws, n_dev, axis=0)
+    keys_p, _ = pad_to_multiple(np.asarray(keys), n_dev, axis=0)
+    fn = _sharded_pf(spec, data.shape[1], mesh, axis_name,
+                     n_particles, sv_phi, sv_sigma)
+    out = fn(jnp.asarray(padded, dtype=spec.dtype),
+             jnp.asarray(keys_p, dtype=jnp.uint32), data)
+    return out[:n]
+
+
+def bootstrap_grid_sharded(spec: ModelSpec, params, data, lambda_grid,
+                           n_resamples: int = 2000, block_len: int = 12,
+                           key=None, mesh: Optional[Mesh] = None,
+                           axis_name: str = "batch"):
+    """Block-bootstrap λ-grid (BASELINE.md config 5) with the resample axis
+    sharded across chips.
+
+    The resample indices are placed with a NamedSharding and the cached grid
+    engine (fused MXU kernel for fully-observed static-λ panels) is invoked
+    on them — XLA's computation-follows-data partitioning runs each chip's
+    resample slice locally; padded rows are trimmed BEFORE the CI/selection
+    stats so they cannot bias the percentiles.  Same return contract as
+    ``estimation.bootstrap.bootstrap_lambda_grid``.
+    """
+    from ..estimation.bootstrap import (grid_losses, grid_stats,
+                                        lambda_to_gamma, moving_block_indices)
+
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    if mesh is None:
+        mesh = make_mesh(axis_name=axis_name)
+    data = jnp.asarray(data, dtype=spec.dtype)
+    T = data.shape[1]
+    lam = jnp.asarray(lambda_grid, dtype=spec.dtype)
+    gammas = lambda_to_gamma(lam)
+    idx = np.asarray(moving_block_indices(key, T, block_len, n_resamples))
+    n_dev = mesh.devices.size
+    padded, n = pad_to_multiple(idx, n_dev, axis=0)
+    idx_sharded = jax.device_put(
+        jnp.asarray(padded), NamedSharding(mesh, P(axis_name, None)))
+    losses = grid_losses(spec, gammas, idx_sharded, params, data)[:n]
+    return (losses,) + grid_stats(losses, lam.shape[0])
