@@ -410,3 +410,22 @@ def simulate_dns_panel(rng, maturities, T=80, lam=0.5):
         beta = delta + Phi @ beta + 0.1 * rng.standard_normal(3)
         data[:, t] = Z @ beta + 0.02 * rng.standard_normal(N)
     return data + 5.0
+
+
+def stable_1c_params(spec, dtype=np.float32):
+    """A stationary, finite-loglik parameter point for the 1C (DNS Kalman)
+    spec — λ = 0.5, small obs/state noise, Φ = 0.9 I.  Shared by the sharded
+    particle-filter test and the driver dry run so the chosen stable point
+    lives in exactly one place."""
+    p = np.zeros(spec.n_params, dtype=dtype)
+    p[spec.layout["gamma"][0]] = np.log(0.5)
+    p[spec.layout["obs_var"][0]] = 4e-4
+    a, _ = spec.layout["chol"]
+    rows, cols = spec.chol_indices
+    for k, (r, c) in enumerate(zip(rows, cols)):
+        p[a + k] = 0.05 if r == c else 0.0
+    a, b = spec.layout["delta"]
+    p[a:b] = [5.0, -1.0, 0.5]
+    a, b = spec.layout["phi"]
+    p[a:b] = np.diag([0.9, 0.9, 0.9]).reshape(-1)
+    return p

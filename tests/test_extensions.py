@@ -88,6 +88,27 @@ def test_bootstrap_fused_matches_scan_engine(maturities, yields_panel):
     np.testing.assert_allclose(got, want, rtol=1e-9)
 
 
+def test_bootstrap_nan_panel_takes_general_engine(maturities, yields_panel):
+    """A panel with missing columns must dispatch to the general scan engine
+    (the fused kernel's no-carry identity only holds when every column is
+    observed) and still produce the scan engine's carry-through losses."""
+    from yieldfactormodels_jl_tpu.estimation.bootstrap import (
+        _jitted_grid_loss, grid_losses, lambda_to_gamma, moving_block_indices)
+    spec, _ = create_model("NS", tuple(maturities), float_type="float64")
+    p = jnp.asarray(np.concatenate([
+        [np.log(0.5)], [0.3, -0.1, 0.05],
+        np.diag([0.9, 0.85, 0.8]).T.reshape(-1)]))
+    data = np.asarray(yields_panel).copy()
+    data[:, 7] = np.nan  # a fully-missing column → unobserved carry step
+    data = jnp.asarray(data)
+    T = data.shape[1]
+    gammas = lambda_to_gamma(jnp.asarray([0.3, 0.8]))
+    idx = moving_block_indices(jax.random.PRNGKey(5), T, 8, 6)
+    got = np.asarray(grid_losses(spec, gammas, idx, p, data))
+    want = np.asarray(_jitted_grid_loss(spec, T)(gammas, idx, p, data))
+    np.testing.assert_array_equal(got, want)
+
+
 def test_bootstrap_traceable_under_jit(maturities, yields_panel):
     """bootstrap_lambda_grid must stay jit-wrappable: with tracer data the
     concrete-finiteness gate is skipped and the general engine runs."""

@@ -61,21 +61,12 @@ def test_sharded_multistart_runs_and_improves():
 def test_sharded_particle_filter_matches_serial():
     """Draw-axis sharding must reproduce the single-device PF logliks
     exactly (same keys ⇒ same resampling path per draw)."""
+    from tests.oracle import stable_1c_params
     from yieldfactormodels_jl_tpu.ops.particle import particle_filter_loglik
 
     spec, _ = create_model("1C", MATS, float_type="float64")
     data = _panel(T=24)
-    p = np.zeros(spec.n_params)
-    p[0] = np.log(0.5)
-    p[1] = 4e-4
-    a, b = spec.layout["chol"]
-    rows, cols = spec.chol_indices
-    for k, (r, c) in enumerate(zip(rows, cols)):
-        p[a + k] = 0.05 if r == c else 0.0
-    a, b = spec.layout["delta"]
-    p[a:b] = [5.0, -1.0, 0.5]
-    a, b = spec.layout["phi"]
-    p[a:b] = np.diag([0.9, 0.9, 0.9]).reshape(-1)
+    p = stable_1c_params(spec, dtype=np.float64)
     draws = np.tile(p, (5, 1))  # non-multiple of 8 → padding
     draws += np.random.default_rng(1).uniform(-0.01, 0.01, draws.shape)
     keys = np.asarray(jax.random.split(jax.random.PRNGKey(7), 5))

@@ -159,8 +159,15 @@ def particle_filter_sharded(spec: ModelSpec, draws, data, keys=None,
     draws = np.asarray(draws)
     if keys is None:
         keys = jax.random.split(jax.random.PRNGKey(0), draws.shape[0])
+    keys = np.asarray(keys)
+    if keys.shape[0] != draws.shape[0]:
+        raise ValueError(
+            f"particle_filter_sharded: {draws.shape[0]} draws but "
+            f"{keys.shape[0]} keys — each draw needs its own PRNG key "
+            f"(independent padding would silently pair draws with repeated "
+            f"keys)")
     padded, n = pad_to_multiple(draws, n_dev, axis=0)
-    keys_p, _ = pad_to_multiple(np.asarray(keys), n_dev, axis=0)
+    keys_p, _ = pad_to_multiple(keys, n_dev, axis=0)
     fn = _sharded_pf(spec, data.shape[1], mesh, axis_name,
                      n_particles, sv_phi, sv_sigma)
     out = fn(jnp.asarray(padded, dtype=spec.dtype),
