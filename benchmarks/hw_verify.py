@@ -168,6 +168,51 @@ def main() -> int:
         tag = f"grad[{code}{', per-lane' if win else ''}]"
         check(tag, vals_ok and grads_ok, detail)
 
+    # ---- SV particle filter: σ_h → 0 collapse to the exact Kalman loglik ----
+    # (Mosaic isn't involved, but the lane-major layout + resample gathers are
+    # exactly the parts whose XLA:TPU lowering differs from CPU)
+    from yieldfactormodels_jl_tpu.ops.particle import particle_filter_loglik
+
+    spec, _ = create_model("1C", mats, float_type="float32")
+    pf_B = 2 if interpret else 16
+    pf_P = 8 if interpret else 256
+    p = jnp.asarray(params_for(spec)[:pf_B], jnp.float32)
+    fin = jnp.asarray(np.nan_to_num(data, nan=4.0))
+    kf = np.asarray(jax.jit(jax.vmap(
+        lambda q: univariate_kf.get_loss(spec, q, fin)))(p))
+    pf = np.asarray(jax.jit(jax.vmap(
+        lambda q, k: particle_filter_loglik(
+            spec, q, fin, k, n_particles=pf_P, sv_phi=0.0, sv_sigma=0.0)))(
+        p, jax.random.split(jax.random.PRNGKey(0), pf_B)))
+    both = np.isfinite(kf) & np.isfinite(pf)
+    same_sentinels = bool(np.array_equal(np.isfinite(kf), np.isfinite(pf)))
+    check("pf[1C, sv->0 collapse]",
+          bool(both.any()) and same_sentinels
+          and np.allclose(pf[both], kf[both], rtol=2e-3),
+          f"finite {int(both.sum())}/{pf_B}, sentinels_match {same_sentinels}, "
+          f"maxrel {np.max(np.abs(pf[both]-kf[both])/np.abs(kf[both])):.2e}"
+          if both.any() else "no finite lanes")
+
+    # ---- bootstrap λ-grid: MXU-fused engine vs general scan engine ----
+    from yieldfactormodels_jl_tpu.estimation.bootstrap import (
+        _jitted_grid_loss, _jitted_grid_loss_fused, lambda_to_gamma,
+        moving_block_indices)
+
+    from tests.oracle import stable_ns_params
+
+    nspec, _ = create_model("NS", mats, float_type="float32")
+    np_ = stable_ns_params(nspec)
+    R = 4 if interpret else 128
+    gam = lambda_to_gamma(jnp.asarray([0.3, 0.6, 0.9], jnp.float32))
+    idx = moving_block_indices(jax.random.PRNGKey(2), fin.shape[1], 8, R)
+    args = (gam, idx, jnp.asarray(np_), fin)
+    want = np.asarray(_jitted_grid_loss(nspec, fin.shape[1])(*args))
+    got = np.asarray(_jitted_grid_loss_fused(nspec, fin.shape[1])(*args))
+    check("bootstrap[NS, fused vs scan]",
+          np.isfinite(got).all() and np.allclose(got, want, rtol=2e-3,
+                                                 atol=1e-5),
+          f"maxabs {np.max(np.abs(got-want)):.2e}")
+
     print(f"# platform={platform} interpret={interpret} "
           f"{'ALL PASS' if failures == 0 else f'{failures} FAILURES'}")
     return 1 if failures else 0

@@ -429,3 +429,18 @@ def stable_1c_params(spec, dtype=np.float32):
     a, b = spec.layout["phi"]
     p[a:b] = np.diag([0.9, 0.9, 0.9]).reshape(-1)
     return p
+
+
+def stable_ns_params(spec, dtype=np.float32):
+    """A stable parameter point for the NS (static-λ) spec — λ = 0.5, level
+    curve deltas, Φ diag (0.9, 0.85, 0.8).  Shared by the bootstrap parity
+    tests and benchmarks/hw_verify.py so the point lives in exactly one
+    place (same rationale as stable_1c_params)."""
+    p = np.zeros(spec.n_params, dtype=dtype)
+    a, b = spec.layout["gamma"]
+    p[a:b] = np.log(0.5)
+    a, b = spec.layout["delta"]
+    p[a:b] = [0.3, -0.1, 0.05]
+    a, b = spec.layout["phi"]
+    p[a:b] = np.diag([0.9, 0.85, 0.8]).T.reshape(-1)
+    return p

@@ -12,6 +12,8 @@ from yieldfactormodels_jl_tpu.estimation.bootstrap import (
 from yieldfactormodels_jl_tpu.ops import assoc_scan
 from yieldfactormodels_jl_tpu.ops.particle import particle_filter_loglik
 
+from tests import oracle
+
 
 def _dns_params():
     p = np.zeros(20)
@@ -75,9 +77,7 @@ def test_bootstrap_fused_matches_scan_engine(maturities, yields_panel):
     from yieldfactormodels_jl_tpu.estimation.bootstrap import (
         _jitted_grid_loss, _jitted_grid_loss_fused)
     spec, _ = create_model("NS", tuple(maturities), float_type="float64")
-    p = jnp.asarray(np.concatenate([
-        [np.log(0.5)], [0.3, -0.1, 0.05],
-        np.diag([0.9, 0.85, 0.8]).T.reshape(-1)]))
+    p = jnp.asarray(oracle.stable_ns_params(spec, dtype=np.float64))
     data = jnp.asarray(yields_panel)
     T = data.shape[1]
     grid = jnp.asarray([0.2, 0.5, 1.0])
@@ -95,9 +95,7 @@ def test_bootstrap_nan_panel_takes_general_engine(maturities, yields_panel):
     from yieldfactormodels_jl_tpu.estimation.bootstrap import (
         _jitted_grid_loss, grid_losses, lambda_to_gamma, moving_block_indices)
     spec, _ = create_model("NS", tuple(maturities), float_type="float64")
-    p = jnp.asarray(np.concatenate([
-        [np.log(0.5)], [0.3, -0.1, 0.05],
-        np.diag([0.9, 0.85, 0.8]).T.reshape(-1)]))
+    p = jnp.asarray(oracle.stable_ns_params(spec, dtype=np.float64))
     data = np.asarray(yields_panel).copy()
     data[:, 7] = np.nan  # a fully-missing column → unobserved carry step
     data = jnp.asarray(data)
