@@ -444,3 +444,39 @@ def stable_ns_params(spec, dtype=np.float32):
     a, b = spec.layout["phi"]
     p[a:b] = np.diag([0.9, 0.85, 0.8]).T.reshape(-1)
     return p
+
+
+def rts_smoother(Z, Phi, delta, Omega_state, obs_var, data):
+    """Forward KF (library scan conventions: one step per column, masked
+    update on NaN columns) + RTS backward pass.  Returns (beta_smooth (T, Ms),
+    P_smooth (T, Ms, Ms), beta_filt, P_filt)."""
+    N, T = data.shape
+    Ms = Phi.shape[0]
+    Omega_obs = obs_var * np.eye(N)
+    beta, P = kalman_init(Phi, delta, Omega_state)
+    b_pred, P_pred, b_upd, P_upd = [], [], [], []
+    for t in range(T):
+        y = data[:, t]
+        b_pred.append(beta.copy())
+        P_pred.append(P.copy())
+        if np.all(np.isfinite(y)):
+            v = y - Z @ beta
+            F = Z @ P @ Z.T + Omega_obs
+            K = P @ Z.T @ np.linalg.inv(F)
+            bu = beta + K @ v
+            Pu = (np.eye(Ms) - K @ Z) @ P
+        else:
+            bu, Pu = beta.copy(), P.copy()
+        b_upd.append(bu)
+        P_upd.append(Pu)
+        beta = delta + Phi @ bu
+        P = Phi @ Pu @ Phi.T + Omega_state
+    bs = [None] * T
+    Ps = [None] * T
+    bs[T - 1], Ps[T - 1] = b_upd[T - 1], P_upd[T - 1]
+    for t in range(T - 2, -1, -1):
+        G = P_upd[t] @ Phi.T @ np.linalg.inv(P_pred[t + 1])
+        bs[t] = b_upd[t] + G @ (bs[t + 1] - b_pred[t + 1])
+        Ps[t] = P_upd[t] + G @ (Ps[t + 1] - P_pred[t + 1]) @ G.T
+    return (np.asarray(bs), np.asarray(Ps),
+            np.asarray(b_upd), np.asarray(P_upd))

@@ -1,0 +1,95 @@
+"""RTS smoother (ops/smoother.py) vs an independent NumPy oracle."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from yieldfactormodels_jl_tpu import create_model
+from yieldfactormodels_jl_tpu.models.params import unpack_kalman
+from yieldfactormodels_jl_tpu.ops import smoother
+
+from tests import oracle
+from tests.oracle import stable_1c_params
+
+
+def _dns_case(maturities, yields_panel, with_nan=False):
+    spec, _ = create_model("1C", tuple(maturities), float_type="float64")
+    p = jnp.asarray(stable_1c_params(spec, dtype=np.float64))
+    data = np.asarray(yields_panel[:, :40]).copy()
+    if with_nan:
+        data[:, 11] = np.nan
+    return spec, p, data
+
+
+@pytest.mark.parametrize("with_nan", [False, True])
+def test_rts_matches_oracle(maturities, yields_panel, with_nan):
+    spec, p, data = _dns_case(maturities, yields_panel, with_nan)
+    out = smoother.smooth(spec, p, jnp.asarray(data))
+    kp = unpack_kalman(spec, p)
+    Z = oracle.dns_loadings(float(kp.gamma[0]), np.asarray(maturities))
+    bs, Ps, bf, Pf = oracle.rts_smoother(
+        Z, np.asarray(kp.Phi), np.asarray(kp.delta),
+        np.asarray(kp.Omega_state), float(kp.obs_var), data)
+    np.testing.assert_allclose(np.asarray(out["beta_smooth"]).T, bs, rtol=1e-8,
+                               atol=1e-10)
+    np.testing.assert_allclose(np.asarray(out["P_smooth"]), Ps, rtol=1e-8,
+                               atol=1e-12)
+    np.testing.assert_allclose(np.asarray(out["beta_filt"]).T, bf, rtol=1e-8,
+                               atol=1e-10)
+
+
+def test_rts_final_step_equals_filter_and_shrinks_variance(maturities, yields_panel):
+    spec, p, data = _dns_case(maturities, yields_panel)
+    out = smoother.smooth(spec, p, jnp.asarray(data))
+    # β_{T−1|T} == β_{T−1|T−1} by construction
+    np.testing.assert_allclose(np.asarray(out["beta_smooth"])[:, -1],
+                               np.asarray(out["beta_filt"])[:, -1], rtol=1e-12)
+    # smoothing never inflates uncertainty: tr(P_{t|T}) ≤ tr(P_{t|t}) + ulp
+    tr_s = np.trace(np.asarray(out["P_smooth"]), axis1=1, axis2=2)
+    tr_f = np.trace(np.asarray(out["P_filt"]), axis1=1, axis2=2)
+    assert np.all(tr_s <= tr_f + 1e-12)
+
+
+def test_rts_tvl_ekf_runs(maturities, yields_panel):
+    """The backward pass is measurement-free, so the TVλ EKF smooths with the
+    same code; pin shapes, finiteness, and the final-step identity."""
+    spec, _ = create_model("TVλ", tuple(maturities), float_type="float64")
+    p = np.zeros(spec.n_params)
+    a, b = spec.layout["obs_var"]
+    p[a:b] = 4e-4
+    a, _ = spec.layout["chol"]
+    rows, cols = spec.chol_indices
+    for k, (r, c) in enumerate(zip(rows, cols)):
+        p[a + k] = 0.05 if r == c else 0.0
+    a, b = spec.layout["delta"]
+    p[a:b] = [5.0, -1.0, 0.5, np.log(0.5)]
+    a, b = spec.layout["phi"]
+    p[a:b] = np.diag([0.9, 0.9, 0.9, 0.95]).reshape(-1)
+    data = jnp.asarray(yields_panel[:, :30])
+    out = smoother.smooth(spec, jnp.asarray(p), data)
+    assert np.asarray(out["beta_smooth"]).shape == (4, 30)
+    assert np.isfinite(np.asarray(out["beta_smooth"])).all()
+    assert np.isfinite(np.asarray(out["P_smooth"])).all()
+    np.testing.assert_allclose(np.asarray(out["beta_smooth"])[:, -1],
+                               np.asarray(out["beta_filt"])[:, -1], rtol=1e-12)
+
+
+def test_rts_poisons_output_on_filter_failure(maturities, yields_panel):
+    """A non-stationary Φ breaks the forward Cholesky (get_loss → −Inf); the
+    smoother must return NaN moments, not finite garbage."""
+    spec, _ = create_model("1C", tuple(maturities), float_type="float64")
+    p = np.asarray(stable_1c_params(spec, dtype=np.float64))
+    a, b = spec.layout["phi"]
+    p[a:b] = np.diag([1.5, 1.5, 1.5]).reshape(-1)  # explosive transition
+    from yieldfactormodels_jl_tpu import get_loss
+    data = jnp.asarray(yields_panel[:, :30])
+    assert float(get_loss(spec, jnp.asarray(p), data)) == -np.inf
+    out = smoother.smooth(spec, jnp.asarray(p), data)
+    assert np.isnan(np.asarray(out["beta_smooth"])).all()
+    assert np.isnan(np.asarray(out["P_smooth"])).all()
+
+
+def test_rts_rejects_non_kalman(maturities):
+    spec, _ = create_model("NS", tuple(maturities), float_type="float64")
+    with pytest.raises(ValueError, match="Kalman"):
+        smoother.smooth(spec, jnp.zeros(spec.n_params), jnp.zeros((len(maturities), 5)))

@@ -136,6 +136,12 @@ def _step(spec: ModelSpec, kp: KalmanParams, Z_const, d_const, state: KalmanStat
         "beta_after": beta_next,
         "Z2": Z[:, 1],
         "Z3": Z[:, 2],
+        # filtering moments for the RTS backward pass (ops/smoother.py);
+        # XLA dead-code-eliminates these from callers that don't use them
+        "beta_pred": beta,
+        "P_pred": P,
+        "beta_upd": beta_upd,
+        "P_upd": P_upd,
     }
     return KalmanState(beta_next, P_next), outs
 

@@ -1,0 +1,81 @@
+"""Rauch–Tung–Striebel (fixed-interval) smoother for the Kalman families.
+
+A capability beyond the reference (which only filters —
+/root/reference/src/models/kalman/filter.jl has no backward pass): smoothed
+state estimates β_{t|T} and covariances P_{t|T} for every t, as a forward
+`lax.scan` (the existing filter, whose per-step filtering moments ride along
+as scan outputs) followed by a reverse `lax.scan` over the standard RTS
+recursion
+
+    G_t   = P_{t|t} Φᵀ P_{t+1|t}⁻¹
+    β_{t|T} = β_{t|t} + G_t (β_{t+1|T} − β_{t+1|t})
+    P_{t|T} = P_{t|t} + G_t (P_{t+1|T} − P_{t+1|t}) G_tᵀ
+
+The backward pass is measurement-free (only Φ and the filtering moments
+enter), so it covers the constant-loading families AND the TVλ EKF with the
+same code — the linearization only affected the forward pass.  Missing
+columns (NaN) are handled by the filter's masked update (predicted == updated
+on unobserved steps), so smoothing across data gaps needs no special casing.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..models import kalman as K
+from ..models.specs import ModelSpec
+
+
+def smooth(spec: ModelSpec, params, data, start=0, end=None):
+    """Smoothed moments for every t of the panel.
+
+    Returns a dict:
+      ``beta_smooth`` (Ms, T), ``P_smooth`` (T, Ms, Ms) — β_{t|T}, P_{t|T};
+      ``beta_filt`` (Ms, T), ``P_filt`` (T, Ms, Ms) — the filtered β_{t|t},
+      P_{t|t} for comparison (equal to the smoothed values at t = T−1).
+    """
+    if not spec.is_kalman:
+        raise ValueError(
+            f"smooth: RTS smoothing needs a state-space covariance recursion; "
+            f"family {spec.family!r} is not a Kalman family")
+    data = jnp.asarray(data, dtype=spec.dtype)
+    T = data.shape[1]
+    if end is None:
+        end = T
+    kp, _, _, outs = K._scan_filter(spec, params, data, start, end)
+
+    b_pred, P_pred = outs["beta_pred"], outs["P_pred"]    # (T, Ms), (T, Ms, Ms)
+    b_upd, P_upd = outs["beta_upd"], outs["P_upd"]
+
+    def backward(carry, inp):
+        bs, Ps = carry
+        b_u, P_u, b_p1, P_p1 = inp
+        # G = P_upd Φᵀ P_pred₊₁⁻¹  via a PD solve: P_pred₊₁ X = Φ P_updᵀ
+        P_p1s = 0.5 * (P_p1 + P_p1.swapaxes(-1, -2))
+        G = jnp.linalg.solve(P_p1s, kp.Phi @ P_u.swapaxes(-1, -2)).swapaxes(-1, -2)
+        b_new = b_u + G @ (bs - b_p1)
+        P_new = P_u + G @ (Ps - P_p1) @ G.swapaxes(-1, -2)
+        return (b_new, P_new), (b_new, P_new)
+
+    # seed with the LAST filtered moments; sweep t = T−2 .. 0
+    init = (b_upd[-1], P_upd[-1])
+    (_, _), (bs_rev, Ps_rev) = lax.scan(
+        backward, init,
+        (b_upd[:-1], P_upd[:-1], b_pred[1:], P_pred[1:]),
+        reverse=True)
+    beta_smooth = jnp.concatenate([bs_rev, b_upd[-1:]], axis=0)
+    P_smooth = jnp.concatenate([Ps_rev, P_upd[-1:]], axis=0)
+    # sentinel convention: a failed forward Cholesky surfaces as ll = −Inf in
+    # the filter (kalman._step); the moments it produced are meaningless, so
+    # poison the whole output with NaN instead of returning finite garbage
+    # (mirrors get_loss's −Inf and the particle filter's draw-level −Inf)
+    ok = jnp.all(outs["ll"] > -jnp.inf)
+    nan = jnp.asarray(jnp.nan, dtype=beta_smooth.dtype)
+    return {
+        "beta_smooth": jnp.where(ok, beta_smooth.T, nan),
+        "P_smooth": jnp.where(ok, P_smooth, nan),
+        "beta_filt": jnp.where(ok, b_upd.T, nan),
+        "P_filt": jnp.where(ok, P_upd, nan),
+    }
