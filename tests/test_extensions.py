@@ -48,6 +48,30 @@ def test_particle_filter_sv_estimates_are_stable(maturities, yields_panel):
     assert np.std(lls) < 0.05 * abs(np.mean(lls))  # RB keeps MC noise small
 
 
+def test_estimate_sv_improves_pf_loglik(maturities, yields_panel):
+    """Simulated MLE (common-random-numbers Nelder–Mead over the PF loglik)
+    must improve on its starts and report the best start's loglik."""
+    from yieldfactormodels_jl_tpu.estimation.sv import estimate_sv
+    from yieldfactormodels_jl_tpu.models.params import (transform_params,
+                                                       untransform_params)
+
+    spec, _ = create_model("1C", tuple(maturities), float_type="float64")
+    raw = np.asarray(untransform_params(spec, jnp.asarray(_dns_params())))
+    rng = np.random.default_rng(2)
+    starts = raw[None, :] + 0.05 * rng.standard_normal((2, raw.shape[0]))
+    data = jnp.asarray(yields_panel[:, :30])
+    key = jax.random.PRNGKey(4)
+    kw = dict(n_particles=32, sv_phi=0.9, sv_sigma=0.2)
+    best, best_ll, lls, iters = estimate_sv(spec, data, starts, key=key,
+                                            max_iters=40, **kw)
+    assert np.isfinite(best_ll) and best_ll == np.nanmax(lls)
+    # the optimized loglik beats both raw starts under the SAME key
+    start_lls = [float(particle_filter_loglik(
+        spec, transform_params(spec, jnp.asarray(s)), data, key, **kw))
+        for s in starts]
+    assert best_ll >= max(start_lls) - 1e-9
+
+
 def test_moving_block_indices_shape_and_range():
     idx = np.asarray(moving_block_indices(jax.random.PRNGKey(0), 50, 12, 7))
     assert idx.shape == (7, 50)

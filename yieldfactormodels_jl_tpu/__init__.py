@@ -34,6 +34,7 @@ from .models.api import (
     get_loss,
     get_loss_array,
     predict,
+    smooth,
     update_factor_loadings,
     random_initial_params,
 )
@@ -60,6 +61,7 @@ __all__ = [
     "get_loss",
     "get_loss_array",
     "predict",
+    "smooth",
     "update_factor_loadings",
     "random_initial_params",
     "transform_params",

@@ -1,3 +1,12 @@
 from . import optimize, neldermead
 
-__all__ = ["optimize", "neldermead"]
+__all__ = ["optimize", "neldermead", "bootstrap", "sv"]
+
+
+def __getattr__(name):
+    # lazy: bootstrap/sv pull in the particle filter / grid engines
+    if name in __all__:
+        import importlib
+
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(name)

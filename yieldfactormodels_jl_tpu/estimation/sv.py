@@ -1,0 +1,92 @@
+"""MLE for the stochastic-volatility measurement-error extension.
+
+The SV model (ops/particle.py) has no closed-form likelihood; the particle
+filter provides a Monte-Carlo estimate.  Estimation here is simulated maximum
+likelihood with **common random numbers**: one fixed PRNG key is reused for
+every objective evaluation, making the estimated likelihood surface a
+deterministic function of the parameters, so the gradient-free Nelder–Mead
+simplex (estimation/neldermead.py — resampling makes the PF loglik piecewise
+constant in places, and AD through systematic resampling is biased) descends
+a fixed surface instead of chasing Monte-Carlo noise.
+
+Multi-start: the whole simplex search is vmapped over the start axis — every
+(start × simplex-vertex) particle filter runs in one device program, the same
+batching thesis as estimation/optimize.py.  Beyond-reference capability
+(the reference has no SV model); conventions follow kalman/filter.jl:190-195
+via particle_filter_loglik.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import register_engine_cache
+from ..models.params import transform_params
+from ..models.specs import ModelSpec
+from ..ops.particle import particle_filter_loglik
+from .neldermead import nelder_mead
+
+_PENALTY = 1e12
+
+
+@register_engine_cache
+@lru_cache(maxsize=32)
+def _jitted_sv_search(spec: ModelSpec, T: int, n_particles: int,
+                      sv_phi: float, sv_sigma: float, max_iters: int,
+                      f_tol: float):
+    def single(raw0, data, key):
+        def obj(raw):
+            ll = particle_filter_loglik(
+                spec, transform_params(spec, raw), data, key,
+                n_particles=n_particles, sv_phi=sv_phi, sv_sigma=sv_sigma)
+            return jnp.where(jnp.isfinite(ll), -ll, _PENALTY)
+
+        return nelder_mead(obj, raw0, max_iters=max_iters, f_tol=f_tol)
+
+    return jax.jit(jax.vmap(single, in_axes=(0, None, None)))
+
+
+def estimate_sv(
+    spec: ModelSpec,
+    data,
+    raw_starts,
+    key=None,
+    n_particles: int = 200,
+    sv_phi: float = 0.95,
+    sv_sigma: float = 0.2,
+    max_iters: int = 200,
+    f_tol: float = 1e-6,
+):
+    """Multi-start simulated MLE under SV measurement errors.
+
+    ``raw_starts`` is (S, P) (or (P,)) of UNCONSTRAINED parameters.  Returns
+    ``(best_params_constrained, best_ll, lls (S,), iters (S,))`` with the PF
+    loglik evaluated at the shared common-random-numbers key.
+    """
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    data = jnp.asarray(data, dtype=spec.dtype)
+    raw_starts = jnp.asarray(raw_starts, dtype=spec.dtype)
+    if raw_starts.ndim == 1:
+        raw_starts = raw_starts[None, :]
+    fn = _jitted_sv_search(spec, data.shape[1], n_particles,
+                           float(sv_phi), float(sv_sigma), int(max_iters),
+                           float(f_tol))
+    xs, fs, iters = fn(raw_starts, data, key)
+    lls = -np.asarray(fs, dtype=np.float64)
+    lls[lls <= -_PENALTY * 0.99] = -np.inf
+    if not np.isfinite(lls).any():
+        # loud failure (optimization.jl:244-250 semantics): every start sat on
+        # the penalty plateau — returning any simplex endpoint as "best" would
+        # hand the caller garbage estimates
+        raise RuntimeError(
+            f"estimate_sv: PF loglik was non-finite at every point of all "
+            f"{lls.shape[0]} simplex searches — starts/model/data are "
+            f"structurally incompatible")
+    best_j = int(np.argmax(np.where(np.isfinite(lls), lls, -np.inf)))
+    best = transform_params(spec, xs[best_j])
+    return np.asarray(best), float(lls[best_j]), lls, np.asarray(iters)

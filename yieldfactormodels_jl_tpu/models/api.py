@@ -66,6 +66,21 @@ def predict(spec: ModelSpec, params, data):
     return _engine(spec).predict(spec, params, data)
 
 
+def smooth(spec: ModelSpec, params, data, start=0, end=None):
+    """Fixed-interval RTS smoothed moments β_{t|T}, P_{t|T} (Kalman families
+    only — see ops/smoother.py; beyond-reference capability).
+
+    Engine note: the forward pass is always the joint-covariance recursion
+    (models/kalman.py) regardless of ``set_kalman_engine`` — the RTS backward
+    pass consumes full P_{t|t}/P_{t+1|t} matrices, which the univariate/sqrt/
+    assoc loglik engines do not emit.  A failed f32 forward Cholesky poisons
+    the output with NaN; rerun in float64 in that case (the loglik engines'
+    f32 robustness does not transfer here)."""
+    from ..ops import smoother
+
+    return smoother.smooth(spec, params, data, start, end)
+
+
 def init_state(spec: ModelSpec, params):
     """The scan carry the filter starts from (β₀/γ₀/P₀...)."""
     up = unpack(spec, params)
