@@ -101,8 +101,10 @@ def _run_config(name: str, scale: int):
         data = jnp.asarray(common.afns5_panel(), dtype=spec.dtype)
         D = max(1, 1000 // scale)
         # chunk the draw axis: 1000 draws x 1000 particles at once exhausts
-        # HBM; 250-draw chunks are the stable envelope
-        CH = min(D, 250)
+        # HBM; 250-draw chunks are the stable envelope for the round-1 layout
+        # (the lane-major kernel's smaller intermediates may admit more —
+        # override with BENCH_PF_CHUNK to probe)
+        CH = min(D, max(1, int(os.environ.get("BENCH_PF_CHUNK", "250"))))
         D = (D // CH) * CH
         draws = common.stationary_draws(spec, common.afns5_params(spec), D,
                                         scale=0.02)
