@@ -17,7 +17,6 @@ Minimizes ``fun``.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Callable, NamedTuple
 
 import jax

@@ -23,7 +23,7 @@ NelderMead to the jittable implementation in ``neldermead.py``, Adam to
 
 from __future__ import annotations
 
-from functools import lru_cache, partial
+from functools import lru_cache
 from typing import Dict, NamedTuple, Optional, Sequence, Tuple
 
 import jax

@@ -26,7 +26,7 @@ from __future__ import annotations
 import os
 import secrets
 from functools import lru_cache
-from typing import Optional, Sequence
+from typing import Sequence
 
 import jax
 import jax.numpy as jnp

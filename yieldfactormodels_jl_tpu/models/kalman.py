@@ -30,7 +30,6 @@ truncating the sample — that is what makes rolling windows a pure vmap axis.
 from __future__ import annotations
 
 import math
-from functools import partial
 from typing import NamedTuple
 
 import jax

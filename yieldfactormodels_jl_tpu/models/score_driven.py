@@ -25,7 +25,6 @@ without the reference's `Ref{Any}` dual-buffer machinery.
 
 from __future__ import annotations
 
-import math
 from typing import NamedTuple
 
 import jax

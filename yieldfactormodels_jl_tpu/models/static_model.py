@@ -12,7 +12,6 @@ set_params!, static/paramteroperations.jl:42).
 
 from __future__ import annotations
 
-from typing import NamedTuple
 
 import jax.numpy as jnp
 from jax import lax

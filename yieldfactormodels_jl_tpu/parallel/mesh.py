@@ -17,7 +17,7 @@ SPMD sharding of the batch axis, not point-to-point messaging.
 from __future__ import annotations
 
 from functools import lru_cache
-from typing import Optional, Sequence
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

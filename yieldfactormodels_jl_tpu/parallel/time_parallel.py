@@ -20,7 +20,7 @@ device's step-sequential latency and log-depth across the mesh.
 
 from __future__ import annotations
 
-from functools import lru_cache, partial
+from functools import lru_cache
 
 import jax
 import jax.numpy as jnp

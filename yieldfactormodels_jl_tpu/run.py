@@ -14,7 +14,7 @@ default — all as the reference hard-codes (:262, :238, :227).
 from __future__ import annotations
 
 import os
-from typing import Optional, Sequence
+from typing import Sequence
 
 import jax.numpy as jnp
 import numpy as np
