@@ -65,6 +65,25 @@ def test_run_placeholder_returns_none(tmp_path, monkeypatch):
     assert run("1", 30, 3, False, "pC", "float64", scratch_dir=scratch) is None
 
 
+def test_run_rolling_batched_windows_end_to_end(tmp_path, monkeypatch):
+    """run(batched_windows=True): the QUICKSTART-advertised device-batched
+    rolling path, wired through the full driver (estimate → predict → shards
+    → merged DB → legacy CSV)."""
+    monkeypatch.chdir(tmp_path)
+    scratch = str(tmp_path) + os.sep
+    _write_data(scratch, T=36)
+    run("1", 32, 3, True, "NS", "float64",
+        window_type="expanding", run_optimization=False,
+        batched_windows=True, scratch_dir=scratch)
+    res = os.path.join(scratch, "YieldFactorModels.jl", "results", "thread_id__1", "NS")
+    merged = os.path.join(res, "db", "forecasts_expanding_merged.sqlite3")
+    assert os.path.isfile(merged)
+    csv = os.path.join(res, "NS__thread_id__1__expanding_window_forecasts.csv")
+    arr = np.loadtxt(csv, delimiter=",")
+    assert arr.shape == (5 * 3, 2 + len(MATS_MONTHS))
+    assert np.isfinite(arr).all()
+
+
 def test_run_rolling_rw_end_to_end(tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     scratch = str(tmp_path) + os.sep
