@@ -1,6 +1,6 @@
 from . import optimize, neldermead
 
-__all__ = ["optimize", "neldermead", "bootstrap", "sv"]
+__all__ = ["optimize", "neldermead", "bootstrap", "sv", "inference"]
 
 
 def __getattr__(name):
