@@ -35,7 +35,13 @@ def fitted_1c():
     p[b0:b1] = [0.55, -0.1, 0.05]
     b0, b1 = spec.layout["phi"]
     p[b0:b1] = np.diag([0.95, 0.9, 0.85]).reshape(-1)
-    _, ll, best, conv = optimize.estimate(spec, data, p[:, None], max_iters=800)
+    # polish with LBFGS restarts (each restart resets the memory pairs):
+    # the ΔLL stop can park ~0.5 SE from the optimum after one pass
+    best = p
+    for _ in range(3):
+        _, ll, best, conv = optimize.estimate(spec, data,
+                                              np.asarray(best)[:, None],
+                                              max_iters=800)
     assert conv.converged and np.isfinite(ll)
     return spec, np.asarray(best), data
 
@@ -51,6 +57,39 @@ def test_se_all_finite_and_recovers_lambda(fitted_1c):
     lam_hat = 1e-2 + np.exp(best[0])
     se_lam = np.exp(best[0]) * se[0]
     assert abs(lam_hat - 0.5) < 3 * se_lam + 1e-9
+
+
+def test_sandwich_se_close_to_hessian_on_wellspecified_dgp(fitted_1c):
+    """Information equality: on a correctly-specified Gaussian DGP the
+    sandwich H⁻¹BH⁻¹ and the plain H⁻¹ agree up to sampling noise.  Also the
+    score contributions must sum to ≈0 at the optimum (first-order cond.)."""
+    from yieldfactormodels_jl_tpu.estimation.inference import (
+        _jitted_score_contributions)
+    from yieldfactormodels_jl_tpu.models.params import untransform_params as utp
+
+    spec, best, data = fitted_1c
+    se_h, _, cov_raw = mle_standard_errors(spec, best, data, kind="hessian")
+    se_s, cov_s, _ = mle_standard_errors(spec, best, data, kind="sandwich")
+    assert np.isfinite(se_s).all()
+    np.testing.assert_allclose(cov_s, cov_s.T, rtol=1e-10, atol=1e-12)
+    ratio = se_s / se_h
+    assert np.all(ratio > 0.3) and np.all(ratio < 3.0), ratio
+    S = np.asarray(_jitted_score_contributions(spec, data.shape[1])(
+        jnp.asarray(np.asarray(utp(spec, jnp.asarray(best)))),
+        jnp.asarray(data), jnp.asarray(0), jnp.asarray(data.shape[1])))
+    # the fit converges on a ΔLL criterion, so the summed score is small but
+    # not machine-zero; what matters for inference is that the implied Newton
+    # step is well inside one standard error in every direction
+    newton = cov_raw @ S.sum(axis=0)
+    assert np.all(np.abs(newton) < 0.5 * np.sqrt(np.diagonal(cov_raw))), newton
+
+
+def test_sandwich_rejects_non_kalman(maturities):
+    import pytest as _pytest
+    spec, _ = create_model("NS", tuple(maturities), float_type="float64")
+    with _pytest.raises(ValueError, match="sandwich"):
+        mle_standard_errors(spec, np.zeros(spec.n_params),
+                            np.zeros((len(maturities), 10)), kind="sandwich")
 
 
 def test_se_matches_finite_difference_hessian(fitted_1c):

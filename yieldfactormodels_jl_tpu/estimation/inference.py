@@ -40,9 +40,43 @@ def _jitted_information(spec: ModelSpec, T: int):
     return jax.jit(info)
 
 
+@register_engine_cache
+@lru_cache(maxsize=32)
+def _jitted_score_contributions(spec: ModelSpec, T: int):
+    """(T, P) per-step score matrix ∂ℓ_t/∂raw for the sandwich B-matrix —
+    Kalman families only (their per-step outs['ll'] ARE loglik contributions;
+    the prediction-error families' per-t losses are MSE terms, for which the
+    QMLE sandwich is not the standard estimator).
+
+    Engine note: like api.smooth, this always runs the joint-covariance
+    forward pass — the per-step ll decomposition is what the sandwich needs,
+    and the loglik engines don't emit it.  A failed f32 Cholesky surfaces as
+    NaN scores, guarded by the caller; rerun in float64 in that case.
+
+    jacfwd, not jacrev: the map is R^P → R^T with T ≫ P, so P forward JVPs
+    beat T backward scan passes (and skip the O(T) residual stash)."""
+    from ..models import kalman as K
+
+    def scores(raw, data, start, end):
+        def contribs(r):
+            _, _, _, outs = K._scan_filter(
+                spec, transform_params(spec, r), data, start, end)
+            mask = K.loglik_contrib_mask(start, end, data.shape[1])
+            return jnp.where(mask, outs["ll"], 0.0)
+
+        return jax.jacfwd(contribs)(raw)
+
+    return jax.jit(scores)
+
+
 def mle_standard_errors(spec: ModelSpec, params_hat, data, start=0, end=None,
-                        rcond: float = 1e-10):
+                        rcond: float = 1e-10, kind: str = "hessian"):
     """Standard errors and covariance of a fitted CONSTRAINED parameter vector.
+
+    ``kind="hessian"`` (default): observed-information covariance H⁻¹.
+    ``kind="sandwich"``: the QMLE-robust Bollerslev–Wooldridge estimator
+    H⁻¹ B H⁻¹ with B = Σ_t s_t s_tᵀ from the per-step score contributions
+    (Kalman families only — valid under misspecified innovation densities).
 
     Returns ``(se, cov, cov_raw)``: delta-method standard errors (P,) and
     covariance (P, P) in the constrained space, plus the raw-space covariance.
@@ -55,6 +89,13 @@ def mle_standard_errors(spec: ModelSpec, params_hat, data, start=0, end=None,
     otherwise pass ``np.linalg.inv`` by float64 luck and surface as
     astronomically large but finite "standard errors".
     """
+    if kind not in ("hessian", "sandwich"):
+        raise ValueError(f"kind must be 'hessian' or 'sandwich', got {kind!r}")
+    if kind == "sandwich" and not spec.is_kalman:
+        raise ValueError(
+            "kind='sandwich' needs per-step loglik contributions — Kalman "
+            "families only (the prediction-error families' per-t terms are "
+            "MSE contributions, not scores of a likelihood)")
     data = jnp.asarray(data, dtype=spec.dtype)
     T = data.shape[1]
     if end is None:
@@ -72,7 +113,17 @@ def mle_standard_errors(spec: ModelSpec, params_hat, data, start=0, end=None,
     w, V = np.linalg.eigh(Hs)
     good = w > rcond * max(w.max(), 0.0)
     inv_w = np.where(good, 1.0 / np.where(good, w, 1.0), 0.0)
-    cov_raw = (V * inv_w) @ V.T                    # pseudo-inverse over good
+    Ainv = (V * inv_w) @ V.T                       # pseudo-inverse over good
+    if kind == "sandwich":
+        S = np.asarray(_jitted_score_contributions(spec, T)(
+            raw, data, jnp.asarray(start), jnp.asarray(end)), dtype=np.float64)
+        if not np.isfinite(S).all():   # failed f32 joint forward pass
+            nanm = np.full((P, P), np.nan)
+            return np.full(P, np.nan), nanm, nanm
+        B = S.T @ S                                # Σ_t s_t s_tᵀ  (s_t = ∂ℓ_t)
+        cov_raw = Ainv @ B @ Ainv
+    else:
+        cov_raw = Ainv
     cov_raw = 0.5 * (cov_raw + cov_raw.T)
     # a parameter is unidentified iff it loads on any excluded direction
     bad_load = (V[:, ~good] ** 2).sum(axis=1) > rcond
