@@ -480,3 +480,31 @@ def rts_smoother(Z, Phi, delta, Omega_state, obs_var, data):
         Ps[t] = P_upd[t] + G @ (Ps[t + 1] - P_pred[t + 1]) @ G.T
     return (np.asarray(bs), np.asarray(Ps),
             np.asarray(b_upd), np.asarray(P_upd))
+
+
+def kalman_filter_loglik_steps(Z, Phi, delta, Omega_state, obs_var, data):
+    """Per-step loglik contributions ℓ_t aligned with the library scan
+    (T entries; zero where a step does not contribute) — used to validate
+    the per-step score kernel (estimation/inference.py) by finite
+    differences against THIS independent NumPy path."""
+    N, T = data.shape
+    Ms = Phi.shape[0]
+    Omega_obs = obs_var * np.eye(N)
+    beta, P = kalman_init(Phi, delta, Omega_state)
+    lls = np.zeros(T)
+    for t in range(T):
+        y = data[:, t]
+        if np.any(np.isnan(y)):
+            beta = delta + Phi @ beta
+            P = Phi @ P @ Phi.T + Omega_state
+            continue
+        v = y - Z @ beta
+        F = Z @ P @ Z.T + Omega_obs
+        F_inv = np.linalg.inv(F)
+        K = P @ Z.T @ F_inv
+        if 0 < t < T - 1:  # library mask: contributing steps 1 .. T−2
+            sign, logdet = np.linalg.slogdet(F)
+            lls[t] = -0.5 * (logdet + v @ F_inv @ v + N * LOG_2PI)
+        beta = delta + Phi @ (beta + K @ v)
+        P = Phi @ ((np.eye(Ms) - K @ Z) @ P) @ Phi.T + Omega_state
+    return lls

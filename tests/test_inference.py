@@ -84,6 +84,38 @@ def test_sandwich_se_close_to_hessian_on_wellspecified_dgp(fitted_1c):
     assert np.all(np.abs(newton) < 0.5 * np.sqrt(np.diagonal(cov_raw))), newton
 
 
+def test_score_contributions_match_numpy_oracle_fd(fitted_1c):
+    """Independent-oracle parity (CLAUDE.md rule) for the per-step score
+    kernel: each column of S must match central finite differences of the
+    NumPy per-step loglik (tests/oracle.kalman_filter_loglik_steps)."""
+    from yieldfactormodels_jl_tpu.estimation.inference import (
+        _jitted_score_contributions)
+    from yieldfactormodels_jl_tpu.models.params import unpack_kalman
+    from tests import oracle
+
+    spec, best, data = fitted_1c
+    raw = np.asarray(untransform_params(spec, jnp.asarray(best)))
+    T = data.shape[1]
+    S = np.asarray(_jitted_score_contributions(spec, T)(
+        jnp.asarray(raw), jnp.asarray(data), jnp.asarray(0), jnp.asarray(T)))
+
+    def steps_oracle(r):
+        kp = unpack_kalman(spec, transform_params(spec, jnp.asarray(r)))
+        Z = oracle.dns_loadings(float(kp.gamma[0]), np.asarray(MATS))
+        return oracle.kalman_filter_loglik_steps(
+            Z, np.asarray(kp.Phi), np.asarray(kp.delta),
+            np.asarray(kp.Omega_state), float(kp.obs_var), data)
+
+    eps = 1e-6
+    for j in [0, 1, spec.layout["delta"][0], spec.layout["phi"][0]]:
+        e = np.zeros_like(raw)
+        e[j] = eps
+        col_fd = (steps_oracle(raw + e) - steps_oracle(raw - e)) / (2 * eps)
+        np.testing.assert_allclose(S[:, j], col_fd, rtol=2e-4,
+                                   atol=1e-6 * np.abs(col_fd).max() + 1e-8,
+                                   err_msg=f"score column {j}")
+
+
 def test_sandwich_rejects_non_kalman(maturities):
     import pytest as _pytest
     spec, _ = create_model("NS", tuple(maturities), float_type="float64")

@@ -1,3 +1,3 @@
-from . import transformations, nn_transform, data_management
+from . import transformations, nn_transform, data_management, evaluation
 
-__all__ = ["transformations", "nn_transform", "data_management"]
+__all__ = ["transformations", "nn_transform", "data_management", "evaluation"]

@@ -1,0 +1,76 @@
+"""Out-of-sample forecast evaluation: the Diebold–Mariano (1995) test.
+
+Companion to the rolling-forecast pipeline (forecasting.py exports per-origin
+forecasts; the reference leaves accuracy comparison entirely to external
+tooling).  Tests H₀: equal expected loss between two forecast-error series,
+with a Bartlett-kernel HAC variance (h-step forecasts ⇒ MA(h−1) differential
+autocorrelation) and the Harvey–Leybourne–Newbold small-sample correction.
+
+Pure NumPy — this is post-processing of exported forecasts, not device work.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+
+def diebold_mariano(err1, err2, h: int = 1, loss: str = "squared",
+                    harvey_correction: bool = True):
+    """DM statistic and two-sided p-value for equal predictive accuracy.
+
+    ``err1``/``err2``: forecast-error series of the two competing models on
+    the SAME targets, shape (T,) or (T, N) (multivariate errors are reduced
+    to a per-period aggregate loss over the last axis).  ``h`` is the
+    forecast horizon (HAC truncation lag = h − 1).  Negative statistic ⇒
+    model 1 has the lower loss.
+
+    Returns ``(stat, pvalue)``; NaN when the loss differential is constant
+    (zero HAC variance) or fewer than 2 usable periods remain.
+    """
+    e1 = np.asarray(err1, dtype=np.float64)
+    e2 = np.asarray(err2, dtype=np.float64)
+    if e1.shape != e2.shape:
+        raise ValueError(f"error series shapes differ: {e1.shape} vs {e2.shape}")
+    if loss == "squared":
+        l1, l2 = e1 ** 2, e2 ** 2
+    elif loss == "absolute":
+        l1, l2 = np.abs(e1), np.abs(e2)
+    else:
+        raise ValueError(f"loss must be 'squared' or 'absolute', got {loss!r}")
+    if l1.ndim > 1:
+        l1 = l1.mean(axis=tuple(range(1, l1.ndim)))
+        l2 = l2.mean(axis=tuple(range(1, l2.ndim)))
+    d = l1 - l2
+    # keep TIME ALIGNMENT through missing periods (failed windows etc.):
+    # compacting NaNs out would pair observations k+gap periods apart in the
+    # HAC lags below, mis-estimating the MA(h−1) long-run variance
+    finite = np.isfinite(d)
+    T = int(finite.sum())
+    if T < 2:
+        return float("nan"), float("nan")
+    dbar = d[finite].mean()
+    dc = np.where(finite, d - dbar, 0.0)
+    # Bartlett/Newey–West long-run variance with h−1 lags; lag-k products are
+    # counted only where BOTH endpoints are observed
+    lrv = float(dc @ dc) / T
+    for k in range(1, min(h, d.shape[0])):
+        w = 1.0 - k / h
+        lrv += 2.0 * w * float(dc[k:] @ dc[:-k]) / T
+    if lrv <= 0:
+        return float("nan"), float("nan")
+    stat = dbar / math.sqrt(lrv / T)
+    if harvey_correction and h > 1:
+        # Harvey–Leybourne–Newbold (1997): small-sample scaling paired with
+        # Student-t(T−1) critical values, not the normal
+        c = (T + 1 - 2 * h + h * (h - 1) / T) / T
+        if c <= 0:
+            return float("nan"), float("nan")
+        stat *= math.sqrt(c)
+        from scipy.stats import t as _t
+
+        p = 2.0 * float(_t.sf(abs(stat), df=T - 1))
+    else:
+        p = math.erfc(abs(stat) / math.sqrt(2.0))
+    return float(stat), float(p)
