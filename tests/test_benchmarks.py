@@ -1,0 +1,55 @@
+"""Smoke the five BASELINE benchmark configs at reduced scale.
+
+The driver and the judge rely on ``benchmarks/run_all.py``; this guards the
+harness against rot (import drift, API changes in the kernels it drives)
+without paying full-scale runtimes.  Each config runs in a SUBPROCESS with
+the production environment (f32, no jax_enable_x64) — the same way
+``run_all._orchestrate`` launches them; the suite's in-process x64 mode
+would otherwise trip an optax-linesearch weak-type issue that never occurs
+in the real runs.  (This smoke is what caught the f64 quadrature leak into
+the f32 PF scan carry — ops/particle._measurement now casts.)
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+
+_SNIPPET = """
+import json, sys
+sys.path.insert(0, {bench!r}); sys.path.insert(0, {root!r})
+import run_all
+wall, descr = run_all._run_config({name!r}, {scale})
+print("RESULT " + json.dumps([wall, descr]))
+"""
+
+
+@pytest.mark.parametrize("name,scale", [
+    ("dns3-mle", 1),          # batch axis is already 1; full config
+    ("afns5-mle64", 64),      # 1 start
+    ("afns5-sv-pf", 250),     # 4 draws
+    ("rolling-240", 48),      # 5 windows
+    ("bootstrap-2000", 100),  # 20 resamples
+])
+def test_benchmark_config_runs(name, scale):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("PALLAS_AXON_POOL_IPS", "JAX_ENABLE_X64")}
+    env.update({"JAX_PLATFORMS": "cpu", "BENCH_PF_CHUNK": "4",
+                "OMP_NUM_THREADS": "1"})
+    code = _SNIPPET.format(bench=os.path.join(ROOT, "benchmarks"),
+                           root=ROOT, name=name, scale=scale)
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.startswith("RESULT ")]
+    assert lines, proc.stdout[-500:]
+    wall, descr = json.loads(lines[-1][len("RESULT "):])
+    assert wall > 0 and isinstance(descr, str) and descr
+    if name == "afns5-sv-pf":
+        # the finite-draw count is part of the work string; all must survive
+        assert "finite 4/4" in descr, descr

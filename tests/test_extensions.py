@@ -48,6 +48,21 @@ def test_particle_filter_sv_estimates_are_stable(maturities, yields_panel):
     assert np.std(lls) < 0.05 * abs(np.mean(lls))  # RB keeps MC noise small
 
 
+def test_particle_filter_f32_afns5_under_x64(maturities, yields_panel):
+    """Regression: with jax_enable_x64 on (this suite) and an f32 AFNS5 spec,
+    the yield-adjustment quadrature must not leak f64 into the f32 scan carry
+    (particle._measurement casts like kalman.measurement_setup)."""
+    from tests.test_afns import _afns5_params
+
+    spec, _ = create_model("AFNS5", tuple(maturities), float_type="float32")
+    p, *_ = _afns5_params(spec)
+    ll = float(particle_filter_loglik(
+        spec, jnp.asarray(np.asarray(p), jnp.float32),
+        jnp.asarray(np.asarray(yields_panel)[:, :20], jnp.float32),
+        jax.random.PRNGKey(0), n_particles=8, sv_phi=0.5, sv_sigma=0.1))
+    assert not np.isnan(ll)
+
+
 def test_estimate_sv_improves_pf_loglik(maturities, yields_panel):
     """Simulated MLE (common-random-numbers Nelder–Mead over the PF loglik)
     must improve on its starts and report the best start's loglik."""

@@ -41,15 +41,17 @@ class PFState(NamedTuple):
     key: jnp.ndarray
 
 
-def _measurement(spec: ModelSpec, kp):
+def _measurement(spec: ModelSpec, kp, dtype):
+    """Loadings + intercept cast to the spec dtype — like kalman.
+    measurement_setup; under jax_enable_x64 the quadrature inside
+    yield_adjustment otherwise emits f64 into an f32 scan carry."""
     mats = spec.maturities_array
     if spec.family == "kalman_afns":
         Z = afns_loadings(kp.gamma, mats, spec.M)
         d = yield_adjustment(kp.gamma, kp.Omega_state, mats, spec.M)
-    else:
-        Z = dns_loadings(kp.gamma, mats)
-        d = jnp.zeros((spec.N,), dtype=Z.dtype)
-    return Z, d
+        return Z.astype(dtype), d.astype(dtype)
+    Z = dns_loadings(kp.gamma, mats)
+    return Z.astype(dtype), jnp.zeros((spec.N,), dtype=dtype)
 
 
 def _systematic_resample(key, weights, n):
@@ -167,11 +169,11 @@ def particle_filter_loglik(
     Fully jittable; vmap over ``params`` for 1,000-draw MLE sweeps.
     """
     kp = unpack_kalman(spec, params)
-    Z, d = _measurement(spec, kp)
-    state0 = K.init_state(spec, kp)
     Pn = n_particles
     Ms = spec.state_dim
     dtype = params.dtype
+    Z, d = _measurement(spec, kp, dtype)
+    state0 = K.init_state(spec, kp)
     # factor P0 and Ω once (sqrt_kf.get_loss conventions): a failed
     # factorization is the draw-level −Inf sentinel
     P0s = 0.5 * (state0.P + state0.P.T) + 1e-9 * jnp.eye(Ms, dtype=dtype)
