@@ -1,0 +1,47 @@
+"""The ONE copy of the cpu-mode axon guard (imported before any JAX compute).
+
+This container's sitecustomize registers the axon TPU PJRT plugin in every
+python process (trigger: ``PALLAS_AXON_POOL_IPS``) and pins
+``JAX_PLATFORMS=axon`` — so a process that wants CPU must, before its first
+JAX computation, (a) point ``jax_platforms`` at cpu and (b) deregister the
+axon backend factory, or lazy backend init dials the TPU tunnel (which can
+wedge the single shared relay for hours — CLAUDE.md).
+
+The deregistration uses ``jax._src.xla_bridge._backend_factories``, a private
+API with no stability guarantee.  It must therefore fail LOUDLY if a JAX
+upgrade removes it: silently proceeding would dial the relay from a cpu-mode
+run.  All three cpu-mode entry points (tests/conftest.py,
+benchmarks/hw_verify.py, __graft_entry__.py) call this one function, so a
+breakage is fixed in exactly one place.
+
+This module deliberately lives at the REPO ROOT, outside the package: the
+guard must run before the first JAX computation, so importing it must not
+execute the package's import graph (where any future module-level jnp
+constant would trigger backend init ahead of the guard).  Its only import is
+``jax`` itself, inside the function.
+"""
+
+from __future__ import annotations
+
+
+def force_cpu_platform() -> None:
+    """Pin this process to the CPU backend; raise loudly if the guard breaks.
+
+    Safe to call when the axon plugin was never registered (no-op pop).
+    Must run before the first JAX computation — backend init is lazy and
+    one-shot.
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        from jax._src import xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception as e:  # pragma: no cover - depends on the JAX version
+        raise RuntimeError(
+            "cpu-mode axon guard failed: jax._src.xla_bridge."
+            "_backend_factories is gone in this JAX version.  Fix "
+            "_cpu_guard.py at the repo root (the single shared copy) or "
+            "this cpu run will dial the TPU relay."
+        ) from e

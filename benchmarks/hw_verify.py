@@ -37,12 +37,9 @@ for p in (HERE, ROOT):
 # each work around).  Honor an explicit cpu request by neutralizing the axon
 # factory BEFORE any jax computation — same recipe as tests/conftest.py.
 if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
-    import jax
+    from _cpu_guard import force_cpu_platform  # repo root (on sys.path above)
 
-    jax.config.update("jax_platforms", "cpu")
-    from jax._src import xla_bridge as _xb
-
-    _xb._backend_factories.pop("axon", None)
+    force_cpu_platform()
 
 
 def main() -> int:

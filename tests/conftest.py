@@ -19,14 +19,16 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import jax
 
-jax.config.update("jax_platforms", "cpu")  # sitecustomize already captured env
+from _cpu_guard import force_cpu_platform  # repo-root module: no package imports
+
+force_cpu_platform()  # sitecustomize already captured env; shared loud guard
 jax.config.update("jax_enable_x64", True)
-
-from jax._src import xla_bridge as _xb
-
-_xb._backend_factories.pop("axon", None)
 
 import numpy as np
 import pytest

@@ -146,6 +146,37 @@ def test_bootstrap_nan_panel_takes_general_engine(maturities, yields_panel):
     np.testing.assert_array_equal(got, want)
 
 
+def test_bootstrap_engine_override(maturities, yields_panel):
+    """The explicit ``engine`` kwarg pins a path: fused/scan agree on finite
+    f64 panels, forced-fused validates its preconditions, bad names raise."""
+    import pytest
+    from yieldfactormodels_jl_tpu.estimation.bootstrap import (
+        grid_losses, lambda_to_gamma)
+    spec, _ = create_model("NS", tuple(maturities), float_type="float64")
+    p = jnp.asarray(oracle.stable_ns_params(spec, dtype=np.float64))
+    data = jnp.asarray(yields_panel)
+    T = data.shape[1]
+    gammas = lambda_to_gamma(jnp.asarray([0.3, 0.8]))
+    idx = moving_block_indices(jax.random.PRNGKey(7), T, 8, 6)
+    fused = np.asarray(grid_losses(spec, gammas, idx, p, data, engine="fused"))
+    scan = np.asarray(grid_losses(spec, gammas, idx, p, data, engine="scan"))
+    auto = np.asarray(grid_losses(spec, gammas, idx, p, data))
+    np.testing.assert_allclose(fused, scan, rtol=1e-9)
+    np.testing.assert_array_equal(auto, fused)  # auto dispatches to fused here
+    with pytest.raises(ValueError, match="engine must be"):
+        grid_losses(spec, gammas, idx, p, data, engine="bogus")
+    # forced fused enforces the auto-dispatch preconditions instead of
+    # silently producing -Inf cells
+    nan_data = np.asarray(yields_panel).copy()
+    nan_data[:, 5] = np.nan
+    with pytest.raises(ValueError, match="fully-observed"):
+        grid_losses(spec, gammas, idx, p, jnp.asarray(nan_data), engine="fused")
+    kspec, _ = create_model("1C", tuple(maturities), float_type="float64")
+    kp = jnp.asarray(oracle.stable_1c_params(kspec))
+    with pytest.raises(ValueError, match="static_lambda"):
+        grid_losses(kspec, gammas, idx, kp, data, engine="fused")
+
+
 def test_bootstrap_traceable_under_jit(maturities, yields_panel):
     """bootstrap_lambda_grid must stay jit-wrappable: with tracer data the
     concrete-finiteness gate is skipped and the general engine runs."""

@@ -98,7 +98,7 @@ def lambda_to_gamma(lam):
     return jnp.log(lam - 1e-2)
 
 
-def grid_losses(spec: ModelSpec, gammas, idx, params, data):
+def grid_losses(spec: ModelSpec, gammas, idx, params, data, engine: str = "auto"):
     """(R, G) loss surface for resample indices ``idx`` and γ drivers
     ``gammas`` — the engine-dispatch core of :func:`bootstrap_lambda_grid`.
 
@@ -109,10 +109,42 @@ def grid_losses(spec: ModelSpec, gammas, idx, params, data):
     general engine and stay traceable.  Exposed separately so the mesh layer
     can shard the resample axis (parallel/mesh.py) without re-deriving the
     engine choice.
+
+    ``engine``: ``"auto"`` (the dispatch above), ``"fused"``, or ``"scan"``.
+    The two engines agree to rtol 1e-9 in float64 (tests/test_extensions.py)
+    but differ at ~1e-3 in float32 — so under ``"auto"`` a jit-wrapped call
+    (tracer data → scan engine) can differ slightly from the same eager call
+    (fused engine) in f32.  Pass an explicit engine to pin one path across
+    contexts (ADVICE r2).
+
+    Forced ``"fused"`` validates its preconditions (static_lambda family,
+    fully-observed panel) eagerly — but the finiteness check needs concrete
+    data, so under an outer jit (tracer data) it CANNOT run and, per the
+    repo's in-jit sentinel convention, cells whose resampled blocks touch
+    missing values come back as −Inf rather than raising.  Validate eagerly
+    once before jit-wrapping a pinned-fused call on data that might have
+    gaps.
     """
     T = data.shape[1]
-    if (spec.family == "static_lambda" and not isinstance(data, jax.core.Tracer)
-            and bool(np.isfinite(np.asarray(data)).all())):
+    if engine not in ("auto", "fused", "scan"):
+        raise ValueError(f"engine must be 'auto', 'fused' or 'scan', got {engine!r}")
+    if engine == "fused":
+        # enforce the same preconditions the auto dispatch checks — the fused
+        # kernel has no missing-data handling, so forcing it onto a NaN panel
+        # would silently flush affected cells to -Inf instead of the scan
+        # engine's finite masked losses
+        if spec.family != "static_lambda":
+            raise ValueError("engine='fused' requires a static_lambda spec")
+        if (not isinstance(data, jax.core.Tracer)
+                and not bool(np.isfinite(np.asarray(data)).all())):
+            raise ValueError(
+                "engine='fused' requires a fully-observed (finite) panel; "
+                "this data has missing values — use engine='scan'")
+        fn = _jitted_grid_loss_fused(spec, T)
+    elif (engine == "auto"
+          and spec.family == "static_lambda"
+          and not isinstance(data, jax.core.Tracer)
+          and bool(np.isfinite(np.asarray(data)).all())):
         fn = _jitted_grid_loss_fused(spec, T)
     else:
         fn = _jitted_grid_loss(spec, T)

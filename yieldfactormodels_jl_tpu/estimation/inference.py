@@ -125,8 +125,17 @@ def mle_standard_errors(spec: ModelSpec, params_hat, data, start=0, end=None,
     else:
         cov_raw = Ainv
     cov_raw = 0.5 * (cov_raw + cov_raw.T)
-    # a parameter is unidentified iff it loads on any excluded direction
-    bad_load = (V[:, ~good] ** 2).sum(axis=1) > rcond
+    # a parameter is unidentified iff it loads on any excluded direction.
+    # The loading test is separate from the eigenvalue rcond (ADVICE r2): the
+    # old rule (squared loadings summed > rcond = 1e-10, i.e. |V| ≳ 1e-5) let
+    # a small-but-real loading (e.g. 1e-6) escape the mask — and since the
+    # pseudo-inverse zeroes excluded directions, the escaped parameter's
+    # variance is UNDERestimated: a falsely confident finite SE.  The per-
+    # component threshold 1e-6 on |V| catches that while staying above eigh's
+    # eigenvector mixing noise (~eps·λmax/gap) for near-degenerate pairs
+    # straddling the rcond cutoff, which sqrt(eps) ≈ 1.5e-8 would not.
+    load_tol = 1e-6
+    bad_load = (np.abs(V[:, ~good]) >= load_tol).any(axis=1)
     cov = J @ cov_raw @ J.T
     cov = 0.5 * (cov + cov.T)
     var = np.diagonal(cov).copy()

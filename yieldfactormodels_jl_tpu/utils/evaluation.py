@@ -61,9 +61,11 @@ def diebold_mariano(err1, err2, h: int = 1, loss: str = "squared",
     if lrv <= 0:
         return float("nan"), float("nan")
     stat = dbar / math.sqrt(lrv / T)
-    if harvey_correction and h > 1:
+    if harvey_correction:
         # Harvey–Leybourne–Newbold (1997): small-sample scaling paired with
-        # Student-t(T−1) critical values, not the normal
+        # Student-t(T−1) critical values, not the normal.  Applied at every
+        # h — at h=1 the factor (T−1)/T and the t(T−1) reference still differ
+        # from the plain normal test (ADVICE r2).
         c = (T + 1 - 2 * h + h * (h - 1) / T) / T
         if c <= 0:
             return float("nan"), float("nan")
