@@ -71,7 +71,7 @@ def _kernel_value_and_grad(spec, p, data, start, end):
     return vals, jax.grad(total)(p)
 
 
-@pytest.mark.parametrize("code", ["1C", "AFNS3", "AFNS5"])
+@pytest.mark.parametrize("code", ["1C", "AFNS3", "AFNS5", "TVλ"])
 def test_value_and_grad_match_jax(code, rng):
     spec, _ = create_model(code, MATS, float_type="float64")
     B, T = 3, 18
@@ -166,18 +166,42 @@ def test_grad_through_transform_composition(rng):
 
 
 def test_unsupported_family_raises(rng):
-    spec, _ = create_model("TVλ", MATS, float_type="float64")
+    spec, _ = create_model("NS", MATS, float_type="float64")  # static family
     with pytest.raises(ValueError):
         pallas_kf_grad.batched_loglik_diff(
             spec, np.zeros((2, spec.n_params)), np.zeros((len(MATS), 10)),
             interpret=True)
 
 
-def test_per_lane_windows_match_per_row_reference(rng):
+def test_tvl_exact_jacobian_variant(rng):
+    """The adjoint must follow the forward's dZ₂/dλ formula selection: with
+    ``exact_jacobian=True`` the EKF linearization (and hence the loglik and
+    its gradient) changes, and the jax.vjp-based adjoint tracks it because it
+    differentiates the same build (pallas_kf.tvl_rows)."""
+    import dataclasses
+    spec, _ = create_model("TVλ", MATS, float_type="float64")
+    spec_x = dataclasses.replace(spec, exact_jacobian=True)
+    B, T = 2, 14
+    p = jnp.asarray(_params(spec, B, rng))
+    data = _panel(rng, T)
+    ref_vq, ref_gq = _ref_value_and_grad(spec, p, data, 0, T)
+    got_vq, got_gq = _kernel_value_and_grad(spec, p, data, 0, T)
+    ref_vx, ref_gx = _ref_value_and_grad(spec_x, p, data, 0, T)
+    got_vx, got_gx = _kernel_value_and_grad(spec_x, p, data, 0, T)
+    for got, ref in ((got_vq, ref_vq), (got_gq, ref_gq),
+                     (got_vx, ref_vx), (got_gx, ref_gx)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-6, atol=1e-7)
+    # and the two formulas genuinely differ (the quirk is not a no-op here)
+    assert not np.allclose(np.asarray(got_vq), np.asarray(got_vx))
+
+
+@pytest.mark.parametrize("code", ["1C", "TVλ"])
+def test_per_lane_windows_match_per_row_reference(code, rng):
     """Each draw carries its own [start, end): values AND gradients must match
     running the univariate loss per row with that row's window — the fused
     rolling-window MLE path (one program for all origins)."""
-    spec, _ = create_model("1C", MATS, float_type="float64")
+    spec, _ = create_model(code, MATS, float_type="float64")
     B, T = 3, 16
     p = jnp.asarray(_params(spec, B, rng))
     data = _panel(rng, T)

@@ -229,8 +229,10 @@ def try_initializations(spec: ModelSpec, best_params, data, max_tries: int = 0,
 # estimate: multi-start LBFGS (optimization.jl:329-410)
 # ---------------------------------------------------------------------------
 
-#: families the differentiable fused Pallas kernel supports
-_FUSED_FAMILIES = ("kalman_dns", "kalman_afns")
+#: families the differentiable fused Pallas kernel supports — all three
+#: Kalman families (the TVλ EKF adjoint runs the checkpointed per-step
+#: jax.vjp kernel, ops/pallas_kf_grad._bwd_kernel_tvl)
+_FUSED_FAMILIES = ("kalman_dns", "kalman_afns", "kalman_tvl")
 
 
 def fused_objectives(spec: ModelSpec, data, start, end, penalty=1e12,

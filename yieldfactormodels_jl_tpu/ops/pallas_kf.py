@@ -49,6 +49,30 @@ _SUB, _LANE = 8, 128
 TILE = _SUB * _LANE  # draws per grid program
 
 
+def tvl_rows(beta, mats, exact):
+    """TVλ measurement rows from the predicted state — the single source of
+    truth shared by the value kernel here and the adjoint kernels
+    (pallas_kf_grad, which differentiate THROUGH this build with jax.vjp).
+
+    Returns per maturity ``((1, z2, z3, jac), jb)`` where ``jac`` is the EKF
+    Jacobian column (kalman/filter.jl:38-46, quirk behind ``exact``) and
+    ``jb = jac·β₄`` is the fixed-linearization y_eff offset
+    (ops/univariate_kf.py derivation).  All tiles are derived from ``beta``
+    so Mosaic never sees a replicated-constant layout.
+    """
+    lam = _FLOOR + jnp.exp(beta[3])
+    dlam = lam - _FLOOR
+    one = beta[3] * 0.0 + 1.0
+    rows = []
+    for tau in mats:  # static python floats
+        z2, z3 = dns_slope_curvature(lam, tau)
+        ztau = z2 - z3  # e^{-λτ} via the DNS identity Z₃ = Z₂ − e^{-λτ}
+        dz2 = tvl_dz2_dlam(lam, ztau, tau, exact)
+        jac = ((beta[1] + beta[2]) * dz2 + beta[2] * tau * ztau) * dlam
+        rows.append(((one, z2, z3, jac), jac * beta[3]))
+    return rows
+
+
 def window_masks(windowed, f32, maskr, winr, t):
     """Per-step (in-window, loglik-contributing) masks — the single source of
     truth shared by the value kernel and the adjoint kernels (pallas_kf_grad):
@@ -100,9 +124,8 @@ def _kernel(N: int, Ms: int, T: int, tvl: bool, exact_jac: bool,
 
         obs_s, con_s = window_masks(windowed, f32, maskr, winr, t)
 
-        if tvl:  # lane-local decay rate and Jacobian factor from β_pred
-            lam = _FLOOR + jnp.exp(beta[3])
-            dlam = lam - _FLOOR
+        if tvl:  # lane-local rows + y_eff offsets from β_pred (shared build)
+            trows = tvl_rows(beta, mats, exact_jac)
 
         # ---- N sequential scalar measurement updates (rank-1, lane-local) --
         b = list(beta)
@@ -115,14 +138,9 @@ def _kernel(N: int, Ms: int, T: int, tvl: bool, exact_jac: bool,
             fin_i = jnp.isfinite(y_i)
             finite_s = jnp.logical_and(finite_s, fin_i)
             if tvl:
-                tau = mats[i]  # static python float
-                z2, z3 = dns_slope_curvature(lam, tau)
-                ztau = z2 - z3  # e^{-λτ} via the DNS identity Z₃ = Z₂ − e^{-λτ}
-                dz2 = tvl_dz2_dlam(lam, ztau, tau, exact_jac)
-                jac = ((beta[1] + beta[2]) * dz2 + beta[2] * tau * ztau) * dlam
-                z = (jnp.ones((rows, _LANE), dtype=f32), z2, z3, jac)
+                z, jb = trows[i]
                 # y_eff = y − h(β_pred) + z·β_pred = y + jac·β₄_pred
-                y_eff = y_i + jac * beta[3]
+                y_eff = y_i + jb
                 d_i = jnp.zeros((), f32)
             else:
                 z = tuple(Zr[i * Ms + m] for m in range(Ms))

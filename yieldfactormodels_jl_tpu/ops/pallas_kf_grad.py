@@ -51,7 +51,7 @@ from jax.experimental.pallas import tpu as pltpu
 from ..models.kalman import init_state, loglik_contrib_mask, measurement_setup
 from ..models.params import unpack_kalman
 from ..models.specs import ModelSpec
-from .pallas_kf import (_LANE, _SUB, TILE, _lay, window_array,
+from .pallas_kf import (_LANE, _SUB, TILE, _lay, tvl_rows, window_array,
                         window_masks)
 
 _LOG_2PI = math.log(2.0 * math.pi)
@@ -356,6 +356,305 @@ def _bwd_kernel(N, Ms, T, S, nC, windowed,
 
 
 # ---------------------------------------------------------------------------
+# TVλ EKF: state-dependent measurement rows
+# ---------------------------------------------------------------------------
+#
+# The TVλ family rebuilds its loading row per step from the predicted state
+# (λ = 1e-2 + e^{β₄}; Jacobian column per kalman/filter.jl:38-46), so the
+# measurement chain's adjoint needs SECOND derivatives of the loadings
+# (d(dZ₂/dλ)/dλ through the Jacobian).  Rather than hand-deriving those, the
+# backward kernel keeps the same √T-checkpoint structure and runs ``jax.vjp``
+# over ONE step's value function (pallas_kf.tvl_rows + the rank-1 chain +
+# blend + transition — all unrolled elementwise tile arithmetic, so the
+# transpose lowers like the hand-written adjoints).  This guarantees the
+# adjoint can never diverge from the forward build, including the
+# ``exact_jacobian`` quirk flag.
+
+
+def _tvl_chain_values(N, Ms, mats, exact, ovar, y_scal, beta, P):
+    """TVλ inner chain on values.  Returns (b_u, P_u_sym, ll, fin_all, ok)."""
+    trows = tvl_rows(beta, mats, exact)
+    b = list(beta)
+    Pm = list(P)
+    ll = beta[0] * 0.0  # loaded-value-derived zero (Mosaic layout note above)
+    ok = None
+    fin_all = True
+    for i in range(N):
+        z, jb = trows[i]
+        y_i = y_scal[i]
+        fin_i = jnp.isfinite(y_i)
+        fin_all = jnp.logical_and(fin_all, fin_i)
+        zP = [sum(z[k] * Pm[k * Ms + m] for k in range(Ms)) for m in range(Ms)]
+        f = sum(zP[m] * z[m] for m in range(Ms)) + ovar
+        ok_i = (f > 0) & jnp.isfinite(f)
+        ok = ok_i if ok is None else (ok & ok_i)
+        fsafe = jnp.where(f > 0, f, jnp.ones_like(f))
+        predv = sum(z[m] * b[m] for m in range(Ms))
+        v = jnp.where(fin_i, y_i + jb - predv, jnp.zeros_like(predv))
+        K = [zP[m] / fsafe for m in range(Ms)]
+        b = [b[m] + K[m] * v for m in range(Ms)]
+        Pm = [Pm[k * Ms + m] - K[k] * zP[m] for k in range(Ms) for m in range(Ms)]
+        ll = ll - 0.5 * (jnp.log(fsafe) + v * v / fsafe + _LOG_2PI)
+    Pm = [0.5 * (Pm[k * Ms + m] + Pm[m * Ms + k])
+          for k in range(Ms) for m in range(Ms)]
+    return b, Pm, ll, fin_all, ok
+
+
+def _tvl_full_step(N, Ms, mats, exact, phi, delta, om, ovar, y_scal, obs_s,
+                   beta, P):
+    """One TVλ forward step on values with obs blending (no ll)."""
+    b_u, P_u, _, fin_all, _ = _tvl_chain_values(N, Ms, mats, exact, ovar,
+                                                y_scal, beta, P)
+    obs = jnp.logical_and(obs_s, fin_all)
+    b_m = [jnp.where(obs, b_u[m], beta[m]) for m in range(Ms)]
+    P_m = [jnp.where(obs, P_u[k], P[k]) for k in range(Ms * Ms)]
+    return _transition(Ms, phi, delta, om, b_m, P_m), obs
+
+
+def _fwd_kernel_tvl(N, Ms, T, S, nC, windowed, exact, mats,
+                    phir, deltar, omr, ovarr, b0r, p0r, datar, maskr,
+                    winr, outr, chkr):
+    f32 = phir.dtype
+    D = Ms + Ms * Ms
+    ovar = ovarr[0]
+    phi = tuple(phir[j] for j in range(Ms * Ms))
+    delta = tuple(deltar[m] for m in range(Ms))
+    om = tuple(omr[j] for j in range(Ms * Ms))
+    beta0 = tuple(b0r[m] for m in range(Ms))
+    P0 = tuple(p0r[k] for k in range(Ms * Ms))
+    ll0 = ovar * 0.0
+
+    def step(t, carry):
+        beta, P, ll = carry
+
+        @pl.when(t % S == 0)
+        def _save():
+            c = t // S
+            chkr[pl.ds(c * D, D)] = jnp.stack(list(beta) + list(P))
+
+        obs_s, con_s = window_masks(windowed, f32, maskr, winr, t)
+        y_scal = [datar[t, i] for i in range(N)]
+        b_u, P_u, ll_step, fin_all, ok = _tvl_chain_values(
+            N, Ms, mats, exact, ovar, y_scal, beta, P)
+        obs = jnp.logical_and(obs_s, fin_all)
+        b_m = [jnp.where(obs, b_u[m], beta[m]) for m in range(Ms)]
+        P_m = [jnp.where(obs, P_u[k], P[k]) for k in range(Ms * Ms)]
+        b_next, P_next = _transition(Ms, phi, delta, om, b_m, P_m)
+        neg_inf = ll0 - jnp.inf
+        ll_t = jnp.where(jnp.logical_and(obs, con_s),
+                         jnp.where(ok, ll_step, neg_inf), ll0)
+        return tuple(b_next), tuple(P_next), ll + ll_t
+
+    _, _, ll = jax.lax.fori_loop(0, T, step, (beta0, P0, ll0))
+    outr[...] = jnp.where(jnp.isfinite(ll), ll, -jnp.inf)
+
+
+def _bwd_kernel_tvl(N, Ms, T, S, nC, windowed, exact, mats,
+                    phir, deltar, omr, ovarr, datar, maskr, winr, chkr, gr,
+                    gphir, gdeltar, gomr, govarr, gb0r, gp0r, segr):
+    f32 = phir.dtype
+    D = Ms + Ms * Ms
+    ovar = ovarr[0]
+    phi = tuple(phir[j] for j in range(Ms * Ms))
+    delta = tuple(deltar[m] for m in range(Ms))
+    om = tuple(omr[j] for j in range(Ms * Ms))
+    g = gr[...]  # cotangent per lane, already gated on finite ll
+    zt = ovar * 0.0
+
+    def zeros(n):
+        return tuple(zt for _ in range(n))
+
+    def step_adjoint(t, beta, P, bbar_n, Pbar_n, acc):
+        """Adjoint of one TVλ step via jax.vjp of its value function: the AD
+        transpose covers the loading build's state dependence (incl. the
+        second-derivative terms through the Jacobian column) exactly."""
+        (gphi, gdelta, gom, govar) = acc
+        obs_s, con_s = window_masks(windowed, f32, maskr, winr, t)
+        y_scal = [datar[t, i] for i in range(N)]
+        fin_all = True
+        for i in range(N):
+            fin_all = jnp.logical_and(fin_all, jnp.isfinite(y_scal[i]))
+        obs = jnp.logical_and(obs_s, fin_all)
+
+        def f(beta_t, P_t, phi_t, delta_t, om_t, ovar_t):
+            b_u, P_u, ll_step, _fin, _ok = _tvl_chain_values(
+                N, Ms, mats, exact, ovar_t, y_scal, beta_t, P_t)
+            b_m = tuple(jnp.where(obs, b_u[m], beta_t[m]) for m in range(Ms))
+            P_m = tuple(jnp.where(obs, P_u[k], P_t[k]) for k in range(Ms * Ms))
+            b_next, P_next = _transition(Ms, phi_t, delta_t, om_t, b_m, P_m)
+            return tuple(b_next), tuple(P_next), ll_step
+
+        # lanes whose total ll hit the −Inf sentinel have g = 0 already, so
+        # the ok-gate needs no extra handling here
+        w = jnp.where(jnp.logical_and(obs, con_s), g, zt)
+        _, pullback = jax.vjp(f, tuple(beta), tuple(P), phi, delta, om,
+                              (ovar,)[0])
+        bbar, Pbar, gphi_d, gdelta_d, gom_d, govar_d = pullback(
+            (tuple(bbar_n), tuple(Pbar_n), w))
+        gphi = tuple(gphi[j] + gphi_d[j] for j in range(Ms * Ms))
+        gdelta = tuple(gdelta[m] + gdelta_d[m] for m in range(Ms))
+        gom = tuple(gom[j] + gom_d[j] for j in range(Ms * Ms))
+        govar = (govar[0] + govar_d,)
+        return list(bbar), list(Pbar), (gphi, gdelta, gom, govar)
+
+    def seg_body(ci, carry):
+        c = nC - 1 - ci
+        bbar, Pbar, acc = carry
+        st = chkr[pl.ds(c * D, D)]
+        st_b = [st[m] for m in range(Ms)]
+        st_P = [st[Ms + k] for k in range(Ms * Ms)]
+
+        def fwd_body(s, state):
+            beta, P = state
+            t = c * S + s
+            valid = t < T
+            segr[pl.ds(s * D, D)] = jnp.stack(list(beta) + list(P))
+            y_scal = [datar[jnp.minimum(t, T - 1), i] for i in range(N)]
+            obs_s, _ = window_masks(windowed, f32, maskr, winr,
+                                    jnp.minimum(t, T - 1))
+            (b_next, P_next), _ = _tvl_full_step(N, Ms, mats, exact, phi,
+                                                 delta, om, ovar, y_scal,
+                                                 obs_s, beta, P)
+            beta = tuple(jnp.where(valid, b_next[m], beta[m]) for m in range(Ms))
+            P = tuple(jnp.where(valid, P_next[k], P[k]) for k in range(Ms * Ms))
+            return beta, P
+
+        jax.lax.fori_loop(0, S, fwd_body, (tuple(st_b), tuple(st_P)))
+
+        def bwd_body(s2, carry2):
+            bbar, Pbar, acc = carry2
+            s = S - 1 - s2
+            t = c * S + s
+            valid = t < T
+            blk = segr[pl.ds(s * D, D)]
+            beta = tuple(blk[m] for m in range(Ms))
+            P = tuple(blk[Ms + k] for k in range(Ms * Ms))
+            t_safe = jnp.minimum(t, T - 1)
+            nb, nP, nacc = step_adjoint(t_safe, beta, P, bbar, Pbar, acc)
+            bbar = tuple(jnp.where(valid, nb[m], bbar[m]) for m in range(Ms))
+            Pbar = tuple(jnp.where(valid, nP[k], Pbar[k]) for k in range(Ms * Ms))
+            acc = jax.tree.map(lambda new, old: jnp.where(valid, new, old),
+                               nacc, acc)
+            return bbar, Pbar, acc
+
+        return jax.lax.fori_loop(0, S, bwd_body, (bbar, Pbar, acc))
+
+    acc0 = (zeros(Ms * Ms), zeros(Ms), zeros(Ms * Ms), zeros(1))
+    bbar0, Pbar0, acc = jax.lax.fori_loop(
+        0, nC, seg_body, (zeros(Ms), zeros(Ms * Ms), acc0))
+    (gphi, gdelta, gom, govar) = acc
+    for j in range(Ms * Ms):
+        gphir[j] = gphi[j]
+        gomr[j] = gom[j]
+        gp0r[j] = Pbar0[j]
+    for m in range(Ms):
+        gdeltar[m] = gdelta[m]
+        gb0r[m] = bbar0[m]
+    govarr[0] = govar[0]
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _core_tvl(spec, interpret, windowed, Phi, delta, Om, ovar, beta0, P0,
+              data, masks, win):
+    out, _ = _core_tvl_fwd(spec, interpret, windowed, Phi, delta, Om, ovar,
+                           beta0, P0, data, masks, win)
+    return out
+
+
+def _core_tvl_fwd(spec, interpret, windowed, Phi, delta, Om, ovar, beta0, P0,
+                  data, masks, win):
+    f32 = Phi.dtype
+    B = Phi.shape[0]
+    nb = -(-B // TILE)
+    N, Ms = spec.N, spec.state_dim
+    T = data.shape[1]
+    S, nC = _seg(T)
+    D = Ms + Ms * Ms
+    mats = tuple(float(m) for m in spec.maturities)
+
+    args = [_lay(Phi.astype(f32), B, nb), _lay(delta.astype(f32), B, nb),
+            _lay(Om.astype(f32), B, nb), _lay(ovar.astype(f32), B, nb),
+            _lay(beta0.astype(f32), B, nb), _lay(P0.astype(f32), B, nb),
+            jnp.asarray(data, dtype=f32).T, masks.astype(f32),
+            _lay(win.astype(f32), B, nb)]
+
+    def tile_spec(Drows):
+        return pl.BlockSpec((Drows, _SUB, _LANE), lambda gidx: (0, gidx, 0),
+                            memory_space=pltpu.VMEM)
+
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    out, chk = pl.pallas_call(
+        partial(_fwd_kernel_tvl, N, Ms, T, S, nC, windowed,
+                spec.exact_jacobian, mats),
+        grid=(nb,),
+        in_specs=[tile_spec(Ms * Ms), tile_spec(Ms), tile_spec(Ms * Ms),
+                  tile_spec(1), tile_spec(Ms), tile_spec(Ms * Ms),
+                  smem, smem, tile_spec(2)],
+        out_specs=(pl.BlockSpec((_SUB, _LANE), lambda gidx: (gidx, 0),
+                                memory_space=pltpu.VMEM),
+                   tile_spec(nC * D)),
+        out_shape=(jax.ShapeDtypeStruct((nb * _SUB, _LANE), f32),
+                   jax.ShapeDtypeStruct((nC * D, nb * _SUB, _LANE), f32)),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",)),
+        interpret=interpret,
+    )(*args)
+    ll = out.reshape(-1)[:B]
+    shapes = (Phi.shape, delta.shape, Om.shape, ovar.shape, beta0.shape,
+              P0.shape, data.shape, masks.shape, win.shape)
+    return ll, (args, chk, B, nb, ll, shapes)
+
+
+def _core_tvl_bwd(spec, interpret, windowed, res, g):
+    args, chk, B, nb, ll, shapes = res
+    f32 = args[0].dtype
+    N, Ms = spec.N, spec.state_dim
+    T = args[6].shape[0]
+    S, nC = _seg(T)
+    D = Ms + Ms * Ms
+    mats = tuple(float(m) for m in spec.maturities)
+
+    g_lane = jnp.zeros((nb * TILE,), dtype=f32).at[:B].set(
+        jnp.where(jnp.isfinite(ll), jnp.asarray(g, dtype=f32), 0.0))
+    g_tile = g_lane.reshape(nb * _SUB, _LANE)
+
+    def tile_spec(Drows):
+        return pl.BlockSpec((Drows, _SUB, _LANE), lambda gidx: (0, gidx, 0),
+                            memory_space=pltpu.VMEM)
+
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    grads = pl.pallas_call(
+        partial(_bwd_kernel_tvl, N, Ms, T, S, nC, windowed,
+                spec.exact_jacobian, mats),
+        grid=(nb,),
+        in_specs=[tile_spec(Ms * Ms), tile_spec(Ms), tile_spec(Ms * Ms),
+                  tile_spec(1), smem, smem, tile_spec(2), tile_spec(nC * D),
+                  pl.BlockSpec((_SUB, _LANE), lambda gidx: (gidx, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=tuple(tile_spec(rows)
+                        for rows in (Ms * Ms, Ms, Ms * Ms, 1, Ms, Ms * Ms)),
+        out_shape=tuple(
+            jax.ShapeDtypeStruct((rows, nb * _SUB, _LANE), f32)
+            for rows in (Ms * Ms, Ms, Ms * Ms, 1, Ms, Ms * Ms)),
+        scratch_shapes=[pltpu.VMEM((S * D, _SUB, _LANE), f32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(args[0], args[1], args[2], args[3], args[6], args[7], args[8], chk,
+      g_tile)
+
+    (psh, desh, osh, ovsh, b0sh, p0sh, datash, msh, wsh) = shapes
+    return (_unlay(grads[0], B, psh[1:]), _unlay(grads[1], B, desh[1:]),
+            _unlay(grads[2], B, osh[1:]), _unlay(grads[3], B, ovsh[1:]),
+            _unlay(grads[4], B, b0sh[1:]), _unlay(grads[5], B, p0sh[1:]),
+            jnp.zeros(datash, dtype=f32), jnp.zeros(msh, dtype=f32),
+            jnp.zeros(wsh, dtype=f32))
+
+
+_core_tvl.defvjp(_core_tvl_fwd, _core_tvl_bwd)
+
+
+# ---------------------------------------------------------------------------
 # custom_vjp wiring
 # ---------------------------------------------------------------------------
 
@@ -493,7 +792,11 @@ def batched_loglik_diff(spec: ModelSpec, params_batch, data, start=0, end=None,
 
     ``jax.grad`` flows through the hand-derived adjoint kernel for the state-
     space tensors and through ordinary JAX AD for the parameter unpacking and
-    loading construction.  Constant-measurement Kalman families only.
+    loading construction.  All three Kalman families: constant-measurement
+    DNS/AFNS take the hand-derived adjoint; the TVλ EKF takes the
+    checkpointed per-step ``jax.vjp`` adjoint (its measurement rows are
+    rebuilt from the state in-kernel, so there are no Z/d tensors to
+    differentiate — the loading gradients flow into the state adjoint).
     ``dtype`` defaults to f32 (the TPU compute type); f64 is accepted in
     interpret mode for tight test comparisons against ``jax.grad`` of the
     algebraically identical ``univariate_kf.get_loss``.
@@ -503,10 +806,9 @@ def batched_loglik_diff(spec: ModelSpec, params_batch, data, start=0, end=None,
     batch share one differentiable program.  Scalar ``start``/``end`` are
     ignored when given.
     """
-    if spec.family not in ("kalman_dns", "kalman_afns"):
-        raise ValueError(f"differentiable pallas kernel supports the "
-                         f"constant-measurement kalman families, not "
-                         f"{spec.family!r}")
+    if spec.family not in ("kalman_dns", "kalman_afns", "kalman_tvl"):
+        raise ValueError(f"differentiable pallas kernel supports the kalman "
+                         f"families, not {spec.family!r}")
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
 
@@ -518,12 +820,17 @@ def batched_loglik_diff(spec: ModelSpec, params_batch, data, start=0, end=None,
     if end is None:
         end = T
 
+    tvl = spec.family == "kalman_tvl"
+
     def precompute(pb):
         kp = jax.vmap(partial(unpack_kalman, spec))(pb)
+        state0 = jax.vmap(partial(init_state, spec))(kp)
+        if tvl:  # Z/d are built in-kernel from the state
+            return (kp.Phi, kp.delta, kp.Omega_state, kp.obs_var,
+                    state0.beta, state0.P)
         Z, d = jax.vmap(lambda k: measurement_setup(spec, k, f32))(kp)
         if d is None:
             d = jnp.zeros((B, N), dtype=f32)
-        state0 = jax.vmap(partial(init_state, spec))(kp)
         return (Z, d, kp.Phi, kp.delta, kp.Omega_state, kp.obs_var,
                 state0.beta, state0.P)
 
@@ -535,5 +842,6 @@ def batched_loglik_diff(spec: ModelSpec, params_batch, data, start=0, end=None,
     win = window_array(starts, ends, B, f32)
 
     tensors = precompute(params_batch)
-    return _core(spec, interpret, windowed, *tensors,
-                 jnp.asarray(data, dtype=f32), masks, win)
+    core = _core_tvl if tvl else _core
+    return core(spec, interpret, windowed, *tensors,
+                jnp.asarray(data, dtype=f32), masks, win)
