@@ -412,6 +412,30 @@ def simulate_dns_panel(rng, maturities, T=80, lam=0.5):
     return data + 5.0
 
 
+def simulate_sv_panel(rng, maturities, T, sv_phi, sv_sigma, lam=0.5,
+                      obs_var=4e-4):
+    """Panel from the stochastic-volatility measurement-error DGP matched to
+    ops/particle.py AND to ``stable_1c_params`` (same λ, Φ = 0.9 I, δ,
+    chol = 0.05 I, σ² = 4e-4), so the PF's model is exactly the simulator's:
+    a single log-volatility state h_t = φ_h h_{t−1} + σ_h η_t (h before the
+    first observation is one AR step from h₀ = 0, mirroring the filter's
+    draw-then-observe order) scales the common measurement variance,
+    y_t = Z β_t + ε_t with ε_t ~ N(0, σ² e^{h_t} I)."""
+    N = len(maturities)
+    Z = dns_loadings(np.log(lam - LAMBDA_FLOOR), maturities)
+    Phi = np.diag([0.9, 0.9, 0.9])
+    delta = np.array([5.0, -1.0, 0.5])
+    beta = np.linalg.solve(np.eye(3) - Phi, delta)
+    h = 0.0
+    data = np.zeros((N, T))
+    for t in range(T):
+        beta = delta + Phi @ beta + 0.05 * rng.standard_normal(3)
+        h = sv_phi * h + sv_sigma * rng.standard_normal()
+        data[:, t] = Z @ beta + np.sqrt(obs_var) * np.exp(0.5 * h) \
+            * rng.standard_normal(N)
+    return data
+
+
 def stable_1c_params(spec, dtype=np.float32):
     """A stationary, finite-loglik parameter point for the 1C (DNS Kalman)
     spec — λ = 0.5, small obs/state noise, Φ = 0.9 I.  Shared by the sharded
@@ -428,6 +452,24 @@ def stable_1c_params(spec, dtype=np.float32):
     p[a:b] = [5.0, -1.0, 0.5]
     a, b = spec.layout["phi"]
     p[a:b] = np.diag([0.9, 0.9, 0.9]).reshape(-1)
+    return p
+
+
+def stable_tvl_params(spec, dtype=np.float64):
+    """A stationary, finite-loglik parameter point for the TVλ EKF spec —
+    obs var 4e-4, chol 0.05 I, Φ = 0.9 I, δ giving a steady state near
+    (5, −1, 0.5) with λ ≈ 0.5 (β₄ = ln(0.49)·0.1 per component).  Shared by
+    the smoother-engine and fused-MLE tests (one copy, CLAUDE.md rule)."""
+    p = np.zeros(spec.n_params, dtype=dtype)
+    p[spec.layout["obs_var"][0]] = 4e-4
+    a, _ = spec.layout["chol"]
+    rows, cols = spec.chol_indices
+    for k, (r, c) in enumerate(zip(rows, cols)):
+        p[a + k] = 0.05 if r == c else 0.0
+    a, b = spec.layout["delta"]
+    p[a:b] = [0.5, -0.1, 0.05, 0.1 * np.log(0.49)]
+    a, b = spec.layout["phi"]
+    p[a:b] = np.diag([0.9, 0.9, 0.9, 0.9]).reshape(-1)
     return p
 
 

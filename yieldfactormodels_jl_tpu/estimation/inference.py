@@ -42,25 +42,32 @@ def _jitted_information(spec: ModelSpec, T: int):
 
 @register_engine_cache
 @lru_cache(maxsize=32)
-def _jitted_score_contributions(spec: ModelSpec, T: int):
+def _jitted_score_contributions(spec: ModelSpec, T: int, engine: str):
     """(T, P) per-step score matrix ∂ℓ_t/∂raw for the sandwich B-matrix —
     Kalman families only (their per-step outs['ll'] ARE loglik contributions;
     the prediction-error families' per-t losses are MSE terms, for which the
     QMLE sandwich is not the standard estimator).
 
-    Engine note: like api.smooth, this always runs the joint-covariance
-    forward pass — the per-step ll decomposition is what the sandwich needs,
-    and the loglik engines don't emit it.  A failed f32 Cholesky surfaces as
-    NaN scores, guarded by the caller; rerun in float64 in that case.
+    ``engine``: "joint" (per-step Cholesky) or "univariate" (Cholesky-free
+    sequential updates — same per-step ll decomposition, Koopman–Durbin).
+    The "sqrt"/"assoc" loglik engines don't emit per-step contributions;
+    callers resolve those to an error (mle_standard_errors).  A failed f32
+    factorization surfaces as NaN scores, guarded by the caller; rerun in
+    float64 in that case.
 
     jacfwd, not jacrev: the map is R^P → R^T with T ≫ P, so P forward JVPs
     beat T backward scan passes (and skip the O(T) residual stash)."""
     from ..models import kalman as K
+    from ..ops import univariate_kf
 
     def scores(raw, data, start, end):
         def contribs(r):
-            _, _, _, outs = K._scan_filter(
-                spec, transform_params(spec, r), data, start, end)
+            if engine == "univariate":
+                _, outs = univariate_kf.filter_moments(
+                    spec, transform_params(spec, r), data, start, end)
+            else:
+                _, _, _, outs = K._scan_filter(
+                    spec, transform_params(spec, r), data, start, end)
             mask = K.loglik_contrib_mask(start, end, data.shape[1])
             return jnp.where(mask, outs["ll"], 0.0)
 
@@ -70,13 +77,20 @@ def _jitted_score_contributions(spec: ModelSpec, T: int):
 
 
 def mle_standard_errors(spec: ModelSpec, params_hat, data, start=0, end=None,
-                        rcond: float = 1e-10, kind: str = "hessian"):
+                        rcond: float = 1e-10, kind: str = "hessian",
+                        engine=None):
     """Standard errors and covariance of a fitted CONSTRAINED parameter vector.
 
     ``kind="hessian"`` (default): observed-information covariance H⁻¹.
     ``kind="sandwich"``: the QMLE-robust Bollerslev–Wooldridge estimator
     H⁻¹ B H⁻¹ with B = Σ_t s_t s_tᵀ from the per-step score contributions
     (Kalman families only — valid under misspecified innovation densities).
+
+    ``engine`` (sandwich only): forward engine for the per-step score
+    decomposition — ``None`` reads ``config.kalman_engine()``; "joint" and
+    "univariate" are supported ("sqrt"/"assoc" don't emit per-step ll
+    contributions and raise).  The Hessian half always honors the configured
+    loglik engine through ``api.get_loss``.
 
     Returns ``(se, cov, cov_raw)``: delta-method standard errors (P,) and
     covariance (P, P) in the constrained space, plus the raw-space covariance.
@@ -96,6 +110,16 @@ def mle_standard_errors(spec: ModelSpec, params_hat, data, start=0, end=None,
             "kind='sandwich' needs per-step loglik contributions — Kalman "
             "families only (the prediction-error families' per-t terms are "
             "MSE contributions, not scores of a likelihood)")
+    if kind == "sandwich":
+        from .. import config
+
+        eng = engine or config.kalman_engine()
+        if eng not in ("joint", "univariate"):
+            raise ValueError(
+                f"sandwich standard errors: engine {eng!r} has no per-step "
+                f"loglik decomposition — 'joint' and 'univariate' are "
+                f"supported; pass engine= explicitly or "
+                f"config.set_kalman_engine('univariate')")
     data = jnp.asarray(data, dtype=spec.dtype)
     T = data.shape[1]
     if end is None:
@@ -115,9 +139,9 @@ def mle_standard_errors(spec: ModelSpec, params_hat, data, start=0, end=None,
     inv_w = np.where(good, 1.0 / np.where(good, w, 1.0), 0.0)
     Ainv = (V * inv_w) @ V.T                       # pseudo-inverse over good
     if kind == "sandwich":
-        S = np.asarray(_jitted_score_contributions(spec, T)(
+        S = np.asarray(_jitted_score_contributions(spec, T, eng)(
             raw, data, jnp.asarray(start), jnp.asarray(end)), dtype=np.float64)
-        if not np.isfinite(S).all():   # failed f32 joint forward pass
+        if not np.isfinite(S).all():   # failed f32 forward pass
             nanm = np.full((P, P), np.nan)
             return np.full(P, np.nan), nanm, nanm
         B = S.T @ S                                # Σ_t s_t s_tᵀ  (s_t = ∂ℓ_t)

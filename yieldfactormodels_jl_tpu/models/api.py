@@ -66,19 +66,20 @@ def predict(spec: ModelSpec, params, data):
     return _engine(spec).predict(spec, params, data)
 
 
-def smooth(spec: ModelSpec, params, data, start=0, end=None):
+def smooth(spec: ModelSpec, params, data, start=0, end=None, engine=None):
     """Fixed-interval RTS smoothed moments β_{t|T}, P_{t|T} (Kalman families
     only — see ops/smoother.py; beyond-reference capability).
 
-    Engine note: the forward pass is always the joint-covariance recursion
-    (models/kalman.py) regardless of ``set_kalman_engine`` — the RTS backward
-    pass consumes full P_{t|t}/P_{t+1|t} matrices, which the univariate/sqrt/
-    assoc loglik engines do not emit.  A failed f32 forward Cholesky poisons
-    the output with NaN; rerun in float64 in that case (the loglik engines'
-    f32 robustness does not transfer here)."""
+    Engine note: the forward pass honors ``engine`` /
+    ``config.set_kalman_engine`` for the moment-emitting engines — "joint"
+    (per-step Cholesky) and "univariate" (Cholesky-free, same posterior
+    moments).  "sqrt"/"assoc" do not emit the RTS moment set and raise a
+    clear error instead of silently substituting another engine.  A failed
+    f32 forward factorization poisons the output with NaN; rerun in float64
+    in that case."""
     from ..ops import smoother
 
-    return smoother.smooth(spec, params, data, start, end)
+    return smoother.smooth(spec, params, data, start, end, engine=engine)
 
 
 def init_state(spec: ModelSpec, params):

@@ -28,23 +28,44 @@ from ..models import kalman as K
 from ..models.specs import ModelSpec
 
 
-def smooth(spec: ModelSpec, params, data, start=0, end=None):
+def smooth(spec: ModelSpec, params, data, start=0, end=None, engine=None):
     """Smoothed moments for every t of the panel.
 
     Returns a dict:
       ``beta_smooth`` (Ms, T), ``P_smooth`` (T, Ms, Ms) — β_{t|T}, P_{t|T};
       ``beta_filt`` (Ms, T), ``P_filt`` (T, Ms, Ms) — the filtered β_{t|t},
       P_{t|t} for comparison (equal to the smoothed values at t = T−1).
+
+    ``engine``: forward-pass engine for the filtering moments — ``None``
+    reads ``config.kalman_engine()``.  Supported: ``"joint"`` (per-step
+    Cholesky) and ``"univariate"`` (Cholesky-free sequential updates,
+    algebraically the same posterior moments).  The ``"sqrt"``/``"assoc"``
+    loglik engines do not emit the (β_{t|t}, P_{t|t}, β_{t+1|t}, P_{t+1|t})
+    set the RTS backward pass consumes, so they raise here rather than
+    silently running a different engine than the caller selected.
     """
     if not spec.is_kalman:
         raise ValueError(
             f"smooth: RTS smoothing needs a state-space covariance recursion; "
             f"family {spec.family!r} is not a Kalman family")
+    from .. import config
+    from . import univariate_kf
+
+    eng = engine or config.kalman_engine()
+    if eng not in ("joint", "univariate"):
+        raise ValueError(
+            f"smooth: engine {eng!r} has no filtering-moments path — the RTS "
+            f"backward pass needs per-step (β, P) moments, which only the "
+            f"'joint' and 'univariate' engines emit.  Pass engine= "
+            f"explicitly or config.set_kalman_engine('univariate').")
     data = jnp.asarray(data, dtype=spec.dtype)
     T = data.shape[1]
     if end is None:
         end = T
-    kp, _, _, outs = K._scan_filter(spec, params, data, start, end)
+    if eng == "univariate":
+        kp, outs = univariate_kf.filter_moments(spec, params, data, start, end)
+    else:
+        kp, _, _, outs = K._scan_filter(spec, params, data, start, end)
 
     b_pred, P_pred = outs["beta_pred"], outs["P_pred"]    # (T, Ms), (T, Ms, Ms)
     b_upd, P_upd = outs["beta_upd"], outs["P_upd"]

@@ -76,11 +76,13 @@ def _sequential_update(Z, y_eff, beta, P, obs_var):
     return beta_u, P_u, ll, ok
 
 
-def get_loss(spec: ModelSpec, params, data, start=0, end=None):
-    """Gaussian loglik via sequential scalar updates — numerically equal to
-    ``models.kalman.get_loss`` (same windows/NaN/−Inf conventions), but with
-    no Cholesky/triangular solves: the per-step work is rank-1 FMAs that vmap
-    across draw/start/window batches as pure elementwise lanes."""
+def _filter_scan(spec: ModelSpec, params, data, start, end):
+    """THE sequential-update forward pass — single source of the engine's
+    NaN-column/window/failure semantics, shared by ``get_loss`` and
+    ``filter_moments`` so the loglik and the moments the smoother/sandwich
+    ride can never diverge.  Returns ``(kp, outs)``; ``outs['ll']`` follows
+    the joint form's per-step convention (0 unobserved, −Inf on a failed
+    innovation-variance chain, NOT contribution-masked)."""
     kp = unpack_kalman(spec, params)
     dtype = kp.Phi.dtype
     mats = spec.maturities_array
@@ -90,14 +92,11 @@ def get_loss(spec: ModelSpec, params, data, start=0, end=None):
 
     state0 = init_state(spec, kp)
     T = data.shape[1]
-    if end is None:
-        end = T
     t_idx = jnp.arange(T)
     observed = (t_idx >= start) & (t_idx < end)
-    contrib = loglik_contrib_mask(start, end, T)
 
     def body(state, inp):
-        y, obs_t, con_t = inp
+        y, obs_t = inp
         beta, P = state
         if spec.family == "kalman_tvl":
             # fixed-linearization effective observation for the EKF: with
@@ -119,11 +118,50 @@ def get_loss(spec: ModelSpec, params, data, start=0, end=None):
         P_m = P + (P_u - P) * obs_f
         beta_next = kp.delta + kp.Phi @ beta_m
         P_next = kp.Phi @ P_m @ kp.Phi.T + kp.Omega_state
-        ll_t = jnp.where(obs & con_t,
-                         jnp.where(ok, ll, -jnp.inf),
-                         0.0)
-        return KalmanState(beta_next, P_next), ll_t
+        ll_out = jnp.where(obs & ok, ll, jnp.where(obs, -jnp.inf, 0.0))
+        return (KalmanState(beta_next, P_next),
+                (beta, P, beta_m, P_m, ll_out))
 
-    _, lls = lax.scan(body, state0, (data.T, observed, contrib))
-    total = jnp.sum(lls)
+    _, (b_pred, P_pred, b_upd, P_upd, lls) = lax.scan(
+        body, state0, (data.T, observed))
+    return kp, {"beta_pred": b_pred, "P_pred": P_pred,
+                "beta_upd": b_upd, "P_upd": P_upd, "ll": lls}
+
+
+def get_loss(spec: ModelSpec, params, data, start=0, end=None):
+    """Gaussian loglik via sequential scalar updates — numerically equal to
+    ``models.kalman.get_loss`` (same windows/NaN/−Inf conventions), but with
+    no Cholesky/triangular solves: the per-step work is rank-1 FMAs that vmap
+    across draw/start/window batches as pure elementwise lanes.  (The moment
+    stacks the shared scan also emits are dead code here; jit/scan DCE prunes
+    them — same mechanism the joint engine's `_step` outputs rely on.)"""
+    T = data.shape[1]
+    if end is None:
+        end = T
+    _, outs = _filter_scan(spec, params, data, start, end)
+    contrib = loglik_contrib_mask(start, end, T)
+    # per-step joint convention → loss gating: where(obs & contrib,
+    # where(ok, ll, −Inf), 0) ≡ where(contrib, ll_out, 0) since ll_out is
+    # already 0 on unobserved steps and −Inf on failed observed ones
+    total = jnp.sum(jnp.where(contrib, outs["ll"], 0.0))
     return jnp.where(jnp.isfinite(total), total, -jnp.inf)
+
+
+def filter_moments(spec: ModelSpec, params, data, start=0, end=None):
+    """Per-step filtering moments via the sequential-update engine.
+
+    Returns ``(kp, outs)`` with ``outs`` matching the joint form's moment
+    outputs (models/kalman.py `_step`): ``beta_pred``/``P_pred`` are the
+    incoming predicted moments, ``beta_upd``/``P_upd`` the obs-blended
+    posterior moments, and ``ll`` the per-step loglik in the joint
+    convention — 0 on unobserved steps, −Inf where the innovation variance
+    chain failed (the joint form's failed-Cholesky sentinel), NOT
+    contribution-masked.  The posterior moments are algebraically identical
+    to the joint update's (Koopman–Durbin), so the RTS smoother
+    (ops/smoother.py) and the sandwich score decomposition
+    (estimation/inference.py) can ride this Cholesky-free engine.
+    """
+    T = data.shape[1]
+    if end is None:
+        end = T
+    return _filter_scan(spec, params, data, start, end)
