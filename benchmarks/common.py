@@ -114,6 +114,26 @@ def afns5_params(spec):
     return p
 
 
+def ssd_nns_params(spec):
+    """Plausible constrained 1SSD-NNS (score-driven neural) vector — the
+    reference driver's flagship model (test.jl:22-27).  Layout: EWMA step
+    sizes A, persistence B, 18 neural-loading weights ω, state intercept δ,
+    transition Φ (models/specs.py msed_neural)."""
+    rng = np.random.default_rng(3)
+    p = np.zeros(spec.n_params)
+    lo, hi = spec.layout["A"]
+    p[lo:hi] = 1e-4
+    lo, hi = spec.layout["B"]
+    p[lo:hi] = 0.98
+    lo, hi = spec.layout["omega"]
+    p[lo:hi] = rng.standard_normal(hi - lo) / 10
+    lo, hi = spec.layout["delta"]
+    p[lo:hi] = [0.3, -0.1, 0.05]
+    lo, hi = spec.layout["phi"]
+    p[lo:hi] = np.diag([0.95, 0.9, 0.85]).T.reshape(-1)
+    return p
+
+
 def jitter_starts(p, n_starts, seed=1, scale=0.05):
     """(S, P) stack of jittered copies of ``p`` (multi-start initialization)."""
     rng = np.random.default_rng(seed)

@@ -35,6 +35,7 @@ print("RESULT " + json.dumps([wall, descr]))
     ("afns5-sv-pf", 250),     # 4 draws
     ("rolling-240", 48),      # 5 windows
     ("bootstrap-2000", 100),  # 20 resamples
+    ("ssd-nns-m3", 10),       # 1 start x 1 group iter
 ])
 def test_benchmark_config_runs(name, scale):
     env = {k: v for k, v in os.environ.items()

@@ -31,10 +31,12 @@ class NMState(NamedTuple):
     n_fev: jnp.ndarray    # ()
 
 
-def _initial_simplex(x0):
+def _initial_simplex(x0, step=None):
     n = x0.shape[0]
     pts = jnp.broadcast_to(x0, (n, n))
-    pts = pts + jnp.diag(0.025 + 0.05 * x0)
+    if step is None:
+        step = 0.025 + 0.05 * x0
+    pts = pts + jnp.diag(step * jnp.ones_like(x0))
     return jnp.concatenate([x0[None, :], pts], axis=0)
 
 
@@ -43,15 +45,22 @@ def nelder_mead(
     x0,
     max_iters: int = 500,
     f_tol: float = 1e-8,
+    step=None,
 ):
-    """Returns (x_best, f_best, n_iters)."""
+    """Returns (x_best, f_best, n_iters).
+
+    ``step``: optional scalar or (n,) per-coordinate initial simplex offsets.
+    The default (0.025 + 0.05·x₀) suits parameters already near scale 1; a
+    coordinate that must travel far (e.g. the SV hyperparameters' raw
+    bijection values, estimation/sv.py) needs a commensurate step or the
+    simplex spends its budget expanding."""
     n = x0.shape[0]
     alpha = 1.0
     beta = 1.0 + 2.0 / n
     gamma = 0.75 - 1.0 / (2.0 * n)
     delta = 1.0 - 1.0 / n
 
-    simplex0 = _initial_simplex(x0)
+    simplex0 = _initial_simplex(x0, step)
     fvals0 = jax.vmap(fun)(simplex0)
     state0 = NMState(simplex0, fvals0, jnp.zeros((), jnp.int32), jnp.asarray(n + 1, jnp.int32))
 

@@ -28,6 +28,8 @@ from ..config import register_engine_cache
 from ..models.params import transform_params
 from ..models.specs import ModelSpec
 from ..ops.particle import particle_filter_loglik
+from ..utils.transformations import (from_11_to_R, from_pos_to_R,
+                                     from_R_to_11, from_R_to_pos)
 from .neldermead import nelder_mead
 
 _PENALTY = 1e12
@@ -50,6 +52,33 @@ def _jitted_sv_search(spec: ModelSpec, T: int, n_particles: int,
     return jax.jit(jax.vmap(single, in_axes=(0, None, None)))
 
 
+@register_engine_cache
+@lru_cache(maxsize=32)
+def _jitted_sv_search_full(spec: ModelSpec, T: int, n_particles: int,
+                           max_iters: int, f_tol: float):
+    """Search vector = (raw model params, raw φ_h, raw σ_h): the SV
+    hyperparameters ride the same simplex through their natural bijections
+    (φ_h ∈ (−1,1) via 2σ(x)−1, σ_h > 0 via exp — utils/transformations)."""
+    def single(raw0, data, key):
+        def obj(raw):
+            phi_h = from_R_to_11(raw[-2])
+            sigma_h = from_R_to_pos(raw[-1])
+            ll = particle_filter_loglik(
+                spec, transform_params(spec, raw[:-2]), data, key,
+                n_particles=n_particles, sv_phi=phi_h, sv_sigma=sigma_h)
+            return jnp.where(jnp.isfinite(ll), -ll, _PENALTY)
+
+        # the SV raw coordinates live on bijection scales where a unit is a
+        # big move in (φ_h, σ_h) — give them a commensurate initial step so
+        # the simplex can actually reach them within the iteration budget
+        step = jnp.concatenate([0.025 + 0.05 * raw0[:-2],
+                                jnp.full((2,), 0.5, dtype=raw0.dtype)])
+        return nelder_mead(obj, raw0, max_iters=max_iters, f_tol=f_tol,
+                           step=step)
+
+    return jax.jit(jax.vmap(single, in_axes=(0, None, None)))
+
+
 def estimate_sv(
     spec: ModelSpec,
     data,
@@ -60,12 +89,19 @@ def estimate_sv(
     sv_sigma: float = 0.2,
     max_iters: int = 200,
     f_tol: float = 1e-6,
+    estimate_sv_params: bool = False,
 ):
     """Multi-start simulated MLE under SV measurement errors.
 
     ``raw_starts`` is (S, P) (or (P,)) of UNCONSTRAINED parameters.  Returns
     ``(best_params_constrained, best_ll, lls (S,), iters (S,))`` with the PF
     loglik evaluated at the shared common-random-numbers key.
+
+    ``estimate_sv_params=False`` holds the volatility dynamics (φ_h, σ_h)
+    fixed at ``sv_phi``/``sv_sigma``.  With ``estimate_sv_params=True`` they
+    join the searched vector (``sv_phi``/``sv_sigma`` become the starting
+    point, mapped through the (−1,1)/positive bijections) and a fifth return
+    value ``(phi_h_hat, sigma_h_hat)`` carries the estimates.
     """
     if key is None:
         key = jax.random.PRNGKey(0)
@@ -73,9 +109,19 @@ def estimate_sv(
     raw_starts = jnp.asarray(raw_starts, dtype=spec.dtype)
     if raw_starts.ndim == 1:
         raw_starts = raw_starts[None, :]
-    fn = _jitted_sv_search(spec, data.shape[1], n_particles,
-                           float(sv_phi), float(sv_sigma), int(max_iters),
-                           float(f_tol))
+    if estimate_sv_params:
+        sv0 = jnp.asarray([from_11_to_R(jnp.asarray(float(sv_phi))),
+                           from_pos_to_R(jnp.asarray(float(sv_sigma)))],
+                          dtype=spec.dtype)
+        raw_starts = jnp.concatenate(
+            [raw_starts,
+             jnp.broadcast_to(sv0, (raw_starts.shape[0], 2))], axis=1)
+        fn = _jitted_sv_search_full(spec, data.shape[1], n_particles,
+                                    int(max_iters), float(f_tol))
+    else:
+        fn = _jitted_sv_search(spec, data.shape[1], n_particles,
+                               float(sv_phi), float(sv_sigma), int(max_iters),
+                               float(f_tol))
     xs, fs, iters = fn(raw_starts, data, key)
     lls = -np.asarray(fs, dtype=np.float64)
     lls[lls <= -_PENALTY * 0.99] = -np.inf
@@ -88,5 +134,11 @@ def estimate_sv(
             f"{lls.shape[0]} simplex searches — starts/model/data are "
             f"structurally incompatible")
     best_j = int(np.argmax(np.where(np.isfinite(lls), lls, -np.inf)))
+    if estimate_sv_params:
+        best = transform_params(spec, xs[best_j][:-2])
+        sv_hat = (float(from_R_to_11(xs[best_j][-2])),
+                  float(from_R_to_pos(xs[best_j][-1])))
+        return (np.asarray(best), float(lls[best_j]), lls, np.asarray(iters),
+                sv_hat)
     best = transform_params(spec, xs[best_j])
     return np.asarray(best), float(lls[best_j]), lls, np.asarray(iters)
