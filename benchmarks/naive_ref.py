@@ -1,0 +1,324 @@
+"""Reference-equivalent naive CPU walls — the second ratio column.
+
+BASELINE.md's config table compares the SAME optimal algorithm on device vs
+a pinned CPU core.  That is the honest algorithm-for-algorithm ratio, but it
+is not what a user of the reference experiences: the reference runs 1-thread
+per-step loops (/root/reference/src/models/kalman/filter.jl:125-209 and
+friends).  This script MEASURES that cost at FULL scale for the configs
+where a naive run is feasible, using the same style of stand-in as
+``bench.py``'s oracle line: NumPy per-step loops (tests/oracle.py) as the
+proxy for a compiled per-step Julia loop — vectorized only *within* a step,
+python loop over time/draws/resamples, one thread.
+
+Measured here (full scale, no extrapolation):
+  1. dns3-mle        scipy L-BFGS-B (2-point FD gradients, the naive stand-in
+                     for ForwardDiff replays) over the NumPy per-step filter
+  3. afns5-sv-pf     the same Rao-Blackwellized sqrt PF ported to NumPy
+                     per-step loops, 1,000 draws x 1,000 particles
+  5. bootstrap-2000  per-step re-OLS static filter, 2,000 x 64 passes
+
+NOT measured — a full-scale naive run is infeasible (hours to days), and the
+table in BASELINE.md reports an explicit LOWER BOUND computed from a unit
+cost that IS measured here times the exact pass count (labeled as a bound,
+never presented as a measurement):
+  2. afns5-mle64     >= 64 starts x 100 iters x 2 passes x (measured
+                     seconds/pass of the AFNS5 naive filter)
+  4. rolling-240     >= 240 windows x 2 starts x 50 iters x 2 passes x
+                     (measured seconds/pass at mean window length)
+  6. ssd-nns-m3      >= (256 A/B-grid candidates + 10 group iters x 25
+                     passes for the ONE surviving start — the reference's
+                     MSED try_initializations collapses M starts to the
+                     best grid candidate, optimization.jl:153) x (measured
+                     seconds/pass of the naive score-driven filter)
+
+Usage: taskset -c <core> python benchmarks/naive_ref.py [config ...]
+Emits one JSON line per config: {"config", "naive_wall_s" | "unit_s", ...}.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+import time
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+for p in (HERE, ROOT, os.path.join(ROOT, "tests")):
+    if p not in sys.path:
+        sys.path.insert(0, p)
+
+import common  # noqa: E402  (benchmarks/common.py)
+import oracle  # noqa: E402  (tests/oracle.py — independent NumPy loops)
+
+_LOG_2PI = math.log(2.0 * math.pi)
+
+
+def _dns3_matrices(spec, p):
+    """Constrained DNS3 vector → (Z, Phi, delta, Omega, obs_var) in NumPy."""
+    lo, hi = spec.layout["gamma"]
+    Z = oracle.dns_loadings(float(p[lo]), np.asarray(spec.maturities))
+    obs_var = float(p[spec.layout["obs_var"][0]])
+    Ms = spec.state_dim
+    C = np.zeros((Ms, Ms))
+    rows, cols = spec.chol_indices
+    a, _ = spec.layout["chol"]
+    for k, (r, c) in enumerate(zip(rows, cols)):
+        C[r, c] = p[a + k]
+    lo, hi = spec.layout["delta"]
+    delta = np.asarray(p[lo:hi], dtype=np.float64)
+    lo, hi = spec.layout["phi"]
+    Phi = np.asarray(p[lo:hi], dtype=np.float64).reshape(Ms, Ms)
+    return Z, Phi, delta, C @ C.T, obs_var
+
+
+def _np_transform(codes, raw):
+    """NumPy copy of utils/transformations.apply_transforms (0 identity,
+    1 exp, 2 2σ(x)−1) — the raw→constrained bijections the reference
+    optimizes through."""
+    out = raw.copy()
+    out = np.where(codes == 1, np.exp(raw), out)
+    out = np.where(codes == 2, 2.0 / (1.0 + np.exp(-raw)) - 1.0, out)
+    return out
+
+
+def _np_untransform(codes, p):
+    out = p.copy()
+    out = np.where(codes == 1, np.log(np.maximum(p, 1e-300)), out)
+    with np.errstate(divide="ignore"):
+        out = np.where(codes == 2, np.log((1.0 + p) / np.maximum(1.0 - p, 1e-300)),
+                       out)
+    return out
+
+
+def naive_dns3_mle():
+    """Config 1: 200-iteration L-BFGS over the per-step NumPy filter with
+    2-point finite-difference gradients (the naive stand-in for the
+    reference's ForwardDiff filter replays), in RAW (bijected) space like
+    the reference's optimizer."""
+    from scipy.optimize import minimize
+    from yieldfactormodels_jl_tpu import create_model
+
+    spec, _ = create_model("1C", tuple(common.MATURITIES), float_type="float32")
+    data = np.asarray(common.dns_panel(), dtype=np.float64)
+    p0 = np.asarray(common.dns_params(spec), dtype=np.float64)
+    codes = np.asarray(spec.transform_codes)
+    raw0 = _np_untransform(codes, p0)
+    nfev = [0]
+
+    def nll(raw):
+        nfev[0] += 1
+        Z, Phi, delta, Om, ov = _dns3_matrices(spec, _np_transform(codes, raw))
+        try:
+            ll = oracle.kalman_filter_loglik(Z, Phi, delta, Om, ov, data)
+        except np.linalg.LinAlgError:
+            # probe stepped into singular-F territory; the reference
+            # penalizes invalid points the same way (-Inf -> penalty)
+            return 1e12
+        return -ll if np.isfinite(ll) else 1e12
+
+    t0 = time.perf_counter()
+    res = minimize(nll, raw0, method="L-BFGS-B",
+                   options=dict(maxiter=200, maxfun=10 ** 7))
+    wall = time.perf_counter() - t0
+    return wall, (f"{int(res.nit)} LBFGS iters, {nfev[0]} filter passes "
+                  f"(2-point FD grads), ll={-res.fun:.1f}")
+
+
+def _afns5_tensors(spec, draws):
+    """Per-draw (Z, d, Phi, delta, chol_Om, beta0, S0) via the package's
+    unpack (tiny vs the 360-step loops being timed), as NumPy arrays."""
+    import jax.numpy as jnp
+    from functools import partial
+    import jax
+    from yieldfactormodels_jl_tpu.models import kalman as K
+    from yieldfactormodels_jl_tpu.models.params import unpack_kalman
+    from yieldfactormodels_jl_tpu.ops.particle import _measurement
+
+    out = []
+    for p in draws:
+        kp = unpack_kalman(spec, jnp.asarray(p, dtype=jnp.float64))
+        Z, d = _measurement(spec, kp, jnp.float64)
+        st = K.init_state(spec, kp)
+        P0 = 0.5 * (st.P + st.P.T) + 1e-9 * jnp.eye(spec.state_dim)
+        Om = (0.5 * (kp.Omega_state + kp.Omega_state.T)
+              + 1e-12 * jnp.eye(spec.state_dim))
+        out.append(tuple(np.asarray(x, dtype=np.float64) for x in (
+            Z, d, kp.Phi, kp.delta, jnp.linalg.cholesky(Om),
+            st.beta, jnp.linalg.cholesky(P0), kp.obs_var)))
+    return out
+
+
+def _naive_pf_one_draw(rng, Z, d, Phi, delta, cholOm, beta0, S0, obs_var,
+                       data, Pn, sv_phi=0.95, sv_sigma=0.2, ess_frac=0.5):
+    """One draw of the Rao-Blackwellized sqrt PF as per-step NumPy loops —
+    the same algorithm as ops/particle.py (Potter scalar updates, systematic
+    resampling), vectorized only across the particle axis within a step."""
+    Ms, N = beta0.shape[0], Z.shape[0]
+    T = data.shape[1]
+    beta = np.repeat(beta0[:, None], Pn, axis=1)           # (Ms, Pn)
+    S = np.repeat(S0[:, :, None], Pn, axis=2)              # (Ms, Ms, Pn)
+    h = np.zeros(Pn)
+    logw = np.full(Pn, -math.log(Pn))
+    total = 0.0
+    for t in range(T - 1):
+        y = data[:, t]
+        h = sv_phi * h + sv_sigma * rng.standard_normal(Pn)
+        obs = bool(np.all(np.isfinite(y)))
+        r = obs_var * np.exp(h)
+        sqrt_r = np.sqrt(r)
+        b_u, S_u = beta.copy(), S.copy()
+        ll = np.zeros(Pn)
+        for i in range(N):
+            z = Z[i]
+            phi = np.einsum("mkp,m->kp", S_u, z)           # Sᵀz (Ms, Pn)
+            f = np.einsum("kp,kp->p", phi, phi) + r
+            v = y[i] - d[i] - z @ b_u
+            Sphi = np.einsum("mkp,kp->mp", S_u, phi)       # P z
+            b_u = b_u + Sphi * (v / f)
+            alpha = 1.0 / (f + sqrt_r * np.sqrt(f))
+            S_u = S_u - alpha[None, None, :] * (Sphi[:, None, :] * phi[None, :, :])
+            ll -= 0.5 * (np.log(f) + v * v / f + _LOG_2PI)
+        if obs:
+            beta, S = b_u, S_u
+        beta = delta[:, None] + Phi @ beta
+        A = np.einsum("ij,jkp->ikp", Phi, S)
+        # P = A Aᵀ + Ω, refactored per particle (LAPACK per-step batch loop)
+        P = np.einsum("ikp,jkp->ijp", A, A) + (cholOm @ cholOm.T)[:, :, None]
+        S = np.linalg.cholesky(P.transpose(2, 0, 1)).transpose(1, 2, 0)
+        contributes = obs and t > 0
+        if contributes:
+            logw = logw + ll
+            m = logw.max()
+            step_ll = m + math.log(np.exp(logw - m).sum())
+            total += step_ll
+            logw -= step_ll
+            w = np.exp(logw)
+            ess = 1.0 / np.sum(w * w)
+            if ess < ess_frac * Pn:
+                pos = (np.arange(Pn) + rng.uniform()) / Pn
+                idx = np.searchsorted(np.cumsum(w), pos)
+                beta, S, h = beta[:, idx], S[:, :, idx], h[idx]
+                logw = np.full(Pn, -math.log(Pn))
+    return total
+
+
+def naive_afns5_sv_pf(n_draws=1000, n_particles=1000):
+    """Config 3: the full 1,000-draw x 1,000-particle PF sweep, per-step
+    NumPy loops, same draws/panel as run_all's config 3."""
+    from yieldfactormodels_jl_tpu import create_model
+
+    spec, _ = create_model("AFNS5", tuple(common.MATURITIES), float_type="float32")
+    data = np.asarray(common.afns5_panel(), dtype=np.float64)
+    draws = common.stationary_draws(spec, common.afns5_params(spec), n_draws,
+                                    scale=0.02)
+    tensors = _afns5_tensors(spec, draws)
+    rng = np.random.default_rng(0)
+    t0 = time.perf_counter()
+    lls = [_naive_pf_one_draw(rng, *tt[:7], float(tt[7]), data, n_particles)
+           for tt in tensors]
+    wall = time.perf_counter() - t0
+    fin = int(np.isfinite(np.asarray(lls)).sum())
+    return wall, f"{n_draws} draws x {n_particles} particles, finite {fin}/{n_draws}"
+
+
+def naive_bootstrap(n_resamples=2000, n_lambdas=64, block_len=12):
+    """Config 5: per-(resample, λ) static-filter passes with per-step re-OLS
+    (models/filter.jl:93-110 semantics via tests/oracle.static_filter)."""
+    from yieldfactormodels_jl_tpu import create_model
+
+    spec, _ = create_model("NS", tuple(common.MATURITIES), float_type="float32")
+    data = np.asarray(common.dns_panel(), dtype=np.float64)
+    N, T = data.shape
+    grid = np.linspace(0.1, 1.2, n_lambdas)
+    delta = np.array([0.08, -0.06, 0.03])
+    Phi = np.diag([0.9, 0.9, 0.9])
+    rng = np.random.default_rng(0)
+    n_blocks = -(-T // block_len)
+    t0 = time.perf_counter()
+    losses = np.zeros((n_resamples, n_lambdas))
+    Zs = [oracle.dns_loadings(math.log(lam - 1e-2), np.asarray(common.MATURITIES))
+          for lam in grid]
+    for r in range(n_resamples):
+        starts = rng.integers(0, T - block_len + 1, n_blocks)
+        idx = (starts[:, None] + np.arange(block_len)[None, :]).reshape(-1)[:T]
+        resampled = data[:, idx]
+        for g in range(n_lambdas):
+            preds = oracle.static_filter(Zs[g], delta, Phi, resampled)
+            v = resampled[:, 1:] - preds[:, :-1]
+            losses[r, g] = -np.sum(v * v) / N / T
+    wall = time.perf_counter() - t0
+    return wall, f"{n_resamples} resamples x {n_lambdas} lambdas, per-step re-OLS"
+
+
+def unit_afns5_pass():
+    """Measured seconds per naive AFNS5 filter pass (the unit behind the
+    config-2/4 lower bounds; same oracle loop bench.py uses)."""
+    from yieldfactormodels_jl_tpu import create_model
+    import jax.numpy as jnp
+    from yieldfactormodels_jl_tpu.models import kalman as K
+    from yieldfactormodels_jl_tpu.models.params import unpack_kalman
+    from yieldfactormodels_jl_tpu.ops.particle import _measurement
+
+    spec, _ = create_model("AFNS5", tuple(common.MATURITIES), float_type="float32")
+    data = np.asarray(common.afns5_panel(), dtype=np.float64)
+    p = common.afns5_params(spec)
+    kp = unpack_kalman(spec, jnp.asarray(p, dtype=jnp.float64))
+    Z, d = _measurement(spec, kp, jnp.float64)
+    Z, dv = np.asarray(Z, dtype=np.float64), np.asarray(d, dtype=np.float64)
+    Om = np.asarray(kp.Omega_state, dtype=np.float64)
+    reps = 5
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        oracle.kalman_filter_loglik(Z, np.asarray(kp.Phi), np.asarray(kp.delta),
+                                    Om, float(kp.obs_var), data - dv[:, None])
+    return (time.perf_counter() - t0) / reps, f"mean of {reps} full-panel passes"
+
+
+def unit_ssd_nns_pass():
+    """Measured seconds per naive score-driven-neural filter pass (config-6
+    lower-bound unit): tests/oracle.msed_neural_filter — per-step loop with
+    the finite-difference inner score, the NumPy stand-in for the
+    reference's per-step AD score."""
+    from yieldfactormodels_jl_tpu import create_model
+
+    spec, _ = create_model("1SSD-NNS", tuple(common.MATURITIES),
+                           float_type="float32")
+    data = np.asarray(common.dns_panel(), dtype=np.float64)
+    p = common.ssd_nns_params(spec)
+    expand = lambda u: np.concatenate([np.full(9, u[0]), np.full(9, u[1])])
+    lo, hi = spec.layout["A"]; A = expand(p[lo:hi])
+    lo, hi = spec.layout["B"]; B = expand(p[lo:hi])
+    lo, hi = spec.layout["omega"]; omega = np.asarray(p[lo:hi])
+    lo, hi = spec.layout["delta"]; delta = np.asarray(p[lo:hi])
+    lo, hi = spec.layout["phi"]; Phi = np.asarray(p[lo:hi]).reshape(3, 3).T
+    struct = {"A": A, "B": B, "omega": omega, "delta": delta, "Phi": Phi}
+    t0 = time.perf_counter()
+    oracle.msed_neural_filter(struct, np.asarray(common.MATURITIES), data,
+                              transform_bool=True, scale_grad=True,
+                              forget_factor=spec.forget_factor)
+    return time.perf_counter() - t0, "1 full-panel pass (FD inner score)"
+
+
+RUNNERS = {
+    "dns3-mle": naive_dns3_mle,
+    "afns5-sv-pf": naive_afns5_sv_pf,
+    "bootstrap-2000": naive_bootstrap,
+    "unit-afns5-pass": unit_afns5_pass,
+    "unit-ssd-pass": unit_ssd_nns_pass,
+}
+
+
+def main(argv):
+    names = argv or list(RUNNERS)
+    for name in names:
+        wall, descr = RUNNERS[name]()
+        print(json.dumps({"config": name, "naive_wall_s": round(wall, 3),
+                          "work": descr}), flush=True)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,56 @@
+"""Guard the naive reference-equivalent measurement harness against rot.
+
+benchmarks/naive_ref.py produces the second ratio column of BASELINE.md's
+dual-ratio table; these smokes keep it importable/runnable and pin the one
+checkable numeric property: with sv_sigma -> 0 the naive NumPy particle
+filter collapses to the exact Kalman log-likelihood (the same collapse
+tests/test_extensions.py pins for the jitted PF).
+"""
+
+import os
+import sys
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+for p in (os.path.join(ROOT, "benchmarks"), ROOT):
+    if p not in sys.path:
+        sys.path.insert(0, p)
+
+import common  # noqa: E402
+import naive_ref  # noqa: E402
+
+
+def test_units_and_tiny_configs_run():
+    w, d = naive_ref.unit_afns5_pass()
+    assert w > 0 and "passes" in d
+    w, d = naive_ref.naive_bootstrap(n_resamples=3, n_lambdas=2)
+    assert w > 0
+    w, d = naive_ref.naive_afns5_sv_pf(n_draws=1, n_particles=20)
+    assert w > 0 and "finite 1/1" in d
+
+
+def test_naive_pf_collapses_to_kalman_loglik():
+    """sv_sigma = 0 (and h0 = 0) makes every particle identical, so the
+    naive PF loglik must equal the exact Kalman loglik of the same draw."""
+    import oracle  # tests/oracle.py (sys.path has tests/ under pytest)
+    from yieldfactormodels_jl_tpu import create_model
+
+    spec, _ = create_model("AFNS5", tuple(common.MATURITIES),
+                           float_type="float32")
+    data = np.asarray(common.afns5_panel(), dtype=np.float64)[:, :40]
+    p = common.afns5_params(spec)
+    (tt,) = naive_ref._afns5_tensors(spec, [p])
+    Z, d, Phi, delta, cholOm, beta0, S0, obs_var = tt
+    rng = np.random.default_rng(0)
+    got = naive_ref._naive_pf_one_draw(
+        rng, Z, d, Phi, delta, cholOm, beta0, S0, float(obs_var), data,
+        Pn=8, sv_phi=0.9, sv_sigma=0.0)
+    # exact Kalman loglik: the oracle loop shares the PF's conventions
+    # (columns 0..T-2 processed, first innovation skipped); rtol absorbs the
+    # PF init's 1e-9 PSD jitter on P0
+    want = oracle.kalman_filter_loglik(
+        Z, Phi, delta, cholOm @ cholOm.T, float(obs_var),
+        data - d[:, None])
+    np.testing.assert_allclose(got, want, rtol=1e-5)
