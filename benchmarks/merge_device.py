@@ -1,0 +1,82 @@
+"""Merge a device-side measurement log into benchmarks/results.json.
+
+The round-3 probe loop (BASELINE.md "TPU availability" note) runs
+``run_all.py --side device`` for all six configs when the relay recovers
+and appends the JSON lines to its log.  This script folds those lines into
+``results.json`` as COHERENT pairs against the round's clean CPU walls, so
+the whole device sequence needs no manual bookkeeping:
+
+    python benchmarks/merge_device.py /tmp/r3/probe_loop.log
+
+CPU walls of record (measured this round / carried where the kernel is
+unchanged — see BASELINE.md round-3 section):
+  dns3-mle 4.252 (r2, code unchanged), afns5-mle64 648.665 (r2),
+  afns5-sv-pf 307.3 (r2 lane-major re-measure), rolling-240 442.936 (r2),
+  bootstrap-2000 0.957 (r2 MXU-fused re-measure), ssd-nns-m3 177.803 (r3
+  clean window).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+CPU_WALLS = {
+    "dns3-mle": 4.252,
+    "afns5-mle64": 648.665,
+    "afns5-sv-pf": 307.3,
+    "rolling-240": 442.936,
+    "bootstrap-2000": 0.957,
+    "ssd-nns-m3": 177.803,
+}
+
+
+def main(log_path: str) -> None:
+    device = {}
+    with open(log_path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict) and rec.get("side") == "device":
+                device[rec["config"]] = rec  # last occurrence wins
+
+    out_path = os.path.join(HERE, "results.json")
+    previous = {}
+    if os.path.isfile(out_path):
+        previous = {r["config"]: r for r in json.load(open(out_path))}
+
+    merged = []
+    extra = [n for n in previous if n not in CPU_WALLS]
+    for name in list(CPU_WALLS) + extra:  # never drop unknown configs
+        cpu_wall = CPU_WALLS.get(name)
+        if cpu_wall is None:
+            merged.append(previous[name])
+            print(json.dumps(previous[name]))
+            continue
+        rec = previous.get(name, {"config": name})
+        if name in device:
+            # coherent pair: fresh device wall against this round's CPU wall
+            rec["cpu_scale"] = 1
+            rec["cpu_wall_s_scaled"] = cpu_wall
+            rec["cpu_wall_s_est"] = cpu_wall
+            rec["device_wall_s"] = device[name]["wall_s"]
+            rec["work"] = device[name]["work"]
+            rec["speedup_vs_1core"] = round(cpu_wall / rec["device_wall_s"], 2)
+        # no device record -> leave the previous (coherent r2) pair verbatim
+        # rather than mixing a new CPU wall with a stale device wall
+        if rec != {"config": name}:
+            merged.append(rec)
+            print(json.dumps(rec))
+    with open(out_path, "w") as f:
+        json.dump(merged, f, indent=2)
+    sys.stderr.write(f"# wrote {out_path} ({len(device)} device records "
+                     f"from {log_path})\n")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "/tmp/r3/probe_loop.log")

@@ -132,8 +132,6 @@ def _afns5_tensors(spec, draws):
     """Per-draw (Z, d, Phi, delta, chol_Om, beta0, S0) via the package's
     unpack (tiny vs the 360-step loops being timed), as NumPy arrays."""
     import jax.numpy as jnp
-    from functools import partial
-    import jax
     from yieldfactormodels_jl_tpu.models import kalman as K
     from yieldfactormodels_jl_tpu.models.params import unpack_kalman
     from yieldfactormodels_jl_tpu.ops.particle import _measurement

@@ -7,7 +7,7 @@ Workloads (full scale, from BASELINE.json + VERDICT r2 #3):
                      draws (1,000 particles each)
   4. rolling-240     240 expanding windows × 2 starts re-estimation + 12-step
                      forecasts
-  5. bootstrap-2000  2,000 moving-block resamples × 16-point λ grid
+  5. bootstrap-2000  2,000 moving-block resamples × 64-point λ grid
   6. ssd-nns-m3      1SSD-NNS (the reference driver's flagship) block-coordinate
                      estimation: 256-candidate A/B init grid + best start
                      (reference try_initializations semantics) × 10 group iters
