@@ -51,12 +51,19 @@ def main(log_path: str) -> None:
         previous = {r["config"]: r for r in json.load(open(out_path))}
 
     merged = []
-    extra = [n for n in previous if n not in CPU_WALLS]
-    for name in list(CPU_WALLS) + extra:  # never drop unknown configs
+    # never drop unknown configs, from either side (previous ledger entries
+    # AND fresh device records for configs this script doesn't know yet)
+    extra = list(dict.fromkeys(
+        n for n in list(previous) + list(device) if n not in CPU_WALLS))
+    for name in list(CPU_WALLS) + extra:
         cpu_wall = CPU_WALLS.get(name)
         if cpu_wall is None:
-            merged.append(previous[name])
-            print(json.dumps(previous[name]))
+            rec = previous.get(name, {"config": name})
+            if name in device:  # fresh device wall with no known CPU wall:
+                rec["device_wall_s"] = device[name]["wall_s"]
+                rec["work"] = device[name]["work"]
+            merged.append(rec)
+            print(json.dumps(rec))
             continue
         rec = previous.get(name, {"config": name})
         if name in device:
@@ -66,7 +73,11 @@ def main(log_path: str) -> None:
             rec["cpu_wall_s_est"] = cpu_wall
             rec["device_wall_s"] = device[name]["wall_s"]
             rec["work"] = device[name]["work"]
-            rec["speedup_vs_1core"] = round(cpu_wall / rec["device_wall_s"], 2)
+            if rec["device_wall_s"] > 0:  # rounded-to-0 sub-ms walls
+                rec["speedup_vs_1core"] = round(
+                    cpu_wall / rec["device_wall_s"], 2)
+            else:
+                rec.pop("speedup_vs_1core", None)
         # no device record -> leave the previous (coherent r2) pair verbatim
         # rather than mixing a new CPU wall with a stale device wall
         if rec != {"config": name}:

@@ -455,6 +455,42 @@ def stable_1c_params(spec, dtype=np.float32):
     return p
 
 
+def generic_stable_params(spec, rng):
+    """A finite-loss parameter point for ANY family, driven by spec.layout —
+    the generalization of the named points below (same gamma/obs_var/chol/
+    phi choices), used by the all-codes zoo smoke.  Lives here so stable
+    test points stay in one file (CLAUDE.md rule)."""
+    p = np.zeros(spec.n_params)
+    lo, hi = spec.layout.get("gamma", (0, 0))
+    n = hi - lo
+    if n == 1:
+        p[lo] = np.log(0.5 - LAMBDA_FLOOR)
+    elif n == 2:  # AFNS5 double decay
+        p[lo:hi] = [np.log(0.5), np.log(0.15)]
+    elif n > 2:   # neural loading weights
+        p[lo:hi] = rng.standard_normal(n) / 10
+    lo, hi = spec.layout.get("obs_var", (0, 0))
+    p[lo:hi] = 4e-4
+    if "chol" in spec.layout:
+        a, _ = spec.layout["chol"]
+        rows, cols = spec.chol_indices
+        for k, (r, c) in enumerate(zip(rows, cols)):
+            p[a + k] = 0.05 if r == c else 0.0
+    lo, hi = spec.layout.get("A", (0, 0))
+    p[lo:hi] = 1e-4
+    lo, hi = spec.layout.get("B", (0, 0))
+    p[lo:hi] = 0.97
+    lo, hi = spec.layout.get("omega", (0, 0))
+    p[lo:hi] = rng.standard_normal(hi - lo) / 10
+    lo, hi = spec.layout.get("delta", (0, 0))
+    vals = [0.3, -0.1, 0.05] + [-0.07] * max(0, hi - lo - 3)
+    p[lo:hi] = vals[: hi - lo]
+    lo, hi = spec.layout.get("phi", (0, 0))
+    m = int(round((hi - lo) ** 0.5))
+    p[lo:hi] = (0.9 * np.eye(m)).reshape(-1)
+    return p
+
+
 def stable_tvl_params(spec, dtype=np.float64):
     """A stationary, finite-loglik parameter point for the TVλ EKF spec —
     obs var 4e-4, chol 0.05 I, Φ = 0.9 I, δ giving a steady state near

@@ -22,43 +22,12 @@ PLACEHOLDERS = {"pC", "vanillaNN"}  # reference placeholders (test_registry)
 _CODES = sorted({c for c in MODEL_CODES if c not in PLACEHOLDERS})
 
 
-def _generic_stable_params(spec, rng):
-    """A finite-loss parameter point for ANY family, driven by spec.layout."""
-    p = np.zeros(spec.n_params)
-    lo, hi = spec.layout.get("gamma", (0, 0))
-    n = hi - lo
-    if n == 1:
-        p[lo] = np.log(0.5 - 1e-2)
-    elif n == 2:  # AFNS5 double decay
-        p[lo:hi] = [np.log(0.5), np.log(0.15)]
-    elif n > 2:   # neural loading weights
-        p[lo:hi] = rng.standard_normal(n) / 10
-    lo, hi = spec.layout.get("obs_var", (0, 0))
-    p[lo:hi] = 4e-4
-    if "chol" in spec.layout:
-        a, _ = spec.layout["chol"]
-        rows, cols = spec.chol_indices
-        for k, (r, c) in enumerate(zip(rows, cols)):
-            p[a + k] = 0.05 if r == c else 0.0
-    lo, hi = spec.layout.get("A", (0, 0))
-    p[lo:hi] = 1e-4
-    lo, hi = spec.layout.get("B", (0, 0))
-    p[lo:hi] = 0.97
-    lo, hi = spec.layout.get("omega", (0, 0))
-    p[lo:hi] = rng.standard_normal(hi - lo) / 10
-    lo, hi = spec.layout.get("delta", (0, 0))
-    vals = [0.3, -0.1, 0.05] + [-0.07] * max(0, hi - lo - 3)
-    p[lo:hi] = vals[: hi - lo]
-    lo, hi = spec.layout.get("phi", (0, 0))
-    m = int(round((hi - lo) ** 0.5))
-    p[lo:hi] = (0.9 * np.eye(m)).reshape(-1)
-    return p
-
-
 @pytest.mark.parametrize("code", _CODES)
 def test_code_runs_end_to_end(code, rng):
+    from tests.oracle import generic_stable_params
+
     spec, canon = create_model(code, MATS, float_type="float64")
-    p = jnp.asarray(_generic_stable_params(spec, rng))
+    p = jnp.asarray(generic_stable_params(spec, rng))
     data = 0.4 * rng.standard_normal((len(MATS), 25)) + 4.0
 
     loss = float(get_loss(spec, p, jnp.asarray(data)))
@@ -67,10 +36,12 @@ def test_code_runs_end_to_end(code, rng):
     nan_tail = np.concatenate(
         [data, np.full((len(MATS), 3), np.nan)], axis=1)
     out = predict(spec, p, jnp.asarray(nan_tail))
-    T_ext = nan_tail.shape[1]  # predict appends one internal NaN step and
+    # preds[:, k] is the one-step-ahead prediction of column k+1; predict
+    # appends one internal NaN step, so the output spans all T_ext columns
+    T_ext = nan_tail.shape[1]
     assert np.asarray(out["preds"]).shape == (len(MATS), T_ext), code
     for key in ("factors", "states", "factor_loadings_1", "factor_loadings_2"):
         assert key in out, f"{code}: missing artifact {key!r}"
-    # the forecast tail must be filled (predict-only steps), not NaN
-    tail = np.asarray(out["preds"])[:, -2:]
+    # ALL THREE appended forecast-only steps must be filled, not NaN
+    tail = np.asarray(out["preds"])[:, -3:]
     assert np.isfinite(tail).all(), f"{code}: NaN forecast tail"
