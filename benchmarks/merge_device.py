@@ -62,6 +62,11 @@ def main(log_path: str) -> None:
             if name in device:  # fresh device wall with no known CPU wall:
                 rec["device_wall_s"] = device[name]["wall_s"]
                 rec["work"] = device[name]["work"]
+                if rec.get("cpu_wall_s_est") and rec["device_wall_s"] > 0:
+                    rec["speedup_vs_1core"] = round(
+                        rec["cpu_wall_s_est"] / rec["device_wall_s"], 2)
+                else:  # never leave a ratio computed from a stale wall
+                    rec.pop("speedup_vs_1core", None)
             merged.append(rec)
             print(json.dumps(rec))
             continue
