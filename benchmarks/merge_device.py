@@ -59,14 +59,14 @@ def main(log_path: str) -> None:
         cpu_wall = CPU_WALLS.get(name)
         if cpu_wall is None:
             rec = previous.get(name, {"config": name})
-            if name in device:  # fresh device wall with no known CPU wall:
+            if name in device:  # fresh device wall with no vetted CPU wall:
                 rec["device_wall_s"] = device[name]["wall_s"]
                 rec["work"] = device[name]["work"]
-                if rec.get("cpu_wall_s_est") and rec["device_wall_s"] > 0:
-                    rec["speedup_vs_1core"] = round(
-                        rec["cpu_wall_s_est"] / rec["device_wall_s"], 2)
-                else:  # never leave a ratio computed from a stale wall
-                    rec.pop("speedup_vs_1core", None)
+                # a carried cpu_wall_s_est is from some prior round — pairing
+                # it with this round's device wall would be exactly the
+                # cross-round incoherence the known-config path refuses, so
+                # drop the ratio until a vetted CPU wall exists
+                rec.pop("speedup_vs_1core", None)
             merged.append(rec)
             print(json.dumps(rec))
             continue

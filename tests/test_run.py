@@ -84,6 +84,29 @@ def test_run_rolling_batched_windows_end_to_end(tmp_path, monkeypatch):
     assert np.isfinite(arr).all()
 
 
+def test_run_flagship_with_estimation(tmp_path, monkeypatch):
+    """The reference's OWN driver flow (test.jl:22-27): run() on 1SSD-NNS
+    with optimization enabled — A/B-grid initialization + block-coordinate
+    estimate_steps (1 group iteration keeps the CPU cost test-sized) —
+    through filtering and artifact export."""
+    monkeypatch.chdir(tmp_path)
+    scratch = str(tmp_path) + os.sep
+    _write_data(scratch, T=40)
+    out = run("1", 34, 3, False, "1SSD-NNS", "float64",
+              run_optimization=True, max_group_iters=1,
+              scratch_dir=scratch)
+    assert out is not None
+    res = os.path.join(scratch, "YieldFactorModels.jl", "results",
+                       "thread_id__1", "1SSD-NNS")
+    loss_csv = os.path.join(res, "1SSD-NNS__thread_id__1__loss.csv")
+    assert os.path.isfile(loss_csv)
+    loss = float(np.loadtxt(loss_csv, delimiter=","))
+    assert np.isfinite(loss), loss
+    params_csv = os.path.join(res, "1SSD-NNS__thread_id__1__out_params.csv")
+    assert os.path.isfile(params_csv)
+    assert np.isfinite(np.loadtxt(params_csv, delimiter=",")).all()
+
+
 def test_run_rolling_rw_end_to_end(tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     scratch = str(tmp_path) + os.sep
