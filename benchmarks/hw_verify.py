@@ -9,8 +9,9 @@ support, on the real chip, against the XLA univariate scan path:
     missing column, an estimation window, and per-lane windows,
   - adjoint kernel (`pallas_kf_grad.batched_loglik_diff`): value + gradient
     (direction/norm agreement — elementwise f32 comparison is cancellation
-    noise at these gradient norms, see bench.py) for the constant-measurement
-    families, shared and per-lane windows.
+    noise at these gradient norms, see bench.py) for all three Kalman
+    families incl. the TVλ EKF's per-step jax.vjp adjoint, shared and
+    per-lane windows.
 
 Exit code 0 iff every check passes; one summary line per check.  Run:
 
@@ -126,8 +127,11 @@ def main() -> int:
           f"finite {int(both.sum())}/{B}, sentinels_match {same_sentinels}")
 
     # ---- adjoint kernel: value + gradient direction/norm ----
+    # hardware covers all three Kalman families incl. the TVλ EKF's
+    # per-step jax.vjp adjoint (round 3) and the per-lane-window path
     grad_cases = ((("1C", None),) if interpret else
-                  (("1C", None), ("AFNS5", None), ("1C", "per-lane")))
+                  (("1C", None), ("AFNS5", None), ("TVλ", None),
+                   ("1C", "per-lane")))
     for code, win in grad_cases:
         spec, _ = create_model(code, mats, float_type="float32")
         p = jnp.asarray(params_for(spec), jnp.float32)

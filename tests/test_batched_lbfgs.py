@@ -170,3 +170,19 @@ def test_fused_estimate_tvl_interpret(yields_panel):
     ref = float(univariate_kf.get_loss(spec, jnp.asarray(best),
                                        jnp.asarray(data)))
     np.testing.assert_allclose(float(ll), ref, rtol=2e-3)
+
+    # fused rolling windows for the EKF: per-lane [start, end) inside the
+    # TVλ adjoint kernel (W=2 windows x S=2 starts, one program per eval)
+    from yieldfactormodels_jl_tpu.models.params import untransform_params
+    raw = np.stack([np.asarray(untransform_params(spec, jnp.asarray(c)))
+                    for c in starts.T], axis=0)
+    xs, lls = opt.estimate_windows(
+        spec, data, np.nan_to_num(raw), np.array([0, 2]), np.array([10, 9]),
+        max_iters=2, objective="fused")
+    assert xs.shape == (2, 2, spec.n_params)
+    assert lls.shape == (2, 2)
+    assert np.all(np.isfinite(np.asarray(lls)))
+    from yieldfactormodels_jl_tpu.models.params import transform_params
+    p10 = transform_params(spec, jnp.asarray(np.asarray(xs)[1, 0]))
+    ref_w = float(univariate_kf.get_loss(spec, p10, jnp.asarray(data), 2, 9))
+    np.testing.assert_allclose(float(lls[1, 0]), ref_w, rtol=2e-3)

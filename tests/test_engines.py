@@ -37,6 +37,24 @@ def test_all_engines_agree(dns_case):
         np.testing.assert_allclose(v, base, rtol=1e-7, err_msg=e)
 
 
+@pytest.mark.parametrize("code", ["AFNS5", "TVλ"])
+def test_all_engines_agree_other_families(code, rng):
+    """Engine agreement beyond DNS3: the AFNS intercept and the TVλ EKF's
+    state-dependent rows must produce the same loglik through every engine
+    ('assoc' falls back to univariate for TVλ by design — api.get_loss)."""
+    from tests.oracle import generic_stable_params
+
+    spec, _ = yfm.create_model(code, MATS, float_type="float64")
+    p = jnp.asarray(generic_stable_params(spec, rng))
+    data = jnp.asarray(0.4 * rng.standard_normal((len(MATS), 50)) + 4.0)
+    vals = {e: float(api.get_loss(spec, p, data, 1, 48, engine=e))
+            for e in yfm.KALMAN_ENGINES}
+    base = vals["univariate"]
+    assert np.isfinite(base), f"{code}: non-finite base loglik"
+    for e, v in vals.items():
+        np.testing.assert_allclose(v, base, rtol=1e-7, err_msg=f"{code}:{e}")
+
+
 def test_process_wide_engine_setting(dns_case):
     spec, p, data = dns_case
     base = float(api.get_loss(spec, p, data))
