@@ -1,0 +1,111 @@
+"""simulate(): generative sampling from Kalman-family models.
+
+Checks the simulator against the model's own analytic implications (not
+another JAX path): unconditional state moments from the filters'
+``init_state`` algebra, measurement-noise scale, SV variance inflation,
+and a full round trip — parameters estimated on a simulated panel recover
+the simulating λ within sampling error.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import yieldfactormodels_jl_tpu as yfm
+
+from tests.oracle import stable_1c_params, stable_tvl_params
+
+MATS = tuple(np.array([3, 12, 36, 84, 180, 360]) / 12.0)
+
+
+def test_unconditional_moments_match_numpy_oracle(rng):
+    """Long-run sample mean/cov of the simulated state must match the
+    INDEPENDENT NumPy unconditional moments (oracle.kalman_init on matrices
+    built from the layout in NumPy — CLAUDE.md oracle rule, so a shared
+    Lyapunov/reshape bug in the JAX side cannot cancel)."""
+    from tests import oracle
+
+    spec, _ = yfm.create_model("1C", MATS, float_type="float64")
+    p = stable_1c_params(spec, dtype=np.float64)
+    out = yfm.simulate(spec, jnp.asarray(p), T=20000,
+                       key=jax.random.PRNGKey(0))
+    states = np.asarray(out["states"])
+    # matrices rebuilt in pure NumPy from the flat vector
+    Ms = spec.state_dim
+    C = np.zeros((Ms, Ms))
+    a, _ = spec.layout["chol"]
+    rows, cols = spec.chol_indices
+    for k, (r, c) in enumerate(zip(rows, cols)):
+        C[r, c] = p[a + k]
+    lo, hi = spec.layout["delta"]
+    delta = p[lo:hi]
+    lo, hi = spec.layout["phi"]
+    Phi = p[lo:hi].reshape(Ms, Ms)
+    beta0, P0 = oracle.kalman_init(Phi, delta, C @ C.T)
+    mean_err = np.abs(states.mean(axis=1) - beta0)
+    sd = np.sqrt(np.diagonal(P0))
+    assert np.all(mean_err < 4 * sd / np.sqrt(20000 / 20)), mean_err  # AR-adj
+    cov = np.cov(states)
+    np.testing.assert_allclose(cov, P0, rtol=0.2, atol=5e-4)
+    # measurement noise: residual sd off the exact NumPy loadings
+    gamma = p[spec.layout["gamma"][0]]
+    Z = oracle.dns_loadings(gamma, np.asarray(MATS))
+    obs_var = p[spec.layout["obs_var"][0]]
+    resid = np.asarray(out["data"]) - Z @ states
+    np.testing.assert_allclose(resid.std(), np.sqrt(obs_var), rtol=0.05)
+    assert np.allclose(np.asarray(out["h"]), 0.0)  # no SV requested
+
+
+def test_sv_inflates_measurement_variance(rng):
+    """With SV on, residual variance is scaled by E[e^h] > 1 and the h path
+    is a nontrivial AR(1); data stays finite."""
+    spec, _ = yfm.create_model("1C", MATS, float_type="float64")
+    p = jnp.asarray(stable_1c_params(spec, dtype=np.float64))
+    out = yfm.simulate(spec, p, T=4000, key=jax.random.PRNGKey(1),
+                       sv_phi=0.9, sv_sigma=0.4)
+    h = np.asarray(out["h"])
+    assert np.isfinite(np.asarray(out["data"])).all()
+    assert h.std() > 0.3  # stationary sd = 0.4/sqrt(1-0.81) ≈ 0.92
+    # lag-1 autocorrelation near φ_h
+    ac = np.corrcoef(h[1:], h[:-1])[0, 1]
+    assert 0.8 < ac < 0.97, ac
+
+
+@pytest.mark.parametrize("code,point", [("1C", stable_1c_params),
+                                        ("TVλ", stable_tvl_params)])
+def test_simulated_panel_has_finite_loglik_at_truth(code, point, rng):
+    """The filter must assign a finite loglik to the simulator's own output
+    at the simulating parameters — generator and filter share one model."""
+    spec, _ = yfm.create_model(code, MATS, float_type="float64")
+    p = jnp.asarray(point(spec, dtype=np.float64)
+                    if code == "1C" else point(spec))
+    out = yfm.simulate(spec, p, T=120, key=jax.random.PRNGKey(2))
+    ll = float(yfm.get_loss(spec, p, out["data"]))
+    assert np.isfinite(ll), ll
+
+
+def test_estimation_recovers_simulating_lambda(rng):
+    """Round trip: single-start MLE on a simulated panel recovers λ within
+    sampling error (the identifying parameter of the DNS loadings)."""
+    from yieldfactormodels_jl_tpu.estimation import optimize as opt
+    from yieldfactormodels_jl_tpu.models.loadings import dns_lambda
+
+    spec, _ = yfm.create_model("1C", MATS, float_type="float64")
+    p_true = stable_1c_params(spec, dtype=np.float64)
+    out = yfm.simulate(spec, jnp.asarray(p_true), T=300,
+                       key=jax.random.PRNGKey(3))
+    start = p_true.copy()
+    start[spec.layout["gamma"][0]] = np.log(0.8)  # start well off the truth
+    _, ll, best, conv = opt.estimate(spec, np.asarray(out["data"]),
+                                     start[:, None], max_iters=300)
+    assert np.isfinite(ll)
+    lam_hat = float(dns_lambda(jnp.asarray(best)[spec.layout["gamma"][0]]))
+    assert abs(lam_hat - 0.5) < 0.05, lam_hat
+
+
+def test_simulate_rejects_prediction_error_families():
+    spec, _ = yfm.create_model("NS", MATS, float_type="float64")
+    with pytest.raises(ValueError, match="generative"):
+        yfm.simulate(spec, np.zeros(spec.n_params), T=10,
+                     key=jax.random.PRNGKey(0))

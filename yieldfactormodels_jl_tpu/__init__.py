@@ -34,6 +34,7 @@ from .models.api import (
     get_loss,
     get_loss_array,
     predict,
+    simulate,
     smooth,
     update_factor_loadings,
     random_initial_params,
@@ -61,6 +62,7 @@ __all__ = [
     "get_loss",
     "get_loss_array",
     "predict",
+    "simulate",
     "smooth",
     "update_factor_loadings",
     "random_initial_params",

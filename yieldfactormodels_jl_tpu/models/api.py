@@ -66,6 +66,16 @@ def predict(spec: ModelSpec, params, data):
     return _engine(spec).predict(spec, params, data)
 
 
+def simulate(spec: ModelSpec, params, T: int, key,
+             sv_phi: float = 0.0, sv_sigma: float = 0.0):
+    """Simulate a (N, T) yield panel (+ latent state/vol paths) from a
+    Kalman-family model — see models/simulate.py (beyond-reference: the
+    reference's simulation mode only reads pre-simulated CSVs)."""
+    from .simulate import simulate as _sim
+
+    return _sim(spec, params, T, key, sv_phi=sv_phi, sv_sigma=sv_sigma)
+
+
 def smooth(spec: ModelSpec, params, data, start=0, end=None, engine=None):
     """Fixed-interval RTS smoothed moments β_{t|T}, P_{t|T} (Kalman families
     only — see ops/smoother.py; beyond-reference capability).

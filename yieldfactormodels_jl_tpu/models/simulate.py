@@ -1,0 +1,81 @@
+"""Simulate yield panels from a fitted Kalman-family model.
+
+Beyond-reference capability: the reference's simulation mode only READS
+pre-simulated CSVs (`YieldFactorModels.jl:241-246` + `test.jl`); it has no
+generator.  This module samples from the model the Kalman filters assume:
+
+    β_t = δ + Φ β_{t−1} + C η_t,          η_t ~ N(0, I)   (C Cᵀ = Ω_state)
+    y_t = Z(β_t) β_t + d + √(σ² e^{h_t}) ε_t,  ε_t ~ N(0, I_N)
+    h_t = φ_h h_{t−1} + σ_h ξ_t            (SV extension; h ≡ 0 without it)
+
+β₀ is drawn from the unconditional distribution (the same
+``init_state`` moments the filters start from), so simulated panels are
+stationary from the first column.  The TVλ EKF family rebuilds its loading
+row from the state each step (same ``_tvl_measurement`` the filter
+linearizes); constant-measurement families use ``measurement_setup``.  One
+``lax.scan`` over time — jittable and vmappable over draws.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .kalman import _tvl_measurement, init_state, measurement_setup
+from .params import unpack_kalman
+from .specs import ModelSpec
+
+
+def simulate(spec: ModelSpec, params, T: int, key,
+             sv_phi: float = 0.0, sv_sigma: float = 0.0):
+    """Simulate a (N, T) panel plus its latent paths.
+
+    Returns a dict: ``data`` (N, T), ``states`` (Ms, T) the sampled β path,
+    ``h`` (T,) the log-volatility path (zeros unless ``sv_sigma > 0``).
+    With ``sv_sigma = 0`` the DGP is exactly the homoskedastic model the
+    Kalman loglik assumes; with SV it matches ``ops/particle.py``'s model
+    (draw-then-observe order, h₀ = 0 before the first step).
+    """
+    if not spec.is_kalman:
+        raise ValueError(
+            f"simulate: generative state-space sampling needs a Kalman "
+            f"family; {spec.family!r} is a prediction-error family with no "
+            f"generative measurement model")
+    kp = unpack_kalman(spec, jnp.asarray(params, dtype=spec.dtype))
+    dtype = kp.Phi.dtype
+    Ms, N = spec.state_dim, spec.N
+    mats = spec.maturities_array
+    Z_const, d_const = measurement_setup(spec, kp, dtype)
+    if Z_const is not None and d_const is None:
+        d_const = jnp.zeros((N,), dtype=dtype)
+
+    st0 = init_state(spec, kp)
+    P0 = 0.5 * (st0.P + st0.P.T) + 1e-9 * jnp.eye(Ms, dtype=dtype)
+    S0 = jnp.linalg.cholesky(P0)
+    Om = 0.5 * (kp.Omega_state + kp.Omega_state.T) \
+        + 1e-12 * jnp.eye(Ms, dtype=dtype)
+    C = jnp.linalg.cholesky(Om)
+    sig = jnp.sqrt(kp.obs_var)
+
+    key, k0 = jax.random.split(jnp.asarray(key))
+    beta0 = st0.beta + S0 @ jax.random.normal(k0, (Ms,), dtype=dtype)
+
+    def step(carry, k):
+        beta, h = carry
+        k_eta, k_xi, k_eps = jax.random.split(k, 3)
+        beta = kp.delta + kp.Phi @ beta \
+            + C @ jax.random.normal(k_eta, (Ms,), dtype=dtype)
+        h = sv_phi * h + sv_sigma * jax.random.normal(k_xi, (), dtype=dtype)
+        if spec.family == "kalman_tvl":
+            _, y_mean = _tvl_measurement(spec, beta, mats)
+        else:
+            y_mean = Z_const @ beta + d_const
+        y = y_mean + sig * jnp.exp(0.5 * h) \
+            * jax.random.normal(k_eps, (N,), dtype=dtype)
+        return (beta, h), (y, beta, h)
+
+    h0 = jnp.zeros((), dtype=dtype)
+    _, (ys, betas, hs) = lax.scan(step, (beta0, h0),
+                                  jax.random.split(key, T))
+    return {"data": ys.T, "states": betas.T, "h": hs}
