@@ -1,0 +1,121 @@
+"""forecast_density(): analytic multi-step predictive densities.
+
+Oracle parity (CLAUDE.md rule): the filtered moments come from
+oracle.rts_smoother's INDEPENDENT NumPy forward pass, and the h-step
+prediction recursion is re-run in NumPy; means AND covariances must match.
+Plus structural checks: predictive variance is non-decreasing in the
+horizon, the means match api.predict's NaN-padding point forecasts, and
+statistical calibration on a simulated panel.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import yieldfactormodels_jl_tpu as yfm
+from yieldfactormodels_jl_tpu.models.params import unpack_kalman
+
+from tests import oracle
+from tests.oracle import stable_1c_params
+
+MATS = tuple(np.array([3, 12, 36, 84, 180, 360]) / 12.0)
+H = 12
+
+
+def _case(rng, T=60):
+    spec, _ = yfm.create_model("1C", MATS, float_type="float64")
+    p = stable_1c_params(spec, dtype=np.float64)
+    data = np.asarray(
+        yfm.simulate(spec, jnp.asarray(p), T=T, key=jax.random.PRNGKey(4))
+        ["data"])
+    return spec, p, data
+
+
+@pytest.mark.parametrize("engine", ["joint", "univariate"])
+def test_density_matches_numpy_oracle(engine, rng):
+    spec, p, data = _case(rng)
+    out = yfm.forecast_density(spec, jnp.asarray(p), data, H, engine=engine)
+    kp = unpack_kalman(spec, jnp.asarray(p))
+    Z = oracle.dns_loadings(p[spec.layout["gamma"][0]], np.asarray(MATS))
+    Phi = np.asarray(kp.Phi)
+    delta = np.asarray(kp.delta)
+    Om = np.asarray(kp.Omega_state)
+    ov = float(kp.obs_var)
+    # independent NumPy forward pass -> final FILTERED moments
+    _, _, bf, Pf = oracle.rts_smoother(Z, Phi, delta, Om, ov, data)
+    b, P = bf[-1], Pf[-1]
+    for k in range(H):
+        b = delta + Phi @ b
+        P = Phi @ P @ Phi.T + Om
+        np.testing.assert_allclose(np.asarray(out["means"])[k], Z @ b,
+                                   rtol=1e-8, atol=1e-10)
+        np.testing.assert_allclose(np.asarray(out["covs"])[k],
+                                   Z @ P @ Z.T + ov * np.eye(len(MATS)),
+                                   rtol=1e-8, atol=1e-12)
+
+
+def test_variance_grows_with_horizon_and_means_match_predict(rng):
+    spec, p, data = _case(rng)
+    out = yfm.forecast_density(spec, jnp.asarray(p), data, H)
+    var = np.diagonal(np.asarray(out["covs"]), axis1=1, axis2=2)
+    assert np.all(np.diff(var, axis=0) >= -1e-12), "variance must not shrink"
+    # the density means ARE the point forecasts the NaN-padding path makes:
+    # preds[:, k] is the one-step-ahead prediction of column k+1, so the H
+    # forecast-only columns sit at preds[:, T-1 : T+H-1]
+    T = data.shape[1]
+    nan_pad = np.concatenate(
+        [data, np.full((len(MATS), H), np.nan)], axis=1)
+    preds = np.asarray(yfm.predict(spec, jnp.asarray(p), nan_pad)["preds"])
+    np.testing.assert_allclose(np.asarray(out["means"]).T,
+                               preds[:, T - 1:T + H - 1],
+                               rtol=1e-8, atol=1e-10)
+
+
+def test_calibration_on_simulated_future(rng):
+    """~95% of realized h=1..3 yields fall inside the 95% predictive
+    interval when the model is true (loose bound: binomial noise)."""
+    spec, _ = yfm.create_model("1C", MATS, float_type="float64")
+    p = jnp.asarray(stable_1c_params(spec, dtype=np.float64))
+    hits = total = 0
+    for seed in range(6):
+        sim = yfm.simulate(spec, p, T=80, key=jax.random.PRNGKey(seed))
+        data = np.asarray(sim["data"])
+        out = yfm.forecast_density(spec, p, data[:, :70], 3)
+        for k in range(3):
+            m = np.asarray(out["means"])[k]
+            s = np.sqrt(np.diagonal(np.asarray(out["covs"])[k]))
+            y = data[:, 70 + k]
+            hits += int(np.sum(np.abs(y - m) <= 1.96 * s))
+            total += len(MATS)
+    assert 0.85 <= hits / total <= 1.0, hits / total
+
+
+def test_end_is_the_forecast_origin(rng):
+    """end=E must condition on columns :E only — identical to calling on
+    the truncated panel, so 'step k' is genuinely (k+1) steps past E."""
+    spec, p, data = _case(rng)
+    a = yfm.forecast_density(spec, jnp.asarray(p), data, 4, end=40)
+    b = yfm.forecast_density(spec, jnp.asarray(p), data[:, :40], 4)
+    np.testing.assert_allclose(np.asarray(a["means"]), np.asarray(b["means"]),
+                               rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(a["covs"]), np.asarray(b["covs"]),
+                               rtol=1e-12)
+
+
+def test_failed_filter_poisons_density(rng):
+    spec, p, data = _case(rng)
+    bad = p.copy()
+    lo, hi = spec.layout["phi"]
+    bad[lo:hi] = (1.5 * np.eye(3)).reshape(-1)  # non-stationary
+    out = yfm.forecast_density(spec, jnp.asarray(bad), data, 4)
+    assert np.isnan(np.asarray(out["means"])).all()
+
+
+def test_rejects_prediction_error_families_and_bad_engine(rng):
+    spec, p, data = _case(rng)
+    nspec, _ = yfm.create_model("NS", MATS, float_type="float64")
+    with pytest.raises(ValueError, match="Kalman"):
+        yfm.forecast_density(nspec, np.zeros(nspec.n_params), data, 4)
+    with pytest.raises(ValueError, match="filtering-moments"):
+        yfm.forecast_density(spec, jnp.asarray(p), data, 4, engine="sqrt")

@@ -34,6 +34,7 @@ from .models.api import (
     get_loss,
     get_loss_array,
     predict,
+    forecast_density,
     simulate,
     smooth,
     update_factor_loadings,
@@ -62,6 +63,7 @@ __all__ = [
     "get_loss",
     "get_loss_array",
     "predict",
+    "forecast_density",
     "simulate",
     "smooth",
     "update_factor_loadings",

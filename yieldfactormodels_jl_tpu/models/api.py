@@ -66,6 +66,17 @@ def predict(spec: ModelSpec, params, data):
     return _engine(spec).predict(spec, params, data)
 
 
+def forecast_density(spec: ModelSpec, params, data, horizon: int,
+                     start=0, end=None, engine=None):
+    """h-step-ahead Gaussian predictive densities (means + covariances) for
+    the Kalman families — see ops/forecast.py.  The BASELINE north star's
+    "multi-step predictive density" (api.predict gives the point-forecast
+    artifact set; this gives the distributions)."""
+    from ..ops.forecast import forecast_density as _fd
+
+    return _fd(spec, params, data, horizon, start, end, engine=engine)
+
+
 def simulate(spec: ModelSpec, params, T: int, key,
              sv_phi: float = 0.0, sv_sigma: float = 0.0):
     """Simulate a (N, T) yield panel (+ latent state/vol paths) from a

@@ -1,0 +1,90 @@
+"""Multi-step Gaussian predictive densities for the Kalman families.
+
+The reference's forecasting pipeline produces POINT forecasts by filtering
+NaN-padded panels (forecasting.jl:141 — reproduced by ``api.predict``).
+The BASELINE north star names the "multi-step predictive density"; this
+module supplies it analytically from the same filter: after the last
+observed column the state predictive distribution iterates
+
+    β_{T+k|T} = δ + Φ β_{T+k−1|T},     P_{T+k|T} = Φ P_{T+k−1|T} Φᵀ + Ω,
+
+and each step's yield density is N(Z β + d,  Z P Zᵀ + σ² I) — for the TVλ
+EKF the mean uses the exact nonlinear measurement h(β) and the covariance
+its Jacobian linearization Z(β), the same linearization the filter uses.
+One ``lax.scan`` over the horizon; engine-aware through
+``univariate_kf.filter_moments`` (or the joint engine's moments).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..models import kalman as K
+from ..models.kalman import _tvl_measurement
+from ..models.specs import ModelSpec
+
+
+def forecast_density(spec: ModelSpec, params, data, horizon: int,
+                     start=0, end=None, engine=None):
+    """h-step-ahead predictive densities from the forecast ORIGIN ``end``.
+
+    ``end`` (python int; default = T) is the origin: the filter conditions
+    on columns ``start .. end−1`` ONLY (the panel is truncated there, so
+    step k of the output is exactly the (k+1)-step-ahead density of column
+    ``end−1+k+1`` — no silent transition-only drift through post-``end``
+    columns).  Returns a dict of ``means`` (horizon, N), ``covs``
+    (horizon, N, N) and the state path ``state_means`` (horizon, Ms) /
+    ``state_covs`` (horizon, Ms, Ms).  A failed forward pass (−Inf filter
+    ll) poisons the output with NaN, mirroring ``smooth``'s sentinel
+    convention.
+
+    ``engine``: "joint" or "univariate" forward moments (None reads
+    ``config.kalman_engine()``) — same contract as ``api.smooth``
+    (ops/smoother.forward_moments is the single shared dispatch).
+    """
+    if not spec.is_kalman:
+        raise ValueError(
+            f"forecast_density: analytic Gaussian predictive densities need "
+            f"a Kalman family; {spec.family!r} has no predictive covariance "
+            f"recursion (use api.predict for point forecasts)")
+    from .smoother import forward_moments
+
+    data = jnp.asarray(data, dtype=spec.dtype)
+    T = data.shape[1]
+    if end is None:
+        end = T
+    end = int(end)
+    data = data[:, :end]  # the origin: condition on start..end-1 only
+    params = jnp.asarray(params, dtype=spec.dtype)
+    kp, outs = forward_moments(spec, params, data, start, end, engine)
+    beta = outs["beta_upd"][-1]
+    P = outs["P_upd"][-1]
+    mats = spec.maturities_array
+    Z_const, d_const = K.measurement_setup(spec, kp, params.dtype)
+    if Z_const is not None and d_const is None:
+        d_const = jnp.zeros((spec.N,), dtype=params.dtype)
+    eyeN = jnp.eye(spec.N, dtype=params.dtype)
+
+    def step(carry, _):
+        b, Pm = carry
+        b = kp.delta + kp.Phi @ b
+        Pm = kp.Phi @ Pm @ kp.Phi.T + kp.Omega_state
+        if spec.family == "kalman_tvl":
+            Z, y_mean = _tvl_measurement(spec, b, mats)
+        else:
+            Z = Z_const
+            y_mean = Z @ b + d_const
+        cov = Z @ Pm @ Z.T + kp.obs_var * eyeN
+        return (b, Pm), (y_mean, cov, b, Pm)
+
+    (_, _), (means, covs, sb, sP) = lax.scan(step, (beta, P), None,
+                                             length=horizon)
+    ok = jnp.all(outs["ll"] > -jnp.inf)
+    nan = jnp.asarray(jnp.nan, dtype=params.dtype)
+    return {
+        "means": jnp.where(ok, means, nan),
+        "covs": jnp.where(ok, covs, nan),
+        "state_means": jnp.where(ok, sb, nan),
+        "state_covs": jnp.where(ok, sP, nan),
+    }

@@ -28,6 +28,28 @@ from ..models import kalman as K
 from ..models.specs import ModelSpec
 
 
+def forward_moments(spec: ModelSpec, params, data, start, end, engine=None):
+    """Engine-validated per-step filtering moments ``(kp, outs)`` — THE shared
+    dispatch for every consumer of (β_pred, P_pred, β_upd, P_upd, ll)
+    (``smooth`` here and ``ops/forecast.forecast_density``), so the engine
+    contract — "joint" and "univariate" emit moments, "sqrt"/"assoc" raise —
+    lives in exactly one place."""
+    from .. import config
+    from . import univariate_kf
+
+    eng = engine or config.kalman_engine()
+    if eng not in ("joint", "univariate"):
+        raise ValueError(
+            f"engine {eng!r} has no filtering-moments path — per-step "
+            f"(β, P) moments are emitted by the 'joint' and 'univariate' "
+            f"engines only.  Pass engine= explicitly or "
+            f"config.set_kalman_engine('univariate').")
+    if eng == "univariate":
+        return univariate_kf.filter_moments(spec, params, data, start, end)
+    kp, _, _, outs = K._scan_filter(spec, params, data, start, end)
+    return kp, outs
+
+
 def smooth(spec: ModelSpec, params, data, start=0, end=None, engine=None):
     """Smoothed moments for every t of the panel.
 
@@ -48,24 +70,11 @@ def smooth(spec: ModelSpec, params, data, start=0, end=None, engine=None):
         raise ValueError(
             f"smooth: RTS smoothing needs a state-space covariance recursion; "
             f"family {spec.family!r} is not a Kalman family")
-    from .. import config
-    from . import univariate_kf
-
-    eng = engine or config.kalman_engine()
-    if eng not in ("joint", "univariate"):
-        raise ValueError(
-            f"smooth: engine {eng!r} has no filtering-moments path — the RTS "
-            f"backward pass needs per-step (β, P) moments, which only the "
-            f"'joint' and 'univariate' engines emit.  Pass engine= "
-            f"explicitly or config.set_kalman_engine('univariate').")
     data = jnp.asarray(data, dtype=spec.dtype)
     T = data.shape[1]
     if end is None:
         end = T
-    if eng == "univariate":
-        kp, outs = univariate_kf.filter_moments(spec, params, data, start, end)
-    else:
-        kp, _, _, outs = K._scan_filter(spec, params, data, start, end)
+    kp, outs = forward_moments(spec, params, data, start, end, engine)
 
     b_pred, P_pred = outs["beta_pred"], outs["P_pred"]    # (T, Ms), (T, Ms, Ms)
     b_upd, P_upd = outs["beta_upd"], outs["P_upd"]
