@@ -73,3 +73,57 @@ def test_dm_degenerate_inputs():
         diebold_mariano(np.zeros(10), np.zeros(11))
     with pytest.raises(ValueError, match="loss"):
         diebold_mariano(np.zeros(10), np.ones(10), loss="huber")
+
+
+def test_crps_matches_numerical_integration():
+    """Closed form vs the defining integral ∫(F(x) − 1{x ≥ y})² dx computed
+    by independent NumPy quadrature (CLAUDE.md oracle rule)."""
+    from scipy.special import ndtr
+
+    from yieldfactormodels_jl_tpu.utils.evaluation import crps_gaussian
+
+    rng = np.random.default_rng(0)
+    for _ in range(5):
+        mu, sd = rng.normal(), np.exp(rng.normal())
+        y = mu + sd * rng.normal() * 2
+        lo, hi = mu - 12 * sd, mu + 12 * sd
+        # split at the indicator's jump so trapezoid converges O(Δx²);
+        # np.trapezoid is numpy>=2 — fall back for the declared 1.24 floor
+        trap = getattr(np, "trapezoid", None) or np.trapz
+        xs1 = np.linspace(lo, min(y, hi), 100001)
+        xs2 = np.linspace(max(y, lo), hi, 100001)
+        want = (trap(ndtr((xs1 - mu) / sd) ** 2, xs1)
+                + trap((ndtr((xs2 - mu) / sd) - 1.0) ** 2, xs2))
+        got = float(crps_gaussian(mu, sd, y))
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_crps_properties_and_density_pipeline():
+    """Sharper correct densities score better; degenerate sd and NaN
+    outcomes go NaN; scores of forecast_density feed diebold_mariano."""
+    import jax
+    import jax.numpy as jnp
+
+    import yieldfactormodels_jl_tpu as yfm
+    from tests.oracle import stable_1c_params
+    from yieldfactormodels_jl_tpu.utils.evaluation import crps_gaussian
+
+    rng = np.random.default_rng(1)
+    y = rng.normal(size=500)
+    sharp = crps_gaussian(0.0, 1.0, y).mean()       # the true density
+    blunt = crps_gaussian(0.0, 4.0, y).mean()       # too wide
+    biased = crps_gaussian(2.0, 1.0, y).mean()      # wrong mean
+    assert sharp < blunt and sharp < biased
+    assert np.isnan(crps_gaussian(0.0, 0.0, 1.0))
+    assert np.isnan(crps_gaussian(0.0, 1.0, np.nan))
+
+    mats = tuple(np.array([3, 12, 36, 84, 180, 360]) / 12.0)
+    spec, _ = yfm.create_model("1C", mats, float_type="float64")
+    p = jnp.asarray(stable_1c_params(spec, dtype=np.float64))
+    sim = yfm.simulate(spec, p, T=60, key=jax.random.PRNGKey(7))
+    data = np.asarray(sim["data"])
+    fd = yfm.forecast_density(spec, p, data, 3, end=50)
+    m = np.asarray(fd["means"])
+    s = np.sqrt(np.diagonal(np.asarray(fd["covs"]), axis1=1, axis2=2))
+    scores = crps_gaussian(m, s, data[:, 50:53].T)
+    assert scores.shape == (3, len(mats)) and np.isfinite(scores).all()

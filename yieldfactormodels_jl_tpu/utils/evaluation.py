@@ -1,10 +1,14 @@
-"""Out-of-sample forecast evaluation: the Diebold–Mariano (1995) test.
+"""Out-of-sample forecast evaluation: Diebold–Mariano + Gaussian CRPS.
 
 Companion to the rolling-forecast pipeline (forecasting.py exports per-origin
 forecasts; the reference leaves accuracy comparison entirely to external
-tooling).  Tests H₀: equal expected loss between two forecast-error series,
-with a Bartlett-kernel HAC variance (h-step forecasts ⇒ MA(h−1) differential
-autocorrelation) and the Harvey–Leybourne–Newbold small-sample correction.
+tooling).  ``diebold_mariano`` tests H₀: equal expected loss between two
+forecast-error series, with a Bartlett-kernel HAC variance (h-step forecasts
+⇒ MA(h−1) differential autocorrelation) and the Harvey–Leybourne–Newbold
+small-sample correction.  ``crps_gaussian`` scores the predictive DENSITIES
+``api.forecast_density`` produces (closed form for N(μ, σ²); Gneiting &
+Raftery 2007, eq. 21) — proper scoring, lower is better; CRPS series from
+two models feed straight back into ``diebold_mariano``.
 
 Pure NumPy — this is post-processing of exported forecasts, not device work.
 """
@@ -14,6 +18,29 @@ from __future__ import annotations
 import math
 
 import numpy as np
+
+
+def crps_gaussian(mean, sd, y):
+    """Continuous ranked probability score of N(mean, sd²) against outcome
+    ``y`` (elementwise over any broadcastable shapes; lower is better):
+
+        CRPS = σ [ z(2Φ(z) − 1) + 2φ(z) − 1/√π ],   z = (y − μ)/σ.
+
+    A proper score for the predictive densities ``api.forecast_density``
+    returns; NaNs propagate (missing outcomes score NaN), ``sd <= 0`` is
+    invalid and returns NaN rather than a degenerate 0/∞.
+    """
+    from scipy.special import ndtr  # scipy is already a dependency (t below)
+
+    mean = np.asarray(mean, dtype=np.float64)
+    sd = np.asarray(sd, dtype=np.float64)
+    y = np.asarray(y, dtype=np.float64)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        z = (y - mean) / sd
+        phi = np.exp(-0.5 * z * z) / math.sqrt(2.0 * math.pi)
+        out = sd * (z * (2.0 * ndtr(z) - 1.0) + 2.0 * phi
+                    - 1.0 / math.sqrt(math.pi))
+    return np.where(sd > 0, out, np.nan)
 
 
 def diebold_mariano(err1, err2, h: int = 1, loss: str = "squared",
