@@ -324,19 +324,57 @@ def main():
 def _orchestrate():
     """Run main() in a watchdog subprocess; fall back to CPU on wedge."""
     here = os.path.abspath(__file__)
-    timeout_s = float(os.environ.get("BENCH_DEVICE_TIMEOUT", "900"))
+    # default sized for the round-3 relay: remote compiles of the kernel set
+    # (tile-rows sweep + fused grad + the 2nd-order-AD ssd section) took
+    # >900 s cold in the first post-outage window; the wedge this watchdog
+    # guards against manifests as a silent multi-HOUR hang, so 2400 s keeps
+    # the guard meaningful without tripping on honest compiles
+    timeout_s = float(os.environ.get("BENCH_DEVICE_TIMEOUT", "2400"))
     try:
-        proc = subprocess.run([sys.executable, here, "--inner"],
-                              timeout=timeout_s, capture_output=True, text=True)
-        if proc.returncode == 0 and proc.stdout.strip():
-            sys.stdout.write(proc.stdout)
-            sys.stderr.write(proc.stderr[-2000:])
+        # NEVER SIGKILL the inner process (subprocess.run's timeout does):
+        # a client killed while holding the relay claim wedges the TPU for
+        # everyone — the round-2 outage, and again on 2026-07-31 when this
+        # orchestrator's 900 s kill preceded hours of UNAVAILABLE backend
+        # inits.  SIGTERM is catchable, lets the claim release, and the
+        # unbounded wait afterwards is bounded in practice by the claim
+        # resolving one way or the other.
+        # file-backed output, not PIPEs: an abandoned child must be able to
+        # keep logging and exit on its own (a full unread pipe would block
+        # its writes and pin the relay claim forever)
+        import tempfile
+        out_f = tempfile.NamedTemporaryFile("w+", suffix=".bench.out",
+                                            delete=False)
+        err_f = tempfile.NamedTemporaryFile("w+", suffix=".bench.err",
+                                            delete=False)
+        proc = subprocess.Popen([sys.executable, here, "--inner"],
+                                stdout=out_f, stderr=err_f, text=True)
+        try:
+            proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            sys.stderr.write(f"# device run past {timeout_s}s; SIGTERM + "
+                             "patient wait (no SIGKILL: relay claim safety)\n")
+            proc.terminate()
+            try:
+                proc.wait(timeout=600)
+            except subprocess.TimeoutExpired:
+                # TERM ignored (stuck inside a C call): abandon the child
+                # WITHOUT killing it — an orphan that eventually exits is
+                # recoverable, a SIGKILL'd claim holder wedges the relay
+                sys.stderr.write("# inner ignored SIGTERM; abandoning it "
+                                 "unkilled and falling back to CPU\n")
+        out_f.flush()
+        err_f.flush()
+        out = open(out_f.name).read()
+        err = open(err_f.name).read()
+        if proc.returncode == 0 and out.strip():
+            sys.stdout.write(out)
+            sys.stderr.write(err[-2000:])
             return
         sys.stderr.write(f"# device run failed rc={proc.returncode}; "
-                         f"stderr tail: {proc.stderr[-500:]}\n")
-    except subprocess.TimeoutExpired:
-        sys.stderr.write(f"# device run wedged past {timeout_s}s "
-                         "(axon backend init?); falling back to CPU\n")
+                         f"stderr tail: {err[-500:]}\n")
+    except Exception as e:
+        sys.stderr.write(f"# device orchestration error ({type(e).__name__}: "
+                         f"{e}); falling back to CPU\n")
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)  # disable the TPU plugin hook
     env["JAX_PLATFORMS"] = "cpu"

@@ -69,6 +69,12 @@ def _patient_run(cmd, soft_s, tag, extra_env=None):
     so device JSON lines land where merge_device.py expects them.
     """
     env = dict(os.environ)
+    # persistent compile cache: remote compiles through the relay dominate
+    # every device step's wall time; cache executables across processes so
+    # re-runs (second windows, bench after hw_verify) skip them where the
+    # PJRT plugin supports serialization (harmless no-op where it doesn't)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(WORKDIR, "jax_cache"))
     if extra_env:
         env.update(extra_env)
     with open(LOG, "a") as logf:
@@ -112,13 +118,24 @@ def probe_once(i: int) -> bool:
 
 def device_sequence() -> None:
     _log("TPU is back: running the device sequence")
-    steps = [
-        ("run_all_device",
-         [sys.executable, os.path.join(HERE, "run_all.py"),
-          "--side", "device", "--configs", "all"]),
-        ("hw_verify", [sys.executable, os.path.join(HERE, "hw_verify.py")]),
-        ("bench", [sys.executable, os.path.join(ROOT, "bench.py")]),
-    ]
+    catalog = {
+        "run_all_device":
+            [sys.executable, os.path.join(HERE, "run_all.py"),
+             "--side", "device", "--configs", "all"],
+        "pf_race":  # config 3 only: XLA lane-major vs fused Pallas PF
+            [sys.executable, os.path.join(HERE, "run_all.py"),
+             "--side", "device", "--configs", "afns5-sv-pf"],
+        "hw_verify": [sys.executable, os.path.join(HERE, "hw_verify.py")],
+        "bench": [sys.executable, os.path.join(ROOT, "bench.py")],
+    }
+    wanted = [w.strip() for w in os.environ.get(
+        "RECOVER_STEPS", "run_all_device,hw_verify,bench").split(",")
+        if w.strip()]
+    unknown = [w for w in wanted if w not in catalog]
+    if unknown:  # a typo must not silently degrade to a no-op "success"
+        raise SystemExit(f"unknown RECOVER_STEPS {unknown}; "
+                         f"valid: {sorted(catalog)}")
+    steps = [(w, catalog[w]) for w in wanted]
     for tag, cmd in steps:
         rc, _ = _patient_run(cmd, STEP_SOFT_S, tag)
         _log(f"{tag}: rc={rc}")

@@ -131,7 +131,54 @@ def _run_config(name: str, scale: int):
         out = np.asarray(jax.block_until_ready(fn(draws, keys)))
         wall = time.perf_counter() - t0
         n_fin = int(np.isfinite(out).sum())
-        return wall, f"{D} draws x 1000 particles, finite {n_fin}/{D}"
+        descr = f"{D} draws x 1000 particles (xla), finite {n_fin}/{D}"
+
+        # On the chip, also race the fused Pallas PF kernel (ops/pallas_pf:
+        # one grid program per draw, 1024 lane-tiled particles, on-chip
+        # resampling; hw_verify.py holds its correctness gate) and keep the
+        # faster engine — same winner-selection protocol as bench.py.  Noise
+        # generation is inside the timed region, mirroring the XLA path's
+        # in-scan key splitting.
+        if jax.devices()[0].platform == "tpu":
+            try:
+                from yieldfactormodels_jl_tpu.ops.pallas_pf import pf_loglik_batch
+
+                Tm1 = data.shape[1] - 1
+
+                @jax.jit
+                def pallas_chunk(d, key):
+                    kz, ku = jax.random.split(key)
+                    nzc = jax.random.normal(kz, (CH, Tm1, 1024), dtype=spec.dtype)
+                    usc = jax.random.uniform(ku, (CH, Tm1), dtype=spec.dtype)
+                    # n_particles=1000: the EXACT config-3 workload — lanes
+                    # 1000..1023 are dead padding, counted against the kernel
+                    return pf_loglik_batch(spec, d, data, nzc, usc,
+                                           n_particles=1000, interpret=False)
+
+                ckeys = jax.random.split(jax.random.PRNGKey(7), D // CH)
+
+                def pallas_fn():
+                    return jnp.concatenate([pallas_chunk(draws[i], ckeys[i])
+                                            for i in range(D // CH)])
+
+                np.asarray(jax.block_until_ready(pallas_chunk(draws[0],
+                                                              ckeys[0])))
+                t0 = time.perf_counter()
+                out_p = np.asarray(jax.block_until_ready(pallas_fn()))
+                wall_p = time.perf_counter() - t0
+                fin_p = int(np.isfinite(out_p).sum())
+                descr += (f"; pallas 1000 particles (1024-lane padded): "
+                          f"{wall_p:.3f}s, finite {fin_p}/{D}, "
+                          f"mean {np.mean(out_p[np.isfinite(out_p)]):.1f} vs "
+                          f"xla {np.mean(out[np.isfinite(out)]):.1f}")
+                if wall_p < wall and fin_p >= n_fin:
+                    wall = wall_p
+                    descr += "; winner=pallas"
+                else:
+                    descr += "; winner=xla"
+            except Exception as e:  # Mosaic failure must not kill the config
+                descr += f"; pallas engine failed ({type(e).__name__}: {e})"
+        return wall, descr
 
     if name == "rolling-240":
         spec, _ = create_model("1C", tuple(common.MATURITIES), float_type="float32")

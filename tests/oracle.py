@@ -586,3 +586,71 @@ def kalman_filter_loglik_steps(Z, Phi, delta, Omega_state, obs_var, data):
         beta = delta + Phi @ (beta + K @ v)
         P = Phi @ ((np.eye(Ms) - K @ Z) @ P) @ Phi.T + Omega_state
     return lls
+
+
+def rbpf_loglik(Z, Phi, delta, Omega_state, obs_var, data, normals, uniforms,
+                sv_phi, sv_sigma, ess_frac=0.5, d=None):
+    """Rao-Blackwellized SV particle filter, independent NumPy float64 loops.
+
+    Oracle for ``ops/particle.particle_filter_loglik`` and
+    ``ops/pallas_pf.pf_loglik_batch`` in their common-noise mode: ``normals``
+    (T−1, Pn) drive the log-vol AR(1) proposal, ``uniforms`` (T−1,) the
+    systematic-resampling offsets.  Deliberately a DIFFERENT algebraic route
+    than the engines — the exact per-particle Kalman step runs the plain-
+    covariance JOINT N-dimensional update (inv/slogdet per particle), which
+    equals the engines' sequential scalar Potter updates by block
+    factorization of the Gaussian likelihood; agreement is therefore a real
+    cross-check of the filter algebra, not a transliteration.  Conventions
+    mirrored from the engines (citations there): skip the first innovation
+    (reference kalman/filter.jl:190-195), predict-only NaN columns, ESS-gated
+    systematic resampling with searchsorted-left + index clamp, initial
+    moments with the engines' +1e-9 / +1e-12 jitters.
+    """
+    N, T = data.shape
+    Ms = Phi.shape[0]
+    Pn = normals.shape[1]
+    if d is None:
+        d = np.zeros(N)
+    beta0, P0 = kalman_init(Phi, delta, Omega_state)
+    P0 = 0.5 * (P0 + P0.T) + 1e-9 * np.eye(Ms)
+    Om = 0.5 * (Omega_state + Omega_state.T) + 1e-12 * np.eye(Ms)
+    x = np.repeat(beta0[:, None], Pn, axis=1)          # (Ms, Pn)
+    Pc = np.repeat(P0[:, :, None], Pn, axis=2)         # (Ms, Ms, Pn)
+    h = np.zeros(Pn)
+    logw = np.full(Pn, -np.log(Pn))
+    total = 0.0
+    for t in range(T - 1):
+        y = data[:, t]
+        h = sv_phi * h + sv_sigma * normals[t]
+        obs = bool(np.all(np.isfinite(y)))
+        r = obs_var * np.exp(h)
+        ll = np.zeros(Pn)
+        if obs:
+            x_new = np.empty_like(x)
+            P_new = np.empty_like(Pc)
+            for p in range(Pn):
+                F = Z @ Pc[:, :, p] @ Z.T + r[p] * np.eye(N)
+                F_inv = np.linalg.inv(F)
+                v = y - d - Z @ x[:, p]
+                K = Pc[:, :, p] @ Z.T @ F_inv
+                x_new[:, p] = x[:, p] + K @ v
+                P_new[:, :, p] = (np.eye(Ms) - K @ Z) @ Pc[:, :, p]
+                _, logdet = np.linalg.slogdet(F)
+                ll[p] = -0.5 * (logdet + v @ F_inv @ v + N * LOG_2PI)
+            x, Pc = x_new, P_new
+        x = delta[:, None] + Phi @ x
+        Pc = np.einsum("ij,jkp,lk->ilp", Phi, Pc, Phi) + Om[:, :, None]
+        contributes = obs and t > 0
+        if contributes:
+            logw = logw + ll
+            m = logw.max()
+            step_ll = m + np.log(np.exp(logw - m).sum())
+            total += step_ll
+            logw = logw - step_ll
+            w = np.exp(logw)
+            if 1.0 / np.sum(w * w) < ess_frac * Pn:
+                pos = (np.arange(Pn) + uniforms[t]) / Pn
+                idx = np.clip(np.searchsorted(np.cumsum(w), pos), 0, Pn - 1)
+                x, Pc, h = x[:, idx], Pc[:, :, idx], h[idx]
+                logw = np.full(Pn, -np.log(Pn))
+    return total

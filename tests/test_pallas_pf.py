@@ -1,0 +1,194 @@
+"""Parity tests for the fused Pallas particle-filter kernel (ops/pallas_pf).
+
+The kernel runs in interpret mode on CPU under float64 (this suite), fed the
+SAME noise arrays as ``particle_filter_loglik(..., noise=...)`` — the
+common-noise contract makes both engines follow identical particle
+trajectories, so agreement is elementwise-tight, not statistical.  Hardware
+compilation and the f32 statistical criterion live in benchmarks/hw_verify.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from yieldfactormodels_jl_tpu import create_model
+from yieldfactormodels_jl_tpu.ops import sqrt_kf
+from yieldfactormodels_jl_tpu.ops.pallas_pf import pf_loglik_batch
+from yieldfactormodels_jl_tpu.ops.particle import particle_filter_loglik
+
+from tests.test_afns import _afns5_params
+
+P = 128  # one lane-tile of particles keeps interpret mode fast
+
+
+def _setup(maturities, yields_panel, D=3, T=40, seed=0):
+    spec, _ = create_model("AFNS5", tuple(maturities), float_type="float64")
+    data = jnp.asarray(yields_panel[:, :T])
+    p, *_ = _afns5_params(spec)
+    rng = np.random.default_rng(seed)
+    batch = np.tile(np.asarray(p), (D, 1))
+    # jitter only the well-conditioned coordinates (decay drivers, δ): the
+    # point is distinct trajectories per draw, not pathological inputs
+    batch[:, 0:2] += 0.05 * rng.standard_normal((D, 2))
+    batch[:, 18:23] += 0.05 * rng.standard_normal((D, 5))
+    batch = jnp.asarray(batch)
+    normals = jnp.asarray(rng.standard_normal((D, T - 1, P)))
+    uniforms = jnp.asarray(rng.uniform(size=(D, T - 1)))
+    return spec, data, batch, normals, uniforms
+
+
+def _xla(spec, data, batch, normals, uniforms, **kw):
+    return jax.vmap(
+        lambda q, nz, u: particle_filter_loglik(
+            spec, q, data, n_particles=P, noise=(nz, u), **kw)
+    )(batch, normals, uniforms)
+
+
+def test_pallas_pf_matches_xla_common_noise(maturities, yields_panel):
+    spec, data, batch, nz, u = _setup(maturities, yields_panel)
+    want = np.asarray(_xla(spec, data, batch, nz, u))
+    got = np.asarray(pf_loglik_batch(spec, batch, data, nz, u))
+    assert np.all(np.isfinite(want))
+    np.testing.assert_allclose(got, want, rtol=1e-9)
+
+
+@pytest.mark.parametrize("th", [0.0, 1.5])
+def test_pallas_pf_resample_extremes(maturities, yields_panel, th):
+    """th=0 never resamples; th=1.5 resamples every contributing step."""
+    spec, data, batch, nz, u = _setup(maturities, yields_panel, D=2)
+    want = np.asarray(_xla(spec, data, batch, nz, u, ess_threshold=th))
+    got = np.asarray(pf_loglik_batch(spec, batch, data, nz, u,
+                                     ess_threshold=th))
+    np.testing.assert_allclose(got, want, rtol=1e-9)
+
+
+def test_pallas_pf_nan_column_predict_only(maturities, yields_panel):
+    spec, data, batch, nz, u = _setup(maturities, yields_panel, D=2)
+    data = data.at[:, 7].set(jnp.nan)
+    want = np.asarray(_xla(spec, data, batch, nz, u))
+    got = np.asarray(pf_loglik_batch(spec, batch, data, nz, u))
+    assert np.all(np.isfinite(want))
+    np.testing.assert_allclose(got, want, rtol=1e-9)
+
+
+def test_pallas_pf_collapse_exact_kalman(maturities, yields_panel):
+    """σ_h = 0 ⇒ every particle runs the exact filter ⇒ PF loglik == KF."""
+    spec, data, batch, nz, u = _setup(maturities, yields_panel, D=2)
+    want = np.asarray(jax.vmap(
+        lambda q: sqrt_kf.get_loss(spec, q, data))(batch))
+    got = np.asarray(pf_loglik_batch(spec, batch, data, nz, u, sv_sigma=0.0))
+    assert np.all(np.isfinite(want))
+    np.testing.assert_allclose(got, want, rtol=1e-9)
+
+
+def test_pallas_pf_invalid_draw_sentinels(maturities, yields_panel):
+    """Non-stationary Φ and σ² < 0 both hit −Inf, matching the XLA engine."""
+    spec, data, batch, nz, u = _setup(maturities, yields_panel, D=3)
+    bad = np.array(batch)
+    bad[0, 23] = 1.5      # Φ₁₁ > 1: P0 solve explodes → factorization sentinel
+    bad[1, 2] = -4e-4     # σ² < 0: innovation variance goes negative
+    bad = jnp.asarray(bad)
+    want = np.asarray(_xla(spec, data, bad, nz, u))
+    got = np.asarray(pf_loglik_batch(spec, bad, data, nz, u))
+    assert want[0] == -np.inf and want[1] == -np.inf
+    assert got[0] == -np.inf and got[1] == -np.inf
+    assert np.isfinite(want[2])
+    np.testing.assert_allclose(got[2], want[2], rtol=1e-9)
+
+
+def test_pallas_pf_dns_family(maturities, yields_panel):
+    """The Ms=3 constant-λ family runs through the same kernel."""
+    from tests.test_extensions import _dns_params
+
+    spec, _ = create_model("1C", tuple(maturities), float_type="float64")
+    data = jnp.asarray(yields_panel[:, :40])
+    rng = np.random.default_rng(3)
+    batch = jnp.asarray(np.tile(_dns_params(), (2, 1)))
+    nz = jnp.asarray(rng.standard_normal((2, 39, P)))
+    u = jnp.asarray(rng.uniform(size=(2, 39)))
+    want = np.asarray(_xla(spec, data, batch, nz, u))
+    got = np.asarray(pf_loglik_batch(spec, batch, data, nz, u))
+    assert np.all(np.isfinite(want))
+    np.testing.assert_allclose(got, want, rtol=1e-9)
+
+
+def test_pallas_pf_shape_validation(maturities, yields_panel):
+    spec, data, batch, nz, u = _setup(maturities, yields_panel, D=2)
+    with pytest.raises(ValueError, match="multiple of 128"):
+        pf_loglik_batch(spec, batch, data, nz[:, :, :100], u)
+    with pytest.raises(ValueError, match="noise shapes"):
+        pf_loglik_batch(spec, batch, data, nz[:, :-1], u)
+    sd, _ = create_model("TVλ", tuple(maturities), float_type="float64")
+    with pytest.raises(ValueError, match="constant-measurement"):
+        pf_loglik_batch(sd, batch, data, nz, u)
+
+
+def test_pallas_pf_oracle_parity(maturities, yields_panel):
+    """House rule (CLAUDE.md): every numeric kernel gets parity coverage
+    against tests/oracle.py's independent NumPy loops — never against
+    another JAX path alone.  The oracle runs the plain-covariance JOINT
+    per-particle update (inv/slogdet), a different algebraic route than both
+    engines' sequential Potter form, on the same common noise."""
+    from tests.test_kalman import _dns_params as _dns_pieces
+    from tests import oracle
+
+    spec, _ = create_model("1C", tuple(maturities), float_type="float64")
+    p, Phi, delta, Omega, obs_var = _dns_pieces()
+    data = np.asarray(yields_panel[:, :40])
+    rng = np.random.default_rng(7)
+    nz = rng.standard_normal((39, P))
+    u = rng.uniform(size=(39,))
+    Z = oracle.dns_loadings(p[0], maturities)
+    want = oracle.rbpf_loglik(Z, Phi, delta, Omega, obs_var, data, nz, u,
+                              sv_phi=0.95, sv_sigma=0.2)
+    xla = float(particle_filter_loglik(
+        spec, jnp.asarray(p), jnp.asarray(data), n_particles=P,
+        noise=(jnp.asarray(nz), jnp.asarray(u))))
+    pal = float(pf_loglik_batch(
+        spec, jnp.asarray(p)[None, :], jnp.asarray(data),
+        jnp.asarray(nz)[None], jnp.asarray(u)[None])[0])
+    np.testing.assert_allclose(xla, want, rtol=1e-8)
+    np.testing.assert_allclose(pal, want, rtol=1e-8)
+
+
+def test_pallas_pf_zero_offset_resampling(maturities, yields_panel):
+    """Regression: a resampling offset of exactly u = 0 must clone particle 0
+    into slot 0 (searchsorted-left semantics), not zero the slot's state —
+    the selection matrix's row-0 lower bound is −∞, not 0."""
+    from tests.test_kalman import _dns_params as _dns_pieces
+    from tests import oracle
+
+    spec, _ = create_model("1C", tuple(maturities), float_type="float64")
+    p, Phi, delta, Omega, obs_var = _dns_pieces()
+    data = np.asarray(yields_panel[:, :30])
+    rng = np.random.default_rng(8)
+    nz = rng.standard_normal((29, P))
+    u = np.zeros(29)  # every resampling offset exactly 0
+    Z = oracle.dns_loadings(p[0], maturities)
+    want = oracle.rbpf_loglik(Z, Phi, delta, Omega, obs_var, data, nz, u,
+                              sv_phi=0.95, sv_sigma=0.2, ess_frac=1.5)
+    xla = float(particle_filter_loglik(
+        spec, jnp.asarray(p), jnp.asarray(data), n_particles=P,
+        noise=(jnp.asarray(nz), jnp.asarray(u)), ess_threshold=1.5))
+    pal = float(pf_loglik_batch(
+        spec, jnp.asarray(p)[None, :], jnp.asarray(data),
+        jnp.asarray(nz)[None], jnp.asarray(u)[None], ess_threshold=1.5)[0])
+    np.testing.assert_allclose(xla, want, rtol=1e-8)
+    np.testing.assert_allclose(pal, want, rtol=1e-8)
+
+
+def test_pallas_pf_dead_lane_padding(maturities, yields_panel):
+    """n_particles < lane width: dead lanes must not change the estimate —
+    a 96-live-particle kernel run on 128 lanes equals the 96-particle XLA
+    engine fed the same (zero-padded) noise."""
+    spec, data, batch, nz, u = _setup(maturities, yields_panel, D=2)
+    n_live = 96
+    want = np.asarray(jax.vmap(
+        lambda q, z, uu: particle_filter_loglik(
+            spec, q, data, n_particles=n_live, noise=(z, uu))
+    )(batch, nz[:, :, :n_live], u))
+    got = np.asarray(pf_loglik_batch(spec, batch, data, nz, u,
+                                     n_particles=n_live))
+    assert np.all(np.isfinite(want))
+    np.testing.assert_allclose(got, want, rtol=1e-9)

@@ -54,9 +54,31 @@ def _measurement(spec: ModelSpec, kp, dtype):
     return Z.astype(dtype), jnp.zeros((spec.N,), dtype=dtype)
 
 
-def _systematic_resample(key, weights, n):
-    """Systematic resampling: fixed-shape, O(P), jit-safe."""
-    positions = (jnp.arange(n) + jax.random.uniform(key)) / n
+def factored_init(spec: ModelSpec, kp, dtype):
+    """Initial state + factored covariances with the engine's jitter/fallback
+    arithmetic — the ONE copy shared by the XLA engine below and the Pallas
+    kernel's parameter packing (ops/pallas_pf._pack_params), so the
+    elementwise common-noise parity contract between them cannot drift.
+    Returns ``(state0, S0, chol_Om, fac_ok)``; a failed factorization is the
+    draw-level −Inf sentinel (sqrt_kf.get_loss conventions)."""
+    Ms = spec.state_dim
+    state0 = K.init_state(spec, kp)
+    P0s = 0.5 * (state0.P + state0.P.T) + 1e-9 * jnp.eye(Ms, dtype=dtype)
+    S0 = jnp.linalg.cholesky(P0s)
+    Om = 0.5 * (kp.Omega_state + kp.Omega_state.T) \
+        + 1e-12 * jnp.eye(Ms, dtype=dtype)
+    chol_Om = jnp.linalg.cholesky(Om)
+    fac_ok = jnp.all(jnp.isfinite(S0)) & jnp.all(jnp.isfinite(chol_Om))
+    S0 = jnp.where(jnp.isfinite(S0), S0, jnp.eye(Ms, dtype=dtype) * 1e-3)
+    chol_Om = jnp.where(jnp.isfinite(chol_Om), chol_Om,
+                        jnp.zeros_like(chol_Om))
+    return state0, S0, chol_Om, fac_ok
+
+
+def _systematic_resample(u, weights, n):
+    """Systematic resampling from a single uniform offset ``u`` ∈ [0, 1):
+    fixed-shape, O(P), jit-safe."""
+    positions = (jnp.arange(n) + u) / n
     cum = jnp.cumsum(weights)
     return jnp.searchsorted(cum, positions)
 
@@ -119,9 +141,12 @@ def _kf_particle_step(Z, d, Phi, delta, chol_Om, beta, S, y, r, obs):
         b_u, S_u, ll, ok = carry
         z, y_i, d_i = zy                              # z (Ms,)
         phi = jnp.sum(S_u * z[:, None, None], axis=0)  # Sᵀz → (Ms, Pn)
-        f = jnp.sum(phi * phi, axis=0) + r            # (Pn,) > 0 always
+        f = jnp.sum(phi * phi, axis=0) + r            # (Pn,) > 0 when r > 0
         fsafe = jnp.where(f > 0, f, 1.0)
-        ok = ok & jnp.isfinite(f)
+        # f ≤ 0 is reachable only from invalid inputs (σ² < 0 passed directly
+        # in constrained space); kill the draw like the Kalman engines do
+        # rather than silently filtering with fsafe = 1
+        ok = ok & jnp.isfinite(f) & (f > 0)
         v = y_i - d_i - jnp.sum(b_u * z[:, None], axis=0)   # (Pn,)
         Sphi = jnp.sum(S_u * phi[None, :, :], axis=1)       # = P z → (Ms, Pn)
         b_u = b_u + Sphi * (v / fsafe)[None, :]
@@ -155,11 +180,12 @@ def particle_filter_loglik(
     spec: ModelSpec,
     params,
     data,
-    key,
+    key=None,
     n_particles: int = 1000,
     sv_phi: float = 0.95,
     sv_sigma: float = 0.2,
     ess_threshold: float = 0.5,
+    noise=None,
 ):
     """Marginal log-likelihood estimate under SV measurement errors.
 
@@ -167,22 +193,22 @@ def particle_filter_loglik(
     recursion over t = 1..T−1 — kalman/filter.jl:190-195).  With
     ``sv_sigma → 0`` the estimate collapses to the exact Kalman loglik.
     Fully jittable; vmap over ``params`` for 1,000-draw MLE sweeps.
+
+    ``noise``: optional ``(normals, uniforms)`` with shapes ``(T-1,
+    n_particles)`` / ``(T-1,)`` — the common-noise mode.  The filter then
+    consumes exactly these draws (normals drive the log-vol proposal,
+    uniforms the systematic-resampling offset) instead of splitting ``key``,
+    so two engines fed the same arrays follow the same particle trajectories:
+    this is the deterministic contract the Pallas kernel
+    (``ops/pallas_pf.py``) is parity-tested against, and what common-random-
+    number estimation drivers pass.
     """
     kp = unpack_kalman(spec, params)
     Pn = n_particles
     Ms = spec.state_dim
     dtype = params.dtype
     Z, d = _measurement(spec, kp, dtype)
-    state0 = K.init_state(spec, kp)
-    # factor P0 and Ω once (sqrt_kf.get_loss conventions): a failed
-    # factorization is the draw-level −Inf sentinel
-    P0s = 0.5 * (state0.P + state0.P.T) + 1e-9 * jnp.eye(Ms, dtype=dtype)
-    S0 = jnp.linalg.cholesky(P0s)
-    Om = 0.5 * (kp.Omega_state + kp.Omega_state.T) + 1e-12 * jnp.eye(Ms, dtype=dtype)
-    chol_Om = jnp.linalg.cholesky(Om)
-    fac_ok = jnp.all(jnp.isfinite(S0)) & jnp.all(jnp.isfinite(chol_Om))
-    S0 = jnp.where(jnp.isfinite(S0), S0, jnp.eye(Ms, dtype=dtype) * 1e-3)
-    chol_Om = jnp.where(jnp.isfinite(chol_Om), chol_Om, jnp.zeros_like(chol_Om))
+    state0, S0, chol_Om, fac_ok = factored_init(spec, kp, dtype)
     beta0 = jnp.broadcast_to(state0.beta[:, None], (Ms, Pn))
     S0b = jnp.broadcast_to(S0[:, :, None], (Ms, Ms, Pn))
     h0 = jnp.zeros((Pn,), dtype=dtype)
@@ -191,9 +217,15 @@ def particle_filter_loglik(
     log_uniform = -jnp.log(jnp.asarray(float(Pn), dtype=params.dtype))
 
     def body(st: PFState, inp):
-        y, t_idx = inp
-        key, k_prop, k_res = jax.random.split(st.key, 3)
-        h_new = sv_phi * st.h + sv_sigma * jax.random.normal(k_prop, (Pn,), dtype=st.h.dtype)
+        if noise is None:
+            y, t_idx = inp
+            key, k_prop, k_res = jax.random.split(st.key, 3)
+            z_row = jax.random.normal(k_prop, (Pn,), dtype=st.h.dtype)
+            u_res = jax.random.uniform(k_res)
+        else:
+            y, t_idx, z_row, u_res = inp
+            key = st.key
+        h_new = sv_phi * st.h + sv_sigma * z_row
         obs = jnp.all(jnp.isfinite(y))
         ysafe = jnp.where(jnp.isfinite(y), y, 0.0)
         r = kp.obs_var * jnp.exp(h_new)
@@ -209,7 +241,7 @@ def particle_filter_loglik(
         step_ll = jnp.where(contributes, step_ll, 0.0)
         wn = jnp.exp(logw_norm)
         ess = 1.0 / jnp.sum(wn * wn)
-        idx = _systematic_resample(k_res, wn, Pn)
+        idx = _systematic_resample(u_res, wn, Pn)
         do_resample = contributes & (ess < ess_threshold * Pn)
         beta = jnp.where(do_resample, beta[:, idx], beta)
         S = jnp.where(do_resample, S[:, :, idx], S)
@@ -220,6 +252,19 @@ def particle_filter_loglik(
 
     t_idx = jnp.arange(T - 1)
     logw0 = jnp.full((Pn,), log_uniform, dtype=params.dtype)
-    _, lls = lax.scan(body, PFState(beta0, S0b, h0, logw0, key), (data.T[:-1], t_idx))
+    if noise is None:
+        if key is None:
+            raise ValueError("particle_filter_loglik needs a PRNG key or "
+                             "a (normals, uniforms) noise pair")
+        xs = (data.T[:-1], t_idx)
+    else:
+        normals, uniforms = noise
+        if normals.shape != (T - 1, Pn) or uniforms.shape != (T - 1,):
+            raise ValueError(
+                f"common-noise shapes must be ({T - 1}, {Pn}) / ({T - 1},); "
+                f"got {normals.shape} / {uniforms.shape}")
+        key = jax.random.PRNGKey(0) if key is None else key  # unused carry
+        xs = (data.T[:-1], t_idx, normals.astype(dtype), uniforms.astype(dtype))
+    _, lls = lax.scan(body, PFState(beta0, S0b, h0, logw0, key), xs)
     total = jnp.sum(lls)
     return jnp.where(fac_ok & jnp.isfinite(total), total, -jnp.inf)
