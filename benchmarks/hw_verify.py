@@ -48,7 +48,7 @@ def main() -> int:
     import jax.numpy as jnp
     import common
 
-    from yieldfactormodels_jl_tpu import create_model
+    from yieldfactormodels_jl_tpu import create_model, get_loss
     from yieldfactormodels_jl_tpu.ops import pallas_kf, pallas_kf_grad, univariate_kf
 
     platform = jax.devices()[0].platform
@@ -279,6 +279,33 @@ def main() -> int:
               f"means {np.mean(sv_got[bsv]):.2f}/{np.mean(sv_ref[bsv]):.2f}, "
               f"gap {mean_gap:.3f} < tol {tol:.3f}"
               if bsv.any() else "no finite lanes")
+
+    # ---- fused score-driven VALUE kernel vs the scan engine ----
+    # the recursion amplifies rounding through T steps (see
+    # tests/test_pallas_ssd.py docstring), so the f32 on-chip gate is looser
+    # than the Kalman value gate; the tight correctness gate is the f64
+    # interpret parity in tests/ (engine + NumPy oracle)
+    from yieldfactormodels_jl_tpu.ops.pallas_ssd import batched_loss as ssd_loss
+
+    sspec, _ = create_model("1SSD-NNS", mats, float_type="float32")
+    sB = 4 if interpret else 64
+    sp = np.asarray(common.ssd_nns_params(sspec))
+    srng = np.random.default_rng(11)
+    sbatch = jnp.asarray(np.tile(sp, (sB, 1))
+                         + 1e-3 * srng.standard_normal((sB, sspec.n_params)),
+                         jnp.float32)
+    sdata = jnp.asarray(np.nan_to_num(data, nan=4.0), jnp.float32)
+    s_ref = np.asarray(jax.jit(jax.vmap(
+        lambda q: get_loss(sspec, q, sdata)))(sbatch))
+    s_got = np.asarray(ssd_loss(sspec, sbatch, sdata, interpret=interpret))
+    sboth = np.isfinite(s_ref) & np.isfinite(s_got)
+    check("ssd-value[1SSD-NNS]",
+          bool(np.array_equal(np.isfinite(s_ref), np.isfinite(s_got)))
+          and bool(sboth.any())
+          and np.allclose(s_got[sboth], s_ref[sboth], rtol=2e-2, atol=1e-4),
+          f"finite {int(sboth.sum())}/{sB}, "
+          f"maxrel {np.max(np.abs(s_got[sboth]-s_ref[sboth])/np.abs(s_ref[sboth])):.2e}"
+          if sboth.any() else "no finite lanes")
 
     # ---- bootstrap λ-grid: MXU-fused engine vs general scan engine ----
     from yieldfactormodels_jl_tpu.estimation.bootstrap import (

@@ -244,8 +244,14 @@ def _run_config(name: str, scale: int):
             return np.asarray([ll])
 
         wall, out = steady(job)
+        # engine note: on TPU the grid + NM candidate values and the L-BFGS
+        # Armijo probes run the fused Pallas value kernel (ops/pallas_ssd,
+        # gated by hw_verify's ssd-value check); gradients keep the scan.
+        eng = ("pallas-value" if optimize._ssd_kernel_enabled(spec)
+               else "scan")
         return wall, (f"256-cand A/B grid + best start x {iters} group iters "
-                      f"(22-dim NM + 12-dim LBFGS blocks), ll={out[0]:.5f}")
+                      f"(22-dim NM + 12-dim LBFGS blocks, engine={eng}), "
+                      f"ll={out[0]:.5f}")
 
     if name == "bootstrap-2000":
         spec, _ = create_model("NS", tuple(common.MATURITIES), float_type="float32")

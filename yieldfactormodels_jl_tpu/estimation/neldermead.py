@@ -127,3 +127,115 @@ def nelder_mead(
     final = lax.while_loop(cond, body, state0)
     i_best = jnp.argmin(final.fvals)
     return final.simplex[i_best], final.fvals[i_best], final.it
+
+
+class NMBatchState(NamedTuple):
+    simplex: jnp.ndarray  # (S, n+1, n)
+    fvals: jnp.ndarray    # (S, n+1)
+    it: jnp.ndarray       # ()
+    iters: jnp.ndarray    # (S,) iteration count at freeze time
+    done: jnp.ndarray     # (S,)
+
+
+def nelder_mead_batched(batch_fun: Callable, X0, max_iters: int = 500,
+                        f_tol: float = 1e-8, step=None):
+    """Lockstep-batched Nelder–Mead: S independent simplexes advance together
+    and EVERY candidate evaluation across the batch is one ``batch_fun`` call.
+
+    ``batch_fun``: (S, K, n) → (S, K) — the leading axis is the start, so an
+    objective that embeds each start's sub-vector into its own full parameter
+    row knows which row a candidate belongs to.  Identical decision logic to
+    :func:`nelder_mead` — fed the same objective it follows the same
+    trajectory per start (tests/test_pallas_ssd.py::test_nelder_mead_batched_trajectory_parity) — but candidate points are evaluated
+    speculatively per case and selected afterwards, so a fused-kernel
+    objective (ops/pallas_ssd.batched_loss) amortizes its launch across the
+    whole batch: 2 batched calls per iteration plus a cond-gated third when
+    some start shrinks.  Converged starts freeze (their rows stop updating)
+    until all are done or ``max_iters``.
+
+    Returns (X_best (S, n), f_best (S,), iters (S,)).
+    """
+    S, n = X0.shape
+    alpha = 1.0
+    beta = 1.0 + 2.0 / n
+    gamma = 0.75 - 1.0 / (2.0 * n)
+    delta = 1.0 - 1.0 / n
+
+    simplex0 = jax.vmap(lambda x: _initial_simplex(x, step))(X0)  # (S, n+1, n)
+    fvals0 = batch_fun(simplex0)
+
+    def fstd(fv):
+        return jnp.std(jnp.nan_to_num(fv, nan=jnp.inf, posinf=1e30), axis=-1)
+
+    state0 = NMBatchState(simplex0, fvals0, jnp.zeros((), jnp.int32),
+                          jnp.zeros((S,), jnp.int32),
+                          fstd(fvals0) <= f_tol)
+
+    def cond(st):
+        return (st.it < max_iters) & jnp.any(~st.done)
+
+    def body(st):
+        order = jnp.argsort(st.fvals, axis=1)
+        simplex = jnp.take_along_axis(st.simplex, order[:, :, None], axis=1)
+        fvals = jnp.take_along_axis(st.fvals, order, axis=1)
+        best = simplex[:, 0]                        # (S, n)
+        worst = simplex[:, -1]
+        f_best, f_second, f_worst = fvals[:, 0], fvals[:, -2], fvals[:, -1]
+        centroid = jnp.mean(simplex[:, :-1], axis=1)
+
+        xr = centroid + alpha * (centroid - worst)
+        fr = batch_fun(xr[:, None, :])[:, 0]        # call 1
+
+        # speculative second candidate per start (exact sequential parity:
+        # each case's point is what nelder_mead would evaluate there)
+        expand = fr < f_best
+        reflect = (~expand) & (fr < f_second)
+        outside = (~expand) & (~reflect) & (fr < f_worst)
+        xe = centroid + beta * (xr - centroid)
+        xc_out = centroid + gamma * (xr - centroid)
+        xc_in = centroid - gamma * (xr - centroid)
+        x2 = jnp.where(expand[:, None], xe,
+                       jnp.where(outside[:, None], xc_out, xc_in))
+        f2 = batch_fun(x2[:, None, :])[:, 0]        # call 2
+
+        # accepted replacement for the worst vertex, or shrink
+        # predicate-select like the sequential cond (NaN f2 ⇒ keep (xr, fr);
+        # jnp.minimum would propagate the NaN and detach f from its point)
+        exp_take = f2 < fr
+        exp_x = jnp.where(exp_take[:, None], x2, xr)
+        exp_f = jnp.where(exp_take, f2, fr)
+        ok_contract = jnp.where(outside, f2 <= fr, f2 < f_worst)
+        shrink = (~expand) & (~reflect) & (~ok_contract)
+        new_x = jnp.where(expand[:, None], exp_x,
+                          jnp.where(reflect[:, None], xr, x2))
+        new_f = jnp.where(expand, exp_f, jnp.where(reflect, fr, f2))
+        repl_simplex = simplex.at[:, -1].set(new_x)
+        repl_fvals = fvals.at[:, -1].set(new_f)
+
+        def with_shrink(_):
+            shr = best[:, None, :] + delta * (simplex - best[:, None, :])
+            shr = shr.at[:, 0].set(best)
+            shr_f = batch_fun(shr)
+            shr_f = shr_f.at[:, 0].set(f_best)
+            sm = jnp.where(shrink[:, None, None], shr, repl_simplex)
+            fv = jnp.where(shrink[:, None], shr_f, repl_fvals)
+            return sm, fv
+
+        new_simplex, new_fvals = lax.cond(
+            jnp.any(shrink & ~st.done), with_shrink,
+            lambda _: (repl_simplex, repl_fvals), operand=None)
+
+        # frozen (converged) starts keep their state
+        new_simplex = jnp.where(st.done[:, None, None], st.simplex, new_simplex)
+        new_fvals = jnp.where(st.done[:, None], st.fvals, new_fvals)
+        now_done = fstd(new_fvals) <= f_tol
+        iters = jnp.where(st.done, st.iters, st.it + 1)
+        return NMBatchState(new_simplex, new_fvals, st.it + 1, iters,
+                            st.done | now_done)
+
+    final = lax.while_loop(cond, body, state0)
+    i_best = jnp.argmin(final.fvals, axis=1)
+    X_best = jnp.take_along_axis(final.simplex, i_best[:, None, None],
+                                 axis=1)[:, 0]
+    f_best = jnp.take_along_axis(final.fvals, i_best[:, None], axis=1)[:, 0]
+    return X_best, f_best, final.iters

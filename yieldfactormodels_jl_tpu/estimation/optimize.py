@@ -23,6 +23,8 @@ NelderMead to the jittable implementation in ``neldermead.py``, Adam to
 
 from __future__ import annotations
 
+import os
+
 from functools import lru_cache
 from typing import Dict, NamedTuple, Optional, Sequence, Tuple
 
@@ -36,7 +38,7 @@ from ..models.params import transform_params, untransform_params, get_new_initia
 from ..models.specs import ModelSpec
 from ..config import register_engine_cache
 from .batched_lbfgs import batched_lbfgs
-from .neldermead import nelder_mead
+from .neldermead import nelder_mead, nelder_mead_batched
 
 
 class Convergence(NamedTuple):
@@ -82,6 +84,33 @@ def _jitted_loss(spec: ModelSpec, T: int):
     """Loss jitted once per (spec, data length); start/end stay traced so every
     rolling-window origin reuses the same executable."""
     return jax.jit(lambda p, data, start, end: api.get_loss(spec, p, data, start, end))
+
+
+def _ssd_kernel_enabled(spec: ModelSpec) -> bool:
+    """Whether the fused Pallas score-driven VALUE kernel (ops/pallas_ssd)
+    serves this spec's bulk value evaluations (A/B grid, Nelder–Mead blocks,
+    L-BFGS Armijo probes).  ``YFM_SSD_PALLAS``: "0" disables, "force" enables
+    off-TPU too (interpret mode — the test hook), default = TPU only."""
+    if spec.family not in ("msed_lambda", "msed_neural"):
+        return False
+    if not spec.detach_inner_beta:  # kernel implements the detached-β̄ score
+        return False
+    flag = os.environ.get("YFM_SSD_PALLAS", "auto")
+    if flag == "0":
+        return False
+    if flag == "force":
+        return True
+    return jax.devices()[0].platform == "tpu"
+
+
+@register_engine_cache
+@lru_cache(maxsize=128)
+def _jitted_ssd_batch_loss(spec: ModelSpec, T: int):
+    """Fused-kernel twin of :func:`_jitted_batch_loss` (constrained batch)."""
+    from ..ops.pallas_ssd import batched_loss as _ssd_loss
+
+    return jax.jit(lambda p, data, start, end: _ssd_loss(spec, p, data,
+                                                         start, end))
 
 
 @register_engine_cache
@@ -210,7 +239,8 @@ def try_initializations(spec: ModelSpec, best_params, data, max_tries: int = 0,
         data = jnp.asarray(data, dtype=spec.dtype)
         if end is None:
             end = data.shape[1]
-        loss_fn = _jitted_batch_loss(spec, data.shape[1])
+        loss_fn = (_jitted_ssd_batch_loss if _ssd_kernel_enabled(spec)
+                   else _jitted_batch_loss)(spec, data.shape[1])
         losses = np.asarray(loss_fn(jnp.asarray(cands, dtype=spec.dtype), data,
                                     jnp.asarray(start), jnp.asarray(end)))
         best = int(np.nanargmax(np.where(np.isfinite(losses), losses, -np.inf)))
@@ -396,6 +426,73 @@ def _jitted_group_opt_batched(spec: ModelSpec, T: int, inds: Tuple[int, ...],
     return jax.jit(jax.vmap(run, in_axes=(0, None, None, None)))
 
 
+@register_engine_cache
+@lru_cache(maxsize=256)
+def _jitted_group_opt_ssd(spec: ModelSpec, T: int, inds: Tuple[int, ...],
+                          kind: str, opts_items: tuple):
+    """Batch-level twin of :func:`_jitted_group_opt_batched` for the MSED
+    families: candidate VALUES run through the fused Pallas score-driven
+    kernel (ops/pallas_ssd) — one launch per Nelder-Mead stage / Armijo probe
+    for the whole start batch — while L-BFGS gradients keep the
+    differentiable scan (the value-probe/gradient split of the Kalman fused
+    path, :func:`fused_objectives`).  For consistency the L-BFGS line search
+    and convergence tests see KERNEL values everywhere (the scan supplies
+    only gradients); the two engines agree to rounding, so this is the
+    approximate-gradient regime quasi-Newton methods tolerate by design —
+    optimizer parity stays tolerance-based (SURVEY.md S7)."""
+    from ..ops.pallas_ssd import batched_loss as _ssd_loss
+
+    opts = dict(opts_items)
+    idx = jnp.asarray(inds, dtype=jnp.int32)
+
+    def _values(P_rows, data, start, end):
+        C = jax.vmap(lambda r: transform_params(spec, r))(P_rows)
+        v = -_ssd_loss(spec, C, data, start, end)
+        return jnp.where(jnp.isfinite(v), v, 1e12)
+
+    if kind == "neldermead":
+        def run_nm(P_full, data, start, end):  # (S, P) raw
+            S, Pn = P_full.shape
+
+            def batch_fun(X):  # (S, K, k) -> (S, K)
+                K = X.shape[1]
+                F = jnp.broadcast_to(P_full[:, None, :], (S, K, Pn))
+                F = F.at[:, :, idx].set(X)
+                return _values(F.reshape(S * K, Pn), data, start,
+                               end).reshape(S, K)
+
+            x, f, _ = nelder_mead_batched(batch_fun, P_full[:, idx],
+                                          max_iters=opts["max_iters"],
+                                          f_tol=opts.get("f_tol", 1e-8))
+            return P_full.at[:, idx].set(x), f
+
+        return jax.jit(run_nm)
+
+    if kind != "lbfgs":
+        raise ValueError(f"ssd group runner supports neldermead/lbfgs, "
+                         f"not {kind!r}")
+
+    def run_lb(P_full, data, start, end):
+        def value_fn(Xs):  # (S, k)
+            return _values(P_full.at[:, idx].set(Xs), data, start, end)
+
+        def vag(Xs):
+            def single(x_sub, p_row):
+                p = p_row.at[idx].set(x_sub)
+                return _finite_objective(spec, data, p, start, end)
+
+            _, grads = jax.vmap(jax.value_and_grad(single))(Xs, P_full)
+            return value_fn(Xs), jnp.where(jnp.isfinite(grads), grads, 0.0)
+
+        res = batched_lbfgs(vag, P_full[:, idx], opts["max_iters"],
+                            g_tol=opts.get("g_tol", 1e-6),
+                            f_abstol=opts.get("f_abstol", 1e-6),
+                            invalid_above=_PENALTY_THRESH, value_fn=value_fn)
+        return P_full.at[:, idx].set(res.x), res.f
+
+    return jax.jit(run_lb)
+
+
 def estimate_steps(spec: ModelSpec, data, all_params, param_groups: Sequence[str],
                    max_group_iters: int = 10, tol: float = 1e-8,
                    optimizers: Optional[Dict[str, Tuple[str, dict]]] = None,
@@ -448,7 +545,9 @@ def estimate_steps(spec: ModelSpec, data, all_params, param_groups: Sequence[str
     # one core, optimization.jl:205; round 1 still looped them in Python) ----
     X = jnp.asarray(raw.T, dtype=spec.dtype)          # (S, P)
     S = n_starts
-    batch_loss = _jitted_batch_loss(spec, T)
+    use_ssd = _ssd_kernel_enabled(spec)
+    batch_loss = (_jitted_ssd_batch_loss if use_ssd
+                  else _jitted_batch_loss)(spec, T)
     prev_ll = np.full(S, -np.inf)
     done = np.zeros(S, dtype=bool)       # own ΔLL criterion met or aborted
     converged = np.zeros(S, dtype=bool)  # met the ΔLL criterion specifically
@@ -463,8 +562,12 @@ def estimate_steps(spec: ModelSpec, data, all_params, param_groups: Sequence[str
             inds = tuple(i for i, gg in enumerate(param_groups) if gg == g)
             if not inds:
                 continue
-            runner = _jitted_group_opt_batched(spec, T, inds, kind,
+            if use_ssd and kind in ("neldermead", "lbfgs"):
+                runner = _jitted_group_opt_ssd(spec, T, inds, kind,
                                                tuple(sorted(opts.items())))
+            else:
+                runner = _jitted_group_opt_batched(spec, T, inds, kind,
+                                                   tuple(sorted(opts.items())))
             X_new, f_g = runner(X, data, jnp.asarray(start), jnp.asarray(end))
             f_g = np.asarray(f_g, dtype=np.float64)
             obj_broken = f_g >= _PENALTY_THRESH  # (S,) clamped ⇒ never saw finite
