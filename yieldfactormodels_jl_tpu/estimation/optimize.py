@@ -388,6 +388,31 @@ def estimate(spec: ModelSpec, data, all_params, start=0, end=None,
     fs = np.asarray(fs, dtype=np.float64)
     lls = -fs
     j = int(np.nanargmax(np.where(np.isfinite(lls), lls, -np.inf)))
+    if kind == "fused":
+        # trust-but-verify the kernel-reported optimum: ONE scan-engine eval
+        # of the winner.  Motivated by the round-3 window-1 anomaly (device
+        # config-2 optimum collapsed 16,100 → −30,278 with the restructured
+        # adjoint unverified on hardware, BASELINE.md) — a silent kernel/
+        # compiler fault must not corrupt results unnoticed.  Warn-only by
+        # default; YFM_FUSED_CHECK=fallback re-runs the vmap path.
+        ll_scan = float(_jitted_loss(spec, T)(
+            transform_params(spec, jnp.asarray(np.asarray(xs)[j],
+                                               dtype=spec.dtype)),
+            data, jnp.asarray(start), jnp.asarray(end)))
+        gap = abs(ll_scan - lls[j])
+        bad = (not np.isfinite(ll_scan)) if np.isfinite(lls[j]) else False
+        bad = bad or (np.isfinite(lls[j])
+                      and gap > 5e-3 * max(abs(ll_scan), 1.0))
+        if bad:
+            import sys as _sys
+            _sys.stderr.write(
+                f"# estimate(): fused-kernel optimum disagrees with the scan "
+                f"engine (fused {lls[j]:.3f} vs scan {ll_scan:.3f}) — "
+                f"suspect kernel/compiler fault; "
+                f"YFM_FUSED_CHECK={os.environ.get('YFM_FUSED_CHECK', 'warn')}\n")
+            if os.environ.get("YFM_FUSED_CHECK", "warn") == "fallback":
+                return estimate(spec, data, all_params, start, end, max_iters,
+                                g_tol, f_abstol, printing, objective="vmap")
     if printing:
         print(f"✓ Best LL = {lls[j]} from starting point {j + 1}/{len(lls)}")
     best = transform_params(spec, jnp.asarray(np.asarray(xs)[j], dtype=spec.dtype))
