@@ -251,6 +251,26 @@ def main():
         t_sv, out_sv = timed(sval, arg=sbatch)
         sfin = int(np.isfinite(np.asarray(out_sv)).sum())
         if on_tpu:
+            # the fused value kernel (ops/pallas_ssd): whole pass per grid
+            # program — the config-6 latency fix; cross-checked loosely
+            # (recursion amplifies f32 rounding, tests/test_pallas_ssd.py)
+            try:
+                from yieldfactormodels_jl_tpu.ops.pallas_ssd import (
+                    batched_loss as ssd_kernel)
+
+                t_sk, out_sk = timed(jax.jit(partial(
+                    ssd_kernel, sspec, data=dev_data)), arg=sbatch)
+                bk = np.isfinite(np.asarray(out_sv)) & \
+                    np.isfinite(np.asarray(out_sk))
+                k_agree = bool(bk.any()) and np.allclose(
+                    np.asarray(out_sk)[bk], np.asarray(out_sv)[bk], rtol=2e-2)
+                skern = (f" | pallas-value {sb / t_sk:.2f} "
+                         f"(agree={k_agree})")
+            except Exception as e:
+                skern = f" | pallas-value failed ({type(e).__name__})"
+        else:
+            skern = ""
+        if on_tpu:
             svag = jax.jit(jax.vmap(jax.value_and_grad(
                 lambda p: api.get_loss(sspec, p, dev_data))))
             t_sg, _ = timed(svag, arg=sbatch)
@@ -261,7 +281,7 @@ def main():
             # reasoning as the fused grad bench above)
             sgrad = " | value+grad skipped (cpu fallback: compile-heavy)"
         ssd_ctx = (f"; 1SSD-NNS (batch {sb}) evals/s: value {sb / t_sv:.2f}"
-                   f"{sgrad}, finite {sfin}/{sb}")
+                   f"{skern}{sgrad}, finite {sfin}/{sb}")
     except Exception as e:  # never kill the bench line
         ssd_ctx = f"; ssd bench failed ({type(e).__name__}: {e})"
 
