@@ -58,3 +58,20 @@ def yields_panel(rng, maturities):
     from tests.oracle import simulate_dns_panel
 
     return simulate_dns_panel(rng, maturities, T=80)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Drop compiled executables between test modules.
+
+    A single pytest process accumulates ~200 jitted programs (several of them
+    very large: interpret-mode Pallas kernels, 2nd-order-AD scans, whole-
+    optimizer while_loops); past that point the XLA:CPU backend_compile has
+    been observed to SEGFAULT on a compile that succeeds in a fresh process
+    (reproduced twice at test_run's flagship estimation, 2026-07-31 — solo
+    and any-subset runs pass).  Clearing caches per module bounds the live
+    compiler state; the cost is re-compiling shared fixtures a few times."""
+    yield
+    import jax
+
+    jax.clear_caches()

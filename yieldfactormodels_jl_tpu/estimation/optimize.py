@@ -700,7 +700,28 @@ def estimate_windows(spec: ModelSpec, data, raw_starts, window_starts, window_en
         ends_vec = jnp.repeat(we, S)
         runner = _jitted_fused_windows(spec, T, max_iters, g_tol, f_abstol)
         xs, fs, its, convs = runner(X0, data, starts_vec, ends_vec)
-        return xs.reshape(W, S, Pn), -fs.reshape(W, S)
+        lls = -fs.reshape(W, S)
+        # trust-but-verify (same rationale as estimate()): ONE scan eval of
+        # the first window's best start flags a silently-faulty kernel
+        j0 = int(np.nanargmax(np.where(np.isfinite(np.asarray(lls[0])),
+                                       np.asarray(lls[0]), -np.inf)))
+        ll_scan = float(_jitted_loss(spec, T)(
+            transform_params(spec, xs.reshape(W, S, Pn)[0, j0]),
+            data, ws[0], we[0]))
+        ll_fused = float(lls[0, j0])
+        if np.isfinite(ll_fused) and (
+                not np.isfinite(ll_scan)
+                or abs(ll_scan - ll_fused) > 5e-3 * max(abs(ll_scan), 1.0)):
+            import sys as _sys
+            _sys.stderr.write(
+                f"# estimate_windows(): fused-kernel optimum disagrees with "
+                f"the scan engine on window 0 (fused {ll_fused:.3f} vs scan "
+                f"{ll_scan:.3f}) — suspect kernel/compiler fault\n")
+            if os.environ.get("YFM_FUSED_CHECK", "warn") == "fallback":
+                return estimate_windows(spec, data, raw_starts, window_starts,
+                                        window_ends, max_iters, g_tol,
+                                        f_abstol, objective="vmap")
+        return xs.reshape(W, S, Pn), lls
     runner = _jitted_window_multistart(spec, T, max_iters, g_tol, f_abstol)
     xs, fs, its, convs = runner(
         jnp.asarray(raw_starts, dtype=spec.dtype),
