@@ -192,3 +192,32 @@ def test_pallas_pf_dead_lane_padding(maturities, yields_panel):
                                      n_particles=n_live))
     assert np.all(np.isfinite(want))
     np.testing.assert_allclose(got, want, rtol=1e-9)
+
+
+def test_estimate_sv_kernel_engine(maturities, yields_panel, monkeypatch):
+    """estimate_sv with the fused-kernel CRN engine (YFM_PF_PALLAS=force →
+    interpret): deterministic, finite, and recovers a sane optimum; the
+    estimate-sv-params variant returns in-range (φ_h, σ_h).  The noise
+    realization differs from the key-splitting scan path by design, so the
+    contract is quality, not equality."""
+    from yieldfactormodels_jl_tpu.estimation.sv import estimate_sv
+    from yieldfactormodels_jl_tpu.models.params import untransform_params
+    from tests.test_extensions import _dns_params
+
+    spec, _ = create_model("1C", tuple(maturities), float_type="float64")
+    data = jnp.asarray(yields_panel[:, :40])
+    raw = np.asarray(untransform_params(spec, jnp.asarray(_dns_params())))
+    starts = np.stack([raw, raw + 1e-3], axis=0)
+    monkeypatch.setenv("YFM_PF_PALLAS", "force")
+    kw = dict(n_particles=P, max_iters=15, sv_phi=0.9, sv_sigma=0.15)
+    best, ll, lls, iters = estimate_sv(spec, data, starts,
+                                       key=jax.random.PRNGKey(3), **kw)
+    best2, ll2, *_ = estimate_sv(spec, data, starts,
+                                 key=jax.random.PRNGKey(3), **kw)
+    assert np.isfinite(ll) and ll == ll2
+    np.testing.assert_allclose(best, best2, rtol=0, atol=0)
+    bestf, llf, _, _, (phi_hat, sig_hat) = estimate_sv(
+        spec, data, starts, key=jax.random.PRNGKey(3),
+        estimate_sv_params=True, **kw)
+    assert np.isfinite(llf)
+    assert -1.0 < phi_hat < 1.0 and sig_hat > 0.0

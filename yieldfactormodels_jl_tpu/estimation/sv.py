@@ -30,9 +30,80 @@ from ..models.specs import ModelSpec
 from ..ops.particle import particle_filter_loglik
 from ..utils.transformations import (from_11_to_R, from_pos_to_R,
                                      from_R_to_11, from_R_to_pos)
-from .neldermead import nelder_mead
+from .neldermead import nelder_mead, nelder_mead_batched
 
 _PENALTY = 1e12
+
+
+def _pf_kernel_enabled() -> bool:
+    """Whether the fused Pallas PF kernel (ops/pallas_pf) evaluates the CRN
+    objective.  Same switch semantics as optimize._ssd_kernel_enabled:
+    ``YFM_PF_PALLAS`` "0" disables, "force" enables off-TPU (interpret, the
+    test hook), default = TPU only."""
+    import os
+
+    flag = os.environ.get("YFM_PF_PALLAS", "auto")
+    if flag == "0":
+        return False
+    if flag == "force":
+        return True
+    return jax.devices()[0].platform == "tpu"
+
+
+@register_engine_cache
+@lru_cache(maxsize=32)
+def _jitted_sv_search_pallas(spec: ModelSpec, T: int, n_particles: int,
+                             sv_phi, sv_sigma, max_iters: int, f_tol: float,
+                             full: bool):
+    """Kernel-backed twin of the two searches below: the whole multi-start
+    simplex advances in lockstep (nelder_mead_batched) and EVERY candidate
+    evaluation across (starts × vertices) is ONE fused PF kernel launch.
+    Common random numbers become common noise ARRAYS (the kernel's streamed-
+    noise contract) shared by every candidate — the same fixed-surface
+    property, one launch instead of S vmapped per-step scans.  ``full``
+    appends (φ_h, σ_h) to the search vector via their bijections, per draw."""
+    from ..ops.pallas_pf import pf_loglik_batch
+
+    P_pad = -(-n_particles // 128) * 128
+
+    def run(raw0, data, key):  # raw0 (S, n)
+        kz, ku = jax.random.split(key)
+        nz = jax.random.normal(kz, (T - 1, P_pad), dtype=data.dtype)
+        us = jax.random.uniform(ku, (T - 1,), dtype=data.dtype)
+
+        def batch_fun(X):  # (S, K, n) -> (S, K)
+            S_, K, n = X.shape
+            flat = X.reshape(S_ * K, n)
+            if full:
+                C = jax.vmap(lambda r: transform_params(spec, r[:-2]))(flat)
+                phis = from_R_to_11(flat[:, -2])
+                sigs = from_R_to_pos(flat[:, -1])
+            else:
+                C = jax.vmap(lambda r: transform_params(spec, r))(flat)
+                phis = jnp.asarray(sv_phi, dtype=data.dtype)
+                sigs = jnp.asarray(sv_sigma, dtype=data.dtype)
+            D = S_ * K
+            ll = pf_loglik_batch(
+                spec, C, data,
+                jnp.broadcast_to(nz[None], (D, T - 1, P_pad)),
+                jnp.broadcast_to(us[None], (D, T - 1)),
+                n_particles=n_particles, sv_phi=phis, sv_sigma=sigs)
+            return jnp.where(jnp.isfinite(ll), -ll, _PENALTY).reshape(S_, K)
+
+        if full:
+            step = jnp.concatenate(
+                [0.025 + 0.05 * raw0[:, :-2],
+                 jnp.full((raw0.shape[0], 2), 0.5, dtype=raw0.dtype)], axis=1)
+            # nelder_mead_batched shares one step vector; per-start steps
+            # differ only via raw0 — use the first start's (they are jittered
+            # copies, and the SV coordinates' 0.5 is what matters)
+            step = step[0]
+        else:
+            step = None
+        return nelder_mead_batched(batch_fun, raw0, max_iters=max_iters,
+                                   f_tol=f_tol, step=step)
+
+    return jax.jit(run)
 
 
 @register_engine_cache
@@ -97,6 +168,12 @@ def estimate_sv(
     ``(best_params_constrained, best_ll, lls (S,), iters (S,))`` with the PF
     loglik evaluated at the shared common-random-numbers key.
 
+    On TPU (``YFM_PF_PALLAS`` knob; "force" for interpret tests) the search
+    runs lockstep-batched with every candidate evaluated through ONE fused
+    PF kernel launch on shared noise arrays — the same fixed-surface CRN
+    property, a different (but equally valid) noise realization than the
+    key-splitting scan path.
+
     ``estimate_sv_params=False`` holds the volatility dynamics (φ_h, σ_h)
     fixed at ``sv_phi``/``sv_sigma``.  With ``estimate_sv_params=True`` they
     join the searched vector (``sv_phi``/``sv_sigma`` become the starting
@@ -109,6 +186,8 @@ def estimate_sv(
     raw_starts = jnp.asarray(raw_starts, dtype=spec.dtype)
     if raw_starts.ndim == 1:
         raw_starts = raw_starts[None, :]
+    use_kernel = _pf_kernel_enabled() and spec.family in ("kalman_dns",
+                                                          "kalman_afns")
     if estimate_sv_params:
         sv0 = jnp.asarray([from_11_to_R(jnp.asarray(float(sv_phi))),
                            from_pos_to_R(jnp.asarray(float(sv_sigma)))],
@@ -116,8 +195,17 @@ def estimate_sv(
         raw_starts = jnp.concatenate(
             [raw_starts,
              jnp.broadcast_to(sv0, (raw_starts.shape[0], 2))], axis=1)
-        fn = _jitted_sv_search_full(spec, data.shape[1], n_particles,
-                                    int(max_iters), float(f_tol))
+        if use_kernel:
+            fn = _jitted_sv_search_pallas(spec, data.shape[1], n_particles,
+                                          0.0, 0.0, int(max_iters),
+                                          float(f_tol), True)
+        else:
+            fn = _jitted_sv_search_full(spec, data.shape[1], n_particles,
+                                        int(max_iters), float(f_tol))
+    elif use_kernel:
+        fn = _jitted_sv_search_pallas(spec, data.shape[1], n_particles,
+                                      float(sv_phi), float(sv_sigma),
+                                      int(max_iters), float(f_tol), False)
     else:
         fn = _jitted_sv_search(spec, data.shape[1], n_particles,
                                float(sv_phi), float(sv_sigma), int(max_iters),

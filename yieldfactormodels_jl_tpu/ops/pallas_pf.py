@@ -290,9 +290,11 @@ def pf_loglik_batch(
         raise ValueError(f"n_particles must be in (0, {P}]; got {n_eff}")
 
     rows, fac_ok = jax.vmap(partial(_pack_params, spec, ft=ft))(params_batch)
-    sv = jnp.broadcast_to(
-        jnp.stack([jnp.asarray(sv_phi, dtype=ft),
-                   jnp.asarray(sv_sigma, dtype=ft)]), (D, 2))
+    # sv_phi / sv_sigma: shared scalars or per-draw (D,) vectors (the SV-MLE
+    # search gives every candidate its own volatility dynamics)
+    sv = jnp.stack([jnp.broadcast_to(jnp.asarray(sv_phi, dtype=ft), (D,)),
+                    jnp.broadcast_to(jnp.asarray(sv_sigma, dtype=ft), (D,))],
+                   axis=1)
     rows = jnp.concatenate([rows, sv], axis=1)
 
     out = pl.pallas_call(
