@@ -1,7 +1,7 @@
 from . import linalg
 
 __all__ = ["linalg", "assoc_scan", "particle", "pallas_kf", "pallas_pf",
-           "smoother", "sqrt_kf", "univariate_kf"]
+           "pallas_ssd", "smoother", "sqrt_kf", "univariate_kf"]
 
 
 def __getattr__(name):
