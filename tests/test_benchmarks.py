@@ -54,3 +54,20 @@ def test_benchmark_config_runs(name, scale):
     if name == "afns5-sv-pf":
         # the finite-draw count is part of the work string; all must survive
         assert "finite 4/4" in descr, descr
+
+
+def test_device_recover_rejects_unknown_steps(monkeypatch, tmp_path):
+    """A RECOVER_STEPS typo must fail loudly, not no-op to 'success'."""
+    import importlib.util
+
+    spec_ = importlib.util.spec_from_file_location(
+        "device_recover",
+        os.path.join(os.path.dirname(__file__), os.pardir, "benchmarks",
+                     "device_recover.py"))
+    mod = importlib.util.module_from_spec(spec_)
+    spec_.loader.exec_module(mod)
+    monkeypatch.setenv("RECOVER_STEPS", "pf-race")  # typo: dash not underscore
+    monkeypatch.setattr(mod, "WORKDIR", str(tmp_path))
+    monkeypatch.setattr(mod, "LOG", str(tmp_path / "log"))
+    with pytest.raises(SystemExit, match="unknown RECOVER_STEPS"):
+        mod.device_sequence()
