@@ -203,8 +203,13 @@ def _dns_score(gamma, beta, y, maturities):
 
 
 def msed_lambda_filter(params_struct, maturities, data, scale_grad=False,
-                       forget_factor=0.98, dtype_eps=np.finfo(np.float64).eps):
-    """params_struct: dict with A (L,), B (L,) or None, omega, delta, Phi."""
+                       forget_factor=0.98, dtype_eps=np.finfo(np.float64).eps,
+                       record_traj=False):
+    """params_struct: dict with A (L,), B (L,) or None, omega, delta, Phi.
+
+    ``record_traj=True`` additionally returns the per-step (Z_next, β_obs)
+    trajectory — the post-transition loadings and the post-re-OLS β the
+    closed-form (δ, Φ) parity check needs (fully-observed data only)."""
     A = params_struct["A"]
     B = params_struct["B"]
     omega = params_struct["omega"]
@@ -220,6 +225,8 @@ def msed_lambda_filter(params_struct, maturities, data, scale_grad=False,
 
     N, T = data.shape
     preds = np.zeros((N, T))
+    Z_traj = np.zeros((T, N, 3))
+    b_traj = np.zeros((T, 3))
     for t in range(T):
         y = data[:, t]
         if np.isnan(y[0]):
@@ -243,9 +250,39 @@ def msed_lambda_filter(params_struct, maturities, data, scale_grad=False,
         if B is not None:
             gamma = nu + B * gamma
             Z = dns_loadings(gamma[0], maturities)
+        Z_traj[t] = Z
+        b_traj[t] = beta
         beta = mu + Phi @ beta
         preds[:, t] = Z @ beta
+    if record_traj:
+        return preds, {"Z_next": Z_traj, "beta_obs": b_traj}
     return preds
+
+
+def msed_lambda_closed_delta_phi(params_struct, maturities, data):
+    """Independent NumPy solve of the (δ, Φ) block optimum for the λ-MSED
+    model on fully-observed data — the oracle for
+    ``optimize._jitted_group_opt_msed_closed`` (CLAUDE.md parity rule).
+
+    Runs the per-step oracle filter for the trajectory, then builds the
+    normal equations of Σₜ ‖y_{t+1} − Z_{t+1}(μ + Φ β̄_t)‖² over
+    θ = (μ, vec_rowmajor Φ) in float64 and recovers δ = (I − Φ)⁻¹μ."""
+    _, traj = msed_lambda_filter(params_struct, maturities, data,
+                                 record_traj=True)
+    N, T = data.shape
+    rows, rhs = [], []
+    for t in range(T - 1):  # contributions t = 0 .. T−2
+        Z = traj["Z_next"][t]          # (N, 3)
+        b = traj["beta_obs"][t]        # (3,)
+        D = np.concatenate([Z, np.einsum("nm,k->nmk", Z, b).reshape(N, 9)], 1)
+        rows.append(D)
+        rhs.append(data[:, t + 1])
+    D = np.concatenate(rows, axis=0)
+    y = np.concatenate(rhs, axis=0)
+    theta, *_ = np.linalg.lstsq(D, y, rcond=None)
+    mu, Phi = theta[:3], theta[3:].reshape(3, 3)
+    delta = np.linalg.solve(np.eye(3) - Phi, mu)
+    return delta, Phi
 
 
 def _neural_score_fd(gamma18, beta, y, maturities, transform_bool, eps=1e-6):
@@ -339,6 +376,29 @@ def static_filter(gamma_Z, delta, Phi, data):
             beta = mu + Phi @ _ols(Z, y)
         preds[:, t] = Z @ beta
     return preds
+
+
+def static_closed_delta_phi(Z, data):
+    """Independent NumPy solve of the (δ, Φ) block optimum for a static
+    model with fixed loadings Z on fully-observed data — the oracle for the
+    static branch of ``optimize._jitted_group_opt_msed_closed`` (CLAUDE.md
+    parity rule; the MSED branch's oracle is
+    :func:`msed_lambda_closed_delta_phi`).  β̄_t is per-column OLS; the
+    objective Σₜ ‖y_{t+1} − Z(μ + Φ β̄_t)‖² is exactly quadratic in
+    θ = (μ, vec_rowmajor Φ)."""
+    N, T = data.shape
+    rows, rhs = [], []
+    for t in range(T - 1):
+        b = _ols(Z, data[:, t])
+        D = np.concatenate([Z, np.einsum("nm,k->nmk", Z, b).reshape(N, 9)], 1)
+        rows.append(D)
+        rhs.append(data[:, t + 1])
+    D = np.concatenate(rows, axis=0)
+    y = np.concatenate(rhs, axis=0)
+    theta, *_ = np.linalg.lstsq(D, y, rcond=None)
+    mu, Phi = theta[:3], theta[3:].reshape(3, 3)
+    delta = np.linalg.solve(np.eye(3) - Phi, mu)
+    return delta, Phi
 
 
 # ---------------------------------------------------------------------------

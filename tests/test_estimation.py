@@ -2,6 +2,7 @@
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from yieldfactormodels_jl_tpu import create_model, get_loss, transform_params
 from yieldfactormodels_jl_tpu.estimation import optimize as opt
@@ -121,8 +122,6 @@ def test_estimate_steps_raises_on_structurally_broken_objective(maturities):
     objective is the penalty everywhere; the reference rethrows errors on the
     first group iteration (optimization.jl:244-250) — here that surfaces as a
     RuntimeError, not a silent penalty 'optimum'."""
-    import pytest
-
     spec, _ = create_model("1C", tuple(maturities), float_type="float64")
     data = np.full((len(maturities), 30), 1e200)
     starts = np.full((spec.n_params, 1), 0.5)
@@ -140,3 +139,191 @@ def test_estimate_steps_reports_real_convergence(maturities, yields_panel):
     assert isinstance(conv, opt.Convergence)
     assert np.isfinite(ll)
     assert 1 <= conv.iterations <= 6
+
+
+def test_fused_check_defaults_to_fallback(monkeypatch):
+    """The trust-but-verify guard must DEFAULT to fallback while the Pallas
+    adjoints' on-chip grad gates are unpassed (VERDICT round 3, weak #2: the
+    round-3 device window recorded an unresolved fused-path optimum
+    regression, BASELINE.md 'Anomaly under investigation').  Flipping back to
+    warn-only requires hw_verify grad-gate evidence, not a silent edit."""
+    monkeypatch.delenv("YFM_FUSED_CHECK", raising=False)
+    assert opt._fused_check_mode() == "fallback"
+    monkeypatch.setenv("YFM_FUSED_CHECK", "warn")
+    assert opt._fused_check_mode() == "warn"
+
+
+def _sd_point(spec, rng):
+    from tests.oracle import generic_stable_params
+
+    return generic_stable_params(spec, rng)
+
+
+@pytest.mark.parametrize("code", ["SD-NS", "NS"])
+def test_msed_closed_form_group2_is_block_optimal(code, maturities,
+                                                  yields_panel, rng):
+    """The closed-form (δ, Φ) solve lands on a stationary point of the FULL
+    loss restricted to the block: on a fully-observed window the γ trajectory
+    and the per-step OLS β̄ never depend on (δ, Φ) (score_driven._step; same
+    structure with constant Z for the static families, static_model), so
+    the sub-objective is exactly quadratic and one 12×12 solve is its global
+    optimum — the redesign of the reference's group-"2" L-BFGS
+    (optimization.jl:439-494) that removes config 6's per-pass latency wall."""
+    import jax
+
+    from yieldfactormodels_jl_tpu.models.params import untransform_params
+
+    spec, _ = create_model(code, tuple(maturities), float_type="float64")
+    T = yields_panel.shape[1]
+    data = jnp.asarray(yields_panel)
+    cons = _sd_point(spec, rng)
+    lo_d, _ = spec.layout["delta"]
+    _, hi_p = spec.layout["phi"]
+    cons[lo_d:hi_p] *= 0.8  # push the block off its optimum (diag stays <1)
+    raw = np.asarray(untransform_params(spec, jnp.asarray(cons)))
+    inds = tuple(range(lo_d, hi_p))
+    assert opt._msed_closed_applicable(spec, inds, data, 0, T)
+
+    runner = opt._jitted_group_opt_msed_closed(spec, T)
+    X_new, f = runner(jnp.asarray(raw)[None, :], data,
+                      jnp.asarray(0), jnp.asarray(T))
+    f_old = float(opt._finite_objective(spec, data, jnp.asarray(raw), 0, T))
+    assert float(f[0]) < f_old  # improved, and f is the accepted value
+
+    idx = jnp.asarray(inds)
+    x_new = jnp.asarray(X_new)[0]
+
+    def sub(x):
+        return opt._finite_objective(spec, data, x_new.at[idx].set(x), 0, T)
+
+    g_new = np.asarray(jax.grad(sub)(x_new[idx]))
+    g_old = np.asarray(jax.grad(sub)(jnp.asarray(raw)[idx]))
+    assert np.linalg.norm(g_new) < 1e-6 * max(1.0, np.linalg.norm(g_old))
+
+
+def test_msed_closed_form_gates_on_missing_data(maturities, yields_panel):
+    """A NaN inside the window breaks exact quadraticity (β carries through Φ
+    across the gap) — the gate must refuse; a NaN beyond ``end`` is fine."""
+    spec, _ = create_model("SD-NS", tuple(maturities), float_type="float64")
+    lo_d, _ = spec.layout["delta"]
+    _, hi_p = spec.layout["phi"]
+    inds = tuple(range(lo_d, hi_p))
+    T = yields_panel.shape[1]
+    holed = np.array(yields_panel)
+    holed[0, T // 2] = np.nan
+    assert not opt._msed_closed_applicable(spec, inds, holed, 0, T)
+    assert opt._msed_closed_applicable(spec, inds, holed, 0, T // 2)
+    # wrong block or an unsupported family (random walk): refuse
+    assert not opt._msed_closed_applicable(spec, inds[1:], yields_panel, 0, T)
+    rspec, _ = create_model("RW", tuple(maturities), float_type="float64")
+    r_inds = tuple(range(rspec.layout["delta"][0], rspec.layout["phi"][1]))
+    assert not opt._msed_closed_applicable(rspec, r_inds, yields_panel, 0, T)
+
+
+def test_estimate_steps_closed_form_beats_lbfgs_path(maturities, yields_panel,
+                                                     monkeypatch, rng):
+    """estimate_steps with the closed-form group-2 runner reaches at least the
+    LL of the pure-iterative path on the same starts (accept-if-improved can
+    only help), at a fraction of the filter passes."""
+    spec, _ = create_model("SD-NS", tuple(maturities), float_type="float64")
+    groups = list(spec.default_param_groups())
+    start_p = _sd_point(spec, np.random.default_rng(7))[:, None]
+
+    monkeypatch.setenv("YFM_MSED_CLOSED", "0")
+    _, ll_iter, _, _ = opt.estimate_steps(spec, yields_panel, start_p, groups,
+                                          max_group_iters=3)
+    monkeypatch.delenv("YFM_MSED_CLOSED")
+    _, ll_closed, _, _ = opt.estimate_steps(spec, yields_panel, start_p, groups,
+                                            max_group_iters=3)
+    assert np.isfinite(ll_closed)
+    assert ll_closed >= ll_iter - 1e-6
+
+
+def test_msed_closed_form_matches_numpy_oracle(maturities, yields_panel, rng):
+    """CLAUDE.md parity rule: the closed-form solve must agree with an
+    INDEPENDENT NumPy float64 computation (oracle filter loop + lstsq normal
+    equations), never only with another JAX path — a systematic bug shared by
+    scan_filter's trajectory outputs and the design-matrix assembly would
+    cancel in the JAX-vs-JAX tests."""
+    from tests import oracle
+    from yieldfactormodels_jl_tpu.models.params import (transform_params,
+                                                        untransform_params)
+
+    spec, _ = create_model("SD-NS", tuple(maturities), float_type="float64")
+    cons = _sd_point(spec, rng)
+    lo_d, hi_d = spec.layout["delta"]
+    lo_p, hi_p = spec.layout["phi"]
+    cons[lo_d:hi_p] *= 0.8
+    raw = jnp.asarray(np.asarray(untransform_params(spec, jnp.asarray(cons))))
+
+    T = yields_panel.shape[1]
+    runner = opt._jitted_group_opt_msed_closed(spec, T)
+    X_new, _ = runner(raw[None, :], jnp.asarray(yields_panel),
+                      jnp.asarray(0), jnp.asarray(T))
+    got = np.asarray(transform_params(spec, jnp.asarray(X_new)[0]))
+
+    struct = {"A": cons[0:1], "B": cons[1:2], "omega": cons[2:3],
+              "delta": cons[lo_d:hi_d],
+              "Phi": cons[lo_p:hi_p].reshape(3, 3).T}
+    want_delta, want_Phi = oracle.msed_lambda_closed_delta_phi(
+        struct, maturities, yields_panel)
+    np.testing.assert_allclose(got[lo_d:hi_d], want_delta, rtol=1e-6)
+    np.testing.assert_allclose(got[lo_p:hi_p].reshape(3, 3).T, want_Phi,
+                               rtol=1e-6, atol=1e-8)
+
+
+def test_static_closed_form_matches_numpy_oracle(maturities, yields_panel):
+    """Static-branch twin of the MSED oracle parity check: the constant-Z
+    closed-form solve must agree with an independent NumPy float64
+    computation (oracle per-column OLS loop + lstsq), never only with
+    another JAX path (CLAUDE.md parity rule)."""
+    from tests import oracle
+    from yieldfactormodels_jl_tpu.models.params import (transform_params,
+                                                        untransform_params)
+
+    spec, _ = create_model("NS", tuple(maturities), float_type="float64")
+    cons = np.asarray(oracle.stable_ns_params(spec, dtype=np.float64))
+    lo_d, hi_d = spec.layout["delta"]
+    lo_p, hi_p = spec.layout["phi"]
+    cons[lo_d:hi_p] *= 0.8
+    raw = jnp.asarray(np.asarray(untransform_params(spec, jnp.asarray(cons))))
+
+    T = yields_panel.shape[1]
+    runner = opt._jitted_group_opt_msed_closed(spec, T)
+    X_new, _ = runner(raw[None, :], jnp.asarray(yields_panel),
+                      jnp.asarray(0), jnp.asarray(T))
+    got = np.asarray(transform_params(spec, jnp.asarray(X_new)[0]))
+
+    Z = np.asarray(oracle.dns_loadings(float(cons[spec.layout["gamma"][0]]),
+                                       maturities))
+    want_delta, want_Phi = oracle.static_closed_delta_phi(Z, yields_panel)
+    np.testing.assert_allclose(got[lo_d:hi_d], want_delta, rtol=1e-6)
+    np.testing.assert_allclose(got[lo_p:hi_p].reshape(3, 3).T, want_Phi,
+                               rtol=1e-6, atol=1e-8)
+
+
+def test_closed_form_survives_nan_forecast_tail(maturities, yields_panel, rng):
+    """Regression: NaN data OUTSIDE the window (forecast tails) must not
+    poison the normal equations through 0·NaN masking — the solve must still
+    improve the block, not silently no-op (review finding, round 4)."""
+    from yieldfactormodels_jl_tpu.models.params import untransform_params
+
+    spec, _ = create_model("SD-NS", tuple(maturities), float_type="float64")
+    cons = _sd_point(spec, rng)
+    lo_d, _ = spec.layout["delta"]
+    _, hi_p = spec.layout["phi"]
+    cons[lo_d:hi_p] *= 0.8
+    raw = jnp.asarray(np.asarray(untransform_params(spec, jnp.asarray(cons))))
+
+    T_obs = yields_panel.shape[1]
+    ext = np.concatenate([yields_panel,
+                          np.full((yields_panel.shape[0], 12), np.nan)], 1)
+    assert opt._msed_closed_applicable(
+        spec, tuple(range(lo_d, hi_p)), ext, 0, T_obs)
+    runner = opt._jitted_group_opt_msed_closed(spec, ext.shape[1])
+    X_new, f = runner(raw[None, :], jnp.asarray(ext),
+                      jnp.asarray(0), jnp.asarray(T_obs))
+    f_old = float(opt._finite_objective(spec, jnp.asarray(ext), raw,
+                                        0, T_obs))
+    assert float(f[0]) < f_old  # improved — i.e. the candidate was taken
+    assert not np.allclose(np.asarray(X_new)[0], np.asarray(raw))

@@ -70,6 +70,22 @@ def compute_loss(spec: ModelSpec, data, raw_params, start=0, end=None):
 _PENALTY_THRESH = 0.999e12
 
 
+def _fused_check_mode() -> str:
+    """Trust-but-verify policy for the fused-kernel optimum.
+
+    Default is ``fallback`` (re-run the vmap path on disagreement) until the
+    Pallas adjoint kernels pass their on-chip gradient gates: round-3 device
+    window 1 recorded an unresolved optimum regression on the fused path
+    (config 2's ll collapsed 16,100 → −30,278, BASELINE.md "Anomaly under
+    investigation") while the restructured adjoints' hardware grad checks had
+    never completed.  A guard that observes corruption and proceeds anyway is
+    telemetry, not a guard (VERDICT round 3, weak #2).  Flip the default back
+    to ``warn`` only with the hw_verify grad-gate evidence in hand.
+    ``YFM_FUSED_CHECK=warn`` restores warn-only explicitly.
+    """
+    return os.environ.get("YFM_FUSED_CHECK", "fallback")
+
+
 def _finite_objective(spec: ModelSpec, data, raw_params, start, end, penalty=1e12):
     """Objective with ±Inf/NaN clamped to a large finite penalty so line
     searches and Adam keep moving (the reference's Optim handles Inf natively;
@@ -393,8 +409,8 @@ def estimate(spec: ModelSpec, data, all_params, start=0, end=None,
         # of the winner.  Motivated by the round-3 window-1 anomaly (device
         # config-2 optimum collapsed 16,100 → −30,278 with the restructured
         # adjoint unverified on hardware, BASELINE.md) — a silent kernel/
-        # compiler fault must not corrupt results unnoticed.  Warn-only by
-        # default; YFM_FUSED_CHECK=fallback re-runs the vmap path.
+        # compiler fault must not corrupt results unnoticed.  Fallback by
+        # default until the on-chip grad gates pass (_fused_check_mode).
         ll_scan = float(_jitted_loss(spec, T)(
             transform_params(spec, jnp.asarray(np.asarray(xs)[j],
                                                dtype=spec.dtype)),
@@ -409,8 +425,8 @@ def estimate(spec: ModelSpec, data, all_params, start=0, end=None,
                 f"# estimate(): fused-kernel optimum disagrees with the scan "
                 f"engine (fused {lls[j]:.3f} vs scan {ll_scan:.3f}) — "
                 f"suspect kernel/compiler fault; "
-                f"YFM_FUSED_CHECK={os.environ.get('YFM_FUSED_CHECK', 'warn')}\n")
-            if os.environ.get("YFM_FUSED_CHECK", "warn") == "fallback":
+                f"YFM_FUSED_CHECK={_fused_check_mode()}\n")
+            if _fused_check_mode() == "fallback":
                 return estimate(spec, data, all_params, start, end, max_iters,
                                 g_tol, f_abstol, printing, objective="vmap")
     if printing:
@@ -518,6 +534,119 @@ def _jitted_group_opt_ssd(spec: ModelSpec, T: int, inds: Tuple[int, ...],
     return jax.jit(run_lb)
 
 
+def _msed_closed_applicable(spec: ModelSpec, inds, data, start, end) -> bool:
+    """Gate for the closed-form (δ, Φ) block solve (see
+    :func:`_jitted_group_opt_msed_closed`).  Requires: an MSED or static
+    (non-RW) family spec (M = 3 filter structure), the group being exactly
+    the contiguous (δ, Φ) tail block, concrete window bounds, and a FULLY
+    OBSERVED window — with missing columns β carries through Φ across steps
+    (score_driven._step transition branch) and the sub-objective stops being
+    quadratic."""
+    ok_family = spec.is_msed or spec.family in ("static_lambda",
+                                                "static_neural")
+    if not ok_family or spec.M != 3:
+        return False
+    if os.environ.get("YFM_MSED_CLOSED", "1") == "0":
+        return False
+    lo_d, _ = spec.layout["delta"]
+    _, hi_p = spec.layout["phi"]
+    if tuple(inds) != tuple(range(lo_d, hi_p)):
+        return False
+    try:
+        s, e = int(start), int(end)
+    except TypeError:
+        return False
+    return bool(np.isfinite(np.asarray(data)[:, s:e]).all())
+
+
+@register_engine_cache
+@lru_cache(maxsize=256)
+def _jitted_group_opt_msed_closed(spec: ModelSpec, T: int):
+    """Closed-form exact solve of the (δ, Φ) block for MSED/static models.
+
+    Structure exploited (a TPU-first redesign of the reference's group-"2"
+    L-BFGS, optimization.jl:439-494): in the score-driven recursion
+    (/root/reference/src/models/filter.jl:52-91) the γ trajectory is driven
+    only by (A, B, ω) through the inner score, and on every observed step the
+    measurement β̄ is re-fit by OLS from scratch — so on a fully-observed
+    window NEITHER depends on (δ, Φ).  The loss contribution at step t is
+    −‖y_{t+1} − Z_{t+1}(μ + Φ β̄_t)‖² with Z_{t+1}, β̄_t, y_{t+1} all
+    constants w.r.t. the block: the sub-objective is EXACTLY quadratic in
+    (μ, vec Φ), a 12-dim linear least squares.  One trajectory pass + one
+    12×12 solve replaces hundreds of 2nd-order-AD filter passes (the
+    ~131 ms/pass device latency wall behind BASELINE.md config 6's 0.12×).
+    The static families (filter.jl:93-110) share the structure with a
+    CONSTANT Z — handled by the same runner without a scan.
+
+    δ is recovered from μ = (I − Φ)δ; the Φ diagonal is clipped into the
+    (−1, 1) image of the R_TO_11 bijection.  The candidate is accepted only
+    if it improves the full objective (evaluated by the scan engine), so
+    block-coordinate monotonicity is preserved unconditionally — clipping,
+    f32 normal-equation rounding, or a singular (I − Φ) degrade to a no-op,
+    never to corruption.
+    """
+    from ..models import score_driven as SD
+    from ..models import static_model as ST
+    from ..models.params import unpack_static
+    from ..ops.linalg import ols_solve
+
+    M = spec.M
+    P_HI = jax.lax.Precision.HIGHEST  # normal equations must not ride bf16 MXU
+
+    def run(p_raw, data, start, end):
+        cons = transform_params(spec, p_raw)
+        t_idx = jnp.arange(T - 1)
+        contrib = ((t_idx >= start) & (t_idx <= end - 2)).astype(cons.dtype)
+        if spec.is_msed:
+            _, _, outs = SD.scan_filter(spec, cons, data, start, end)
+            Z2, Z3 = outs["Z2"][:-1], outs["Z3"][:-1]      # (T-1, N) at γ_{t+1}
+            X = jnp.stack([jnp.ones_like(Z2), Z2, Z3], -1)  # (T-1, N, M)
+            bo = outs["beta_obs"][:-1]                      # (T-1, M)
+        else:
+            # static families: Z is constant (γ is a static parameter) and
+            # β̄_t is per-column OLS — same quadratic structure, no scan
+            sp = unpack_static(spec, cons)
+            Zc = ST.loadings_fn(spec, sp.gamma)             # (N, M)
+            ysafe = jnp.where(jnp.isfinite(data), data, 0.0)
+            bo = jax.vmap(lambda y: ols_solve(Zc, y))(ysafe.T[:-1])  # (T-1, M)
+            X = jnp.broadcast_to(Zc, (T - 1,) + Zc.shape)
+        y1 = data[:, 1:].T                                # (T-1, N) targets
+        # regressors for vec_rowmajor(Φ): column (m, k) is X[:, :, m]·β̄[k]
+        Dphi = (X[:, :, :, None] * bo[:, None, None, :]).reshape(
+            T - 1, X.shape[1], M * M)
+        D = jnp.concatenate([X, Dphi], axis=-1)           # (T-1, N, M+M²)
+        # mask by jnp.where, NEVER by multiplication: NaN data outside the
+        # window (forecast tails) would otherwise poison the sums via 0·NaN
+        # and silently no-op the solve forever (same rule as
+        # window_contributions, models/common.py)
+        keep = contrib[:, None, None] > 0
+        Dm = jnp.where(keep, D, 0.0)
+        ym = jnp.where(keep[:, :, 0], y1, 0.0)
+        G = jnp.einsum("tnp,tnq->pq", Dm, Dm, precision=P_HI)
+        b = jnp.einsum("tnp,tn->p", Dm, ym, precision=P_HI)
+        theta = jnp.linalg.solve(G, b)
+        lam = 1e-8 * jnp.trace(G) / G.shape[0]
+        theta_r = jnp.linalg.solve(
+            G + lam * jnp.eye(G.shape[0], dtype=G.dtype), b)
+        theta = jnp.where(jnp.all(jnp.isfinite(theta)), theta, theta_r)
+        mu = theta[:M]
+        Phi = theta[M:].reshape(M, M)
+        d = jnp.clip(jnp.diagonal(Phi), -0.999999, 0.999999)
+        Phi = Phi + jnp.diag(d - jnp.diagonal(Phi))
+        delta = jnp.linalg.solve(jnp.eye(M, dtype=Phi.dtype) - Phi, mu)
+        lo_d, hi_d = spec.layout["delta"]
+        lo_p, hi_p = spec.layout["phi"]
+        new_cons = (cons.at[lo_d:hi_d].set(delta)
+                    .at[lo_p:hi_p].set(Phi.T.reshape(-1)))  # col-major vec
+        new_raw = untransform_params(spec, new_cons)
+        f_new = _finite_objective(spec, data, new_raw, start, end)
+        f_old = _finite_objective(spec, data, p_raw, start, end)
+        take = jnp.logical_and(f_new < f_old, jnp.all(jnp.isfinite(new_raw)))
+        return jnp.where(take, new_raw, p_raw), jnp.minimum(f_new, f_old)
+
+    return jax.jit(jax.vmap(run, in_axes=(0, None, None, None)))
+
+
 def estimate_steps(spec: ModelSpec, data, all_params, param_groups: Sequence[str],
                    max_group_iters: int = 10, tol: float = 1e-8,
                    optimizers: Optional[Dict[str, Tuple[str, dict]]] = None,
@@ -577,6 +706,12 @@ def estimate_steps(spec: ModelSpec, data, all_params, param_groups: Sequence[str
     done = np.zeros(S, dtype=bool)       # own ΔLL criterion met or aborted
     converged = np.zeros(S, dtype=bool)  # met the ΔLL criterion specifically
     iters_done = np.zeros(S, dtype=np.int64)
+    inds_by_group = {g: tuple(i for i, gg in enumerate(param_groups) if gg == g)
+                     for g in group_ids}
+    # loop-invariant: one host-side finiteness scan, not one per group per
+    # iteration (the gate pulls the data window to host)
+    closed_ok = {g: _msed_closed_applicable(spec, inds_by_group[g], data,
+                                            start, end) for g in group_ids}
     first_group_of_run = True
     for it in range(max_group_iters):
         aborted = np.zeros(S, dtype=bool)
@@ -584,10 +719,16 @@ def estimate_steps(spec: ModelSpec, data, all_params, param_groups: Sequence[str
             if g == "-1":  # placeholder group skipped (:221-223)
                 continue
             kind, opts = _optimizer_for_group(g, table)
-            inds = tuple(i for i, gg in enumerate(param_groups) if gg == g)
+            inds = inds_by_group[g]
             if not inds:
                 continue
-            if use_ssd and kind in ("neldermead", "lbfgs"):
+            if closed_ok[g]:
+                # exact block optimum in one trajectory pass + 12×12 solve
+                # (see _jitted_group_opt_msed_closed) — strictly dominates
+                # any iterative minimizer of the same sub-objective, and the
+                # accept-if-improved guard keeps descent monotone regardless
+                runner = _jitted_group_opt_msed_closed(spec, T)
+            elif use_ssd and kind in ("neldermead", "lbfgs"):
                 runner = _jitted_group_opt_ssd(spec, T, inds, kind,
                                                tuple(sorted(opts.items())))
             else:
@@ -717,7 +858,7 @@ def estimate_windows(spec: ModelSpec, data, raw_starts, window_starts, window_en
                 f"# estimate_windows(): fused-kernel optimum disagrees with "
                 f"the scan engine on window 0 (fused {ll_fused:.3f} vs scan "
                 f"{ll_scan:.3f}) — suspect kernel/compiler fault\n")
-            if os.environ.get("YFM_FUSED_CHECK", "warn") == "fallback":
+            if _fused_check_mode() == "fallback":
                 return estimate_windows(spec, data, raw_starts, window_starts,
                                         window_ends, max_iters, g_tol,
                                         f_abstol, objective="vmap")

@@ -124,6 +124,10 @@ def _step(spec: ModelSpec, mp: MSEDParams, state: MSEDState, y, observed):
         "gamma": gamma_next,
         "Z2": Z_next[:, 1],
         "Z3": Z_next[:, 2],
+        # pre-transition measurement β (post-update) — on fully-observed
+        # windows this is pure OLS, independent of (δ, Φ): the fact the
+        # closed-form group-"2" solve in estimation/optimize.py exploits
+        "beta_obs": beta_obs,
     }
     return MSEDState(gamma_next, beta_next, ewma, count), out
 
