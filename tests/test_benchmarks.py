@@ -36,6 +36,7 @@ print("RESULT " + json.dumps([wall, descr]))
     ("rolling-240", 48),      # 5 windows
     ("bootstrap-2000", 100),  # 20 resamples
     ("ssd-nns-m3", 10),       # 1 start x 1 group iter
+    ("bootstrap-xl", 1600),   # 5 resamples (the 16× throughput-scaled row)
 ])
 def test_benchmark_config_runs(name, scale):
     env = {k: v for k, v in os.environ.items()
