@@ -39,7 +39,7 @@ import time
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 ROOT = os.path.dirname(HERE)
-WORKDIR = os.environ.get("RECOVER_WORKDIR", "/tmp/r3")
+WORKDIR = os.environ.get("RECOVER_WORKDIR", "/tmp/r4")
 LOG = os.path.join(WORKDIR, "probe_loop.log")
 PROBE_SOFT_S = float(os.environ.get("RECOVER_PROBE_SOFT_S", "2700"))
 SLEEP_S = float(os.environ.get("RECOVER_SLEEP_S", "120"))
@@ -72,9 +72,12 @@ def _patient_run(cmd, soft_s, tag, extra_env=None):
     # persistent compile cache: remote compiles through the relay dominate
     # every device step's wall time; cache executables across processes so
     # re-runs (second windows, bench after hw_verify) skip them where the
-    # PJRT plugin supports serialization (harmless no-op where it doesn't)
-    env.setdefault("JAX_COMPILATION_CACHE_DIR",
-                   os.path.join(WORKDIR, "jax_cache"))
+    # PJRT plugin supports serialization (harmless no-op where it doesn't).
+    # Device steps only — XLA:CPU AOT executables are host-specific and a
+    # stale CPU cache risks SIGILL (see hw_verify.py).
+    if (extra_env or {}).get("JAX_PLATFORMS") != "cpu":
+        env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                       os.path.join(WORKDIR, "jax_cache"))
     if extra_env:
         env.update(extra_env)
     with open(LOG, "a") as logf:
@@ -125,11 +128,19 @@ def device_sequence() -> None:
         "pf_race":  # config 3 only: XLA lane-major vs fused Pallas PF
             [sys.executable, os.path.join(HERE, "run_all.py"),
              "--side", "device", "--configs", "afns5-sv-pf"],
+        "ssd_race":  # config 6 only: closed-form group-2 + SSD value kernel
+            [sys.executable, os.path.join(HERE, "run_all.py"),
+             "--side", "device", "--configs", "ssd-nns-m3"],
+        "hw_grad":  # the adjoint gates alone, small shapes — the round-3
+                    # optimum-regression anomaly's decisive evidence, first
+            [sys.executable, os.path.join(HERE, "hw_verify.py"),
+             "--only", "grad"],
         "hw_verify": [sys.executable, os.path.join(HERE, "hw_verify.py")],
         "bench": [sys.executable, os.path.join(ROOT, "bench.py")],
     }
     wanted = [w.strip() for w in os.environ.get(
-        "RECOVER_STEPS", "run_all_device,hw_verify,bench").split(",")
+        "RECOVER_STEPS",
+        "hw_grad,ssd_race,pf_race,bench,hw_verify,run_all_device").split(",")
         if w.strip()]
     unknown = [w for w in wanted if w not in catalog]
     if unknown:  # a typo must not silently degrade to a no-op "success"
