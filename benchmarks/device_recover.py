@@ -78,6 +78,11 @@ def _patient_run(cmd, soft_s, tag, extra_env=None):
     if (extra_env or {}).get("JAX_PLATFORMS") != "cpu":
         env.setdefault("JAX_COMPILATION_CACHE_DIR",
                        os.path.join(WORKDIR, "jax_cache"))
+    else:
+        # an inherited cache dir must not reach CPU steps either (popping,
+        # not just skipping the setdefault): host-specific XLA:CPU AOT
+        # artifacts from another container risk SIGILL
+        env.pop("JAX_COMPILATION_CACHE_DIR", None)
     if extra_env:
         env.update(extra_env)
     with open(LOG, "a") as logf:

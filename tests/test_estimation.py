@@ -1,5 +1,7 @@
 """Estimation-layer tests: optimizers, multi-start, block-coordinate, grids."""
 
+import os
+
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -327,3 +329,36 @@ def test_closed_form_survives_nan_forecast_tail(maturities, yields_panel, rng):
                                         0, T_obs))
     assert float(f[0]) < f_old  # improved — i.e. the candidate was taken
     assert not np.allclose(np.asarray(X_new)[0], np.asarray(raw))
+
+
+def test_estimate_steps_ssd_guard_falls_back_on_kernel_disagreement(
+        maturities, yields_panel, monkeypatch, rng):
+    """estimate_steps' kernel-valued convergence path gets the same
+    trust-but-verify contract as estimate(): a corrupted SSD kernel value
+    must be caught by the one scan-engine eval of the winner and, under the
+    fallback default, the whole estimation re-runs on the scan engine."""
+    spec, _ = create_model("SD-NS", tuple(maturities), float_type="float64")
+    start_p = _sd_point(spec, rng)[:, None]
+    groups = list(spec.default_param_groups())
+    table = {"1": ("neldermead", dict(max_iters=20)),
+             "2": ("lbfgs", dict(max_iters=10, g_tol=1e-6, f_abstol=1e-6))}
+
+    monkeypatch.setenv("YFM_SSD_PALLAS", "force")
+    real = opt._jitted_ssd_batch_loss
+
+    def corrupted(spec_, T_):
+        fn = real(spec_, T_)
+        return lambda p, d, s, e: fn(p, d, s, e) + 0.1  # systematic fault
+
+    monkeypatch.setattr(opt, "_jitted_ssd_batch_loss", corrupted)
+    _, ll, best, _ = opt.estimate_steps(spec, yields_panel, start_p, groups,
+                                        max_group_iters=1, optimizers=table)
+    # the fallback re-ran on the scan engine: the reported ll is consistent
+    # with an independent scan-engine eval of the returned params
+    ll_check = float(get_loss(spec, jnp.asarray(best),
+                              jnp.asarray(yields_panel)))
+    np.testing.assert_allclose(ll, ll_check, rtol=1e-9)
+    # the fallback threads _force_scan as a call argument — the knob itself
+    # is untouched (no process-global env mutation)
+    assert os.environ["YFM_SSD_PALLAS"] == "force"
+

@@ -86,6 +86,24 @@ def _fused_check_mode() -> str:
     return os.environ.get("YFM_FUSED_CHECK", "fallback")
 
 
+def _fused_disagrees(ll_engine: float, ll_scan: float) -> bool:
+    """Shared disagreement criterion for every trust-but-verify guard
+    (estimate / estimate_windows / estimate_steps): a finite engine-reported
+    optimum whose one scan-engine re-eval is non-finite or off by more than
+    0.5% relative.  One definition so the three guards can never drift."""
+    return bool(np.isfinite(ll_engine)
+                and (not np.isfinite(ll_scan)
+                     or abs(ll_scan - ll_engine) > 5e-3 * max(abs(ll_scan), 1.0)))
+
+
+def _warn_fused_disagreement(tag: str, ll_engine: float, ll_scan: float):
+    import sys as _sys
+    _sys.stderr.write(
+        f"# {tag}: fused-kernel optimum disagrees with the scan engine "
+        f"(fused {ll_engine:.6f} vs scan {ll_scan:.6f}) — suspect "
+        f"kernel/compiler fault; YFM_FUSED_CHECK={_fused_check_mode()}\n")
+
+
 def _finite_objective(spec: ModelSpec, data, raw_params, start, end, penalty=1e12):
     """Objective with ±Inf/NaN clamped to a large finite penalty so line
     searches and Adam keep moving (the reference's Optim handles Inf natively;
@@ -233,7 +251,7 @@ def _sanitize(params):
 
 
 def try_initializations(spec: ModelSpec, best_params, data, max_tries: int = 0,
-                        start=0, end=None):
+                        start=0, end=None, _force_scan: bool = False):
     """Returns a (P, S) matrix of candidate starting points (constrained).
 
     - MSED: evaluate the full A×B guess grid in one vmapped batch and keep the
@@ -255,7 +273,8 @@ def try_initializations(spec: ModelSpec, best_params, data, max_tries: int = 0,
         data = jnp.asarray(data, dtype=spec.dtype)
         if end is None:
             end = data.shape[1]
-        loss_fn = (_jitted_ssd_batch_loss if _ssd_kernel_enabled(spec)
+        loss_fn = (_jitted_ssd_batch_loss
+                   if _ssd_kernel_enabled(spec) and not _force_scan
                    else _jitted_batch_loss)(spec, data.shape[1])
         losses = np.asarray(loss_fn(jnp.asarray(cands, dtype=spec.dtype), data,
                                     jnp.asarray(start), jnp.asarray(end)))
@@ -415,17 +434,8 @@ def estimate(spec: ModelSpec, data, all_params, start=0, end=None,
             transform_params(spec, jnp.asarray(np.asarray(xs)[j],
                                                dtype=spec.dtype)),
             data, jnp.asarray(start), jnp.asarray(end)))
-        gap = abs(ll_scan - lls[j])
-        bad = (not np.isfinite(ll_scan)) if np.isfinite(lls[j]) else False
-        bad = bad or (np.isfinite(lls[j])
-                      and gap > 5e-3 * max(abs(ll_scan), 1.0))
-        if bad:
-            import sys as _sys
-            _sys.stderr.write(
-                f"# estimate(): fused-kernel optimum disagrees with the scan "
-                f"engine (fused {lls[j]:.3f} vs scan {ll_scan:.3f}) — "
-                f"suspect kernel/compiler fault; "
-                f"YFM_FUSED_CHECK={_fused_check_mode()}\n")
+        if _fused_disagrees(lls[j], ll_scan):
+            _warn_fused_disagreement("estimate()", lls[j], ll_scan)
             if _fused_check_mode() == "fallback":
                 return estimate(spec, data, all_params, start, end, max_iters,
                                 g_tol, f_abstol, printing, objective="vmap")
@@ -650,7 +660,8 @@ def _jitted_group_opt_msed_closed(spec: ModelSpec, T: int):
 def estimate_steps(spec: ModelSpec, data, all_params, param_groups: Sequence[str],
                    max_group_iters: int = 10, tol: float = 1e-8,
                    optimizers: Optional[Dict[str, Tuple[str, dict]]] = None,
-                   start=0, end=None, max_tries: int = 0, printing: bool = False):
+                   start=0, end=None, max_tries: int = 0, printing: bool = False,
+                   _force_scan: bool = False):
     """Block-coordinate estimation over parameter groups.
 
     Faithful to the reference control flow: improved initializations for the
@@ -673,7 +684,8 @@ def estimate_steps(spec: ModelSpec, data, all_params, param_groups: Sequence[str
     if all_params.ndim == 1:
         all_params = all_params[:, None]
     all_params = try_initializations(spec, all_params[:, 0], data, max_tries=max_tries,
-                                     start=start, end=end)
+                                     start=start, end=end,
+                                     _force_scan=_force_scan)
     n_starts = all_params.shape[1]
     raw = np.stack(
         [_sanitize(np.asarray(untransform_params(spec, jnp.asarray(c)))) for c in all_params.T],
@@ -699,7 +711,7 @@ def estimate_steps(spec: ModelSpec, data, all_params, param_groups: Sequence[str
     # one core, optimization.jl:205; round 1 still looped them in Python) ----
     X = jnp.asarray(raw.T, dtype=spec.dtype)          # (S, P)
     S = n_starts
-    use_ssd = _ssd_kernel_enabled(spec)
+    use_ssd = _ssd_kernel_enabled(spec) and not _force_scan
     batch_loss = (_jitted_ssd_batch_loss if use_ssd
                   else _jitted_batch_loss)(spec, T)
     prev_ll = np.full(S, -np.inf)
@@ -774,6 +786,24 @@ def estimate_steps(spec: ModelSpec, data, all_params, param_groups: Sequence[str
     X_np = np.asarray(X, dtype=np.float64)
     best = np.asarray(transform_params(spec, jnp.asarray(X_np[best_j], dtype=spec.dtype)))
     init = np.asarray(transform_params(spec, jnp.asarray(raw[:, best_j], dtype=spec.dtype)))
+    if use_ssd:
+        # trust-but-verify the kernel-reported winner, same contract as
+        # estimate(): the convergence LLs above came from the fused SSD
+        # kernel, and a silently-faulty kernel (the round-3 device anomaly
+        # class) would otherwise own both the selection and the reported
+        # optimum.  One scan-engine eval of the winner flags it; fallback
+        # re-runs the whole estimation on the scan engine (threaded as a
+        # call argument, not process-global env state).
+        ll_scan = float(_loss(jnp.asarray(best, dtype=spec.dtype), data,
+                              _start_j, _end_j))
+        ll_kern = float(prev_ll[best_j])
+        if _fused_disagrees(ll_kern, ll_scan):
+            _warn_fused_disagreement("estimate_steps()", ll_kern, ll_scan)
+            if _fused_check_mode() == "fallback":
+                return estimate_steps(spec, data, all_params, param_groups,
+                                      max_group_iters, tol, optimizers,
+                                      start, end, max_tries, printing,
+                                      _force_scan=True)
     if printing:
         print(f"✓ Best overall LL = {prev_ll[best_j]} from start {best_j + 1}")
     return init, float(prev_ll[best_j]), best, Convergence(
@@ -850,14 +880,9 @@ def estimate_windows(spec: ModelSpec, data, raw_starts, window_starts, window_en
             transform_params(spec, xs.reshape(W, S, Pn)[0, j0]),
             data, ws[0], we[0]))
         ll_fused = float(lls[0, j0])
-        if np.isfinite(ll_fused) and (
-                not np.isfinite(ll_scan)
-                or abs(ll_scan - ll_fused) > 5e-3 * max(abs(ll_scan), 1.0)):
-            import sys as _sys
-            _sys.stderr.write(
-                f"# estimate_windows(): fused-kernel optimum disagrees with "
-                f"the scan engine on window 0 (fused {ll_fused:.3f} vs scan "
-                f"{ll_scan:.3f}) — suspect kernel/compiler fault\n")
+        if _fused_disagrees(ll_fused, ll_scan):
+            _warn_fused_disagreement("estimate_windows() window 0",
+                                     ll_fused, ll_scan)
             if _fused_check_mode() == "fallback":
                 return estimate_windows(spec, data, raw_starts, window_starts,
                                         window_ends, max_iters, g_tol,
