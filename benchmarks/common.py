@@ -8,6 +8,7 @@ factored out for the five-config suite in run_all.py.
 from __future__ import annotations
 
 import math
+import time
 
 import numpy as np
 
@@ -39,6 +40,23 @@ def grad_agreement(g_a, g_b, cos_min=0.999, norm_tol=0.05):
     ratio = np.abs(na / np.maximum(nb, 1e-12) - 1)
     ok = bool(cos.min() > cos_min) and bool(np.all(ratio < norm_tol))
     return ok, f"cos_min {cos.min():.6f}, norm_ratio_max {ratio.max():.3f}"
+
+
+def steady_wall(fn, arg, reps=5):
+    """Warm (compile) then time ``reps`` back-to-back calls, hard-synced.
+
+    The shared warm-then-time discipline for the benchmark scripts (bench.py
+    and run_all.py carry older local variants with their own flow-specific
+    semantics; new scripts should use this one)."""
+    import jax
+    import numpy as _np
+
+    _np.asarray(jax.block_until_ready(fn(arg)))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(arg)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
 
 
 def dns_panel(seed=0, lam=0.5, T=T_MONTHS):

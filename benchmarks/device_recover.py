@@ -142,11 +142,13 @@ def device_sequence() -> None:
              "--only", "grad"],
         "hw_verify": [sys.executable, os.path.join(HERE, "hw_verify.py")],
         "bench": [sys.executable, os.path.join(ROOT, "bench.py")],
+        "trace":  # roofline evidence: batch sweep + jax.profiler artifact
+            [sys.executable, os.path.join(HERE, "trace_kernel.py")],
     }
     wanted = [w.strip() for w in os.environ.get(
         "RECOVER_STEPS",
-        "hw_grad,ssd_race,pf_race,bench,hw_verify,run_all_device").split(",")
-        if w.strip()]
+        "hw_grad,ssd_race,pf_race,bench,trace,hw_verify,run_all_device"
+        ).split(",") if w.strip()]
     unknown = [w for w in wanted if w not in catalog]
     if unknown:  # a typo must not silently degrade to a no-op "success"
         raise SystemExit(f"unknown RECOVER_STEPS {unknown}; "
