@@ -259,16 +259,27 @@ def msed_lambda_filter(params_struct, maturities, data, scale_grad=False,
     return preds
 
 
-def msed_lambda_closed_delta_phi(params_struct, maturities, data):
-    """Independent NumPy solve of the (δ, Φ) block optimum for the λ-MSED
-    model on fully-observed data — the oracle for
-    ``optimize._jitted_group_opt_msed_closed`` (CLAUDE.md parity rule).
+def neural_struct_from_flat(p, random_walk=False):
+    """Oracle param-struct from a flat scalar-dynamics neural-MSED vector
+    ([A(2) | B(2 unless RW) | ω(18) | δ(3) | vec_colmajor Φ(9)]).  Encodes
+    the scalar duplicator [0]×9+[1]×9 (mseneural.jl:33-51) and the
+    col-major Φ unpack ONCE for every oracle-parity test — deliberately
+    independent of the library's spec machinery."""
+    p = np.asarray(p)
+    expand = lambda u: np.concatenate([np.full(9, u[0]), np.full(9, u[1])])
+    k = 2 if random_walk else 4
+    return {"A": expand(p[0:2]),
+            "B": None if random_walk else expand(p[2:4]),
+            "omega": p[k:k + 18], "delta": p[k + 18:k + 21],
+            "Phi": p[k + 21:k + 30].reshape(3, 3).T}
 
-    Runs the per-step oracle filter for the trajectory, then builds the
-    normal equations of Σₜ ‖y_{t+1} − Z_{t+1}(μ + Φ β̄_t)‖² over
-    θ = (μ, vec_rowmajor Φ) in float64 and recovers δ = (I − Φ)⁻¹μ."""
-    _, traj = msed_lambda_filter(params_struct, maturities, data,
-                                 record_traj=True)
+
+def closed_delta_phi_from_traj(traj, data):
+    """Normal-equation solve of the (δ, Φ) block optimum from a recorded
+    per-step (Z_next, β_obs) trajectory (fully-observed data): lstsq over
+    Σₜ ‖y_{t+1} − Z_{t+1}(μ + Φ β̄_t)‖² in θ = (μ, vec_rowmajor Φ),
+    then δ = (I − Φ)⁻¹μ.  Shared by the λ/neural/static closed-form
+    oracles (CLAUDE.md parity rule)."""
     N, T = data.shape
     rows, rhs = [], []
     for t in range(T - 1):  # contributions t = 0 .. T−2
@@ -283,6 +294,15 @@ def msed_lambda_closed_delta_phi(params_struct, maturities, data):
     mu, Phi = theta[:3], theta[3:].reshape(3, 3)
     delta = np.linalg.solve(np.eye(3) - Phi, mu)
     return delta, Phi
+
+
+def msed_lambda_closed_delta_phi(params_struct, maturities, data):
+    """Independent NumPy solve of the (δ, Φ) block optimum for the λ-MSED
+    model on fully-observed data — the oracle for
+    ``optimize._jitted_group_opt_msed_closed``."""
+    _, traj = msed_lambda_filter(params_struct, maturities, data,
+                                 record_traj=True)
+    return closed_delta_phi_from_traj(traj, data)
 
 
 def _neural_score_fd(gamma18, beta, y, maturities, transform_bool, eps=1e-6):
@@ -304,11 +324,15 @@ def _neural_score_fd(gamma18, beta, y, maturities, transform_bool, eps=1e-6):
 
 def msed_neural_filter(params_struct, maturities, data, transform_bool,
                        scale_grad=False, forget_factor=0.98,
-                       dtype_eps=np.finfo(np.float64).eps):
+                       dtype_eps=np.finfo(np.float64).eps, record_traj=False):
     """Per-step neural MSED loop (models/filter.jl:52-91 with the two-MLP
     loadings of mseneural.jl:137-163).  ``params_struct``: dict with A (18,)
     and B (18,) (or None for random-walk dynamics) already expanded through
-    the duplicator, omega (18,), delta (3,), Phi (3,3)."""
+    the duplicator, omega (18,), delta (3,), Phi (3,3).
+
+    ``record_traj=True`` additionally returns the per-step (Z_next, β_obs)
+    trajectory for the closed-form (δ, Φ) parity check (same contract as
+    :func:`msed_lambda_filter`)."""
     A = params_struct["A"]
     B = params_struct["B"]
     omega = params_struct["omega"]
@@ -324,6 +348,8 @@ def msed_neural_filter(params_struct, maturities, data, transform_bool,
 
     N, T = data.shape
     preds = np.zeros((N, T))
+    Z_traj = np.zeros((T, N, 3))
+    b_traj = np.zeros((T, 3))
     for t in range(T):
         y = data[:, t]
         if np.isnan(y[0]):
@@ -347,8 +373,12 @@ def msed_neural_filter(params_struct, maturities, data, transform_bool,
         if B is not None:
             gamma = nu + B * gamma
             Z = neural_loadings(gamma, maturities, transform_bool)
+        Z_traj[t] = Z
+        b_traj[t] = beta
         beta = mu + Phi @ beta
         preds[:, t] = Z @ beta
+    if record_traj:
+        return preds, {"Z_next": Z_traj, "beta_obs": b_traj}
     return preds
 
 
@@ -381,24 +411,12 @@ def static_filter(gamma_Z, delta, Phi, data):
 def static_closed_delta_phi(Z, data):
     """Independent NumPy solve of the (δ, Φ) block optimum for a static
     model with fixed loadings Z on fully-observed data — the oracle for the
-    static branch of ``optimize._jitted_group_opt_msed_closed`` (CLAUDE.md
-    parity rule; the MSED branch's oracle is
-    :func:`msed_lambda_closed_delta_phi`).  β̄_t is per-column OLS; the
-    objective Σₜ ‖y_{t+1} − Z(μ + Φ β̄_t)‖² is exactly quadratic in
-    θ = (μ, vec_rowmajor Φ)."""
-    N, T = data.shape
-    rows, rhs = [], []
-    for t in range(T - 1):
-        b = _ols(Z, data[:, t])
-        D = np.concatenate([Z, np.einsum("nm,k->nmk", Z, b).reshape(N, 9)], 1)
-        rows.append(D)
-        rhs.append(data[:, t + 1])
-    D = np.concatenate(rows, axis=0)
-    y = np.concatenate(rhs, axis=0)
-    theta, *_ = np.linalg.lstsq(D, y, rcond=None)
-    mu, Phi = theta[:3], theta[3:].reshape(3, 3)
-    delta = np.linalg.solve(np.eye(3) - Phi, mu)
-    return delta, Phi
+    static branch of ``optimize._jitted_group_opt_msed_closed`` (β̄_t is
+    per-column OLS; Z constant ⇒ same quadratic structure)."""
+    T = data.shape[1]
+    traj = {"Z_next": np.broadcast_to(Z, (T,) + Z.shape),
+            "beta_obs": np.stack([_ols(Z, data[:, t]) for t in range(T)])}
+    return closed_delta_phi_from_traj(traj, data)
 
 
 # ---------------------------------------------------------------------------

@@ -362,3 +362,38 @@ def test_estimate_steps_ssd_guard_falls_back_on_kernel_disagreement(
     # is untouched (no process-global env mutation)
     assert os.environ["YFM_SSD_PALLAS"] == "force"
 
+
+
+def test_neural_closed_form_matches_numpy_oracle(maturities, yields_panel):
+    """Flagship-path parity (CLAUDE.md rule): the closed-form (δ, Φ) solve
+    for 1SSD-NNS — the exact model the config-6 device race runs — must
+    agree with the independent NumPy oracle (per-step FD-score filter loop +
+    lstsq normal equations).  The oracle's finite-difference inner score
+    tracks the library's AD score to ~1e-6 (test_score_driven parity), so
+    the solved block matches to the same order."""
+    from tests import oracle
+    from yieldfactormodels_jl_tpu.models.params import (transform_params,
+                                                        untransform_params)
+
+    spec, _ = create_model("1SSD-NNS", tuple(maturities), float_type="float64")
+    cons = _sd_point(spec, np.random.default_rng(5))
+    lo_d, hi_d = spec.layout["delta"]
+    lo_p, hi_p = spec.layout["phi"]
+    cons[lo_d:hi_p] *= 0.8
+    raw = jnp.asarray(np.asarray(untransform_params(spec, jnp.asarray(cons))))
+
+    T = yields_panel.shape[1]
+    runner = opt._jitted_group_opt_msed_closed(spec, T)
+    X_new, _ = runner(raw[None, :], jnp.asarray(yields_panel),
+                      jnp.asarray(0), jnp.asarray(T))
+    got = np.asarray(transform_params(spec, jnp.asarray(X_new)[0]))
+
+    struct = oracle.neural_struct_from_flat(cons)
+    _, traj = oracle.msed_neural_filter(
+        struct, maturities, yields_panel, transform_bool=True,
+        scale_grad=True, forget_factor=spec.forget_factor, record_traj=True)
+    want_delta, want_Phi = oracle.closed_delta_phi_from_traj(traj,
+                                                             yields_panel)
+    np.testing.assert_allclose(got[lo_d:hi_d], want_delta, rtol=2e-5)
+    np.testing.assert_allclose(got[lo_p:hi_p].reshape(3, 3).T, want_Phi,
+                               rtol=2e-5, atol=1e-7)

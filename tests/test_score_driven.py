@@ -158,9 +158,7 @@ def _neural_params(spec, rng, random_walk=False):
     vals.extend(Phi.T.reshape(-1))
     p = np.asarray(vals)
     assert p.shape[0] == spec.n_params
-    expand = lambda u: np.concatenate([np.full(9, u[0]), np.full(9, u[1])])
-    struct = {"A": expand(a_u), "B": None if random_walk else expand(b_u),
-              "omega": omega, "delta": delta, "Phi": Phi}
+    struct = oracle.neural_struct_from_flat(p, random_walk=random_walk)
     return p, struct
 
 
