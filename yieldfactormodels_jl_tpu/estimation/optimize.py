@@ -566,7 +566,13 @@ def _msed_closed_applicable(spec: ModelSpec, inds, data, start, end) -> bool:
         s, e = int(start), int(end)
     except TypeError:
         return False
-    return bool(np.isfinite(np.asarray(data)[:, s:e]).all())
+    dnp = np.asarray(data)
+    # the stacked design needs at least as many rows as unknowns or the
+    # reduced QR's R is non-square (trace-time shape error); tiny windows
+    # below that are degenerate for the block anyway
+    if (dnp.shape[1] - 1) * dnp.shape[0] < spec.M + spec.M * spec.M:
+        return False
+    return bool(np.isfinite(dnp[:, s:e]).all())
 
 
 @register_engine_cache
@@ -583,7 +589,7 @@ def _jitted_group_opt_msed_closed(spec: ModelSpec, T: int):
     −‖y_{t+1} − Z_{t+1}(μ + Φ β̄_t)‖² with Z_{t+1}, β̄_t, y_{t+1} all
     constants w.r.t. the block: the sub-objective is EXACTLY quadratic in
     (μ, vec Φ), a 12-dim linear least squares.  One trajectory pass + one
-    12×12 solve replaces hundreds of 2nd-order-AD filter passes (the
+    12-unknown QR solve replaces hundreds of 2nd-order-AD filter passes (the
     ~131 ms/pass device latency wall behind BASELINE.md config 6's 0.12×).
     The static families (filter.jl:93-110) share the structure with a
     CONSTANT Z — handled by the same runner without a scan.
@@ -592,7 +598,7 @@ def _jitted_group_opt_msed_closed(spec: ModelSpec, T: int):
     (−1, 1) image of the R_TO_11 bijection.  The candidate is accepted only
     if it improves the full objective (evaluated by the scan engine), so
     block-coordinate monotonicity is preserved unconditionally — clipping,
-    f32 normal-equation rounding, or a singular (I − Φ) degrade to a no-op,
+    f32 rounding in the QR solve, or a singular (I − Φ) degrade to a no-op,
     never to corruption.
     """
     from ..models import score_driven as SD
@@ -630,14 +636,21 @@ def _jitted_group_opt_msed_closed(spec: ModelSpec, T: int):
         # and silently no-op the solve forever (same rule as
         # window_contributions, models/common.py)
         keep = contrib[:, None, None] > 0
-        Dm = jnp.where(keep, D, 0.0)
-        ym = jnp.where(keep[:, :, 0], y1, 0.0)
-        G = jnp.einsum("tnp,tnq->pq", Dm, Dm, precision=P_HI)
-        b = jnp.einsum("tnp,tn->p", Dm, ym, precision=P_HI)
-        theta = jnp.linalg.solve(G, b)
+        Dm = jnp.where(keep, D, 0.0).reshape(-1, M + M * M)
+        ym = jnp.where(keep[:, :, 0], y1, 0.0).reshape(-1)
+        # solve the stacked LLS by QR, not normal equations: the device path
+        # is f32 and κ(DᵀD) = κ(D)² would eat the mantissa exactly where the
+        # accept-guard turns a noisy solve into a silent group-2 no-op
+        # (masked-out zero rows contribute nothing to R or Qᵀy)
+        Q, R = jnp.linalg.qr(Dm)
+        qty = jnp.einsum("np,n->p", Q, ym, precision=P_HI)
+        theta = jax.scipy.linalg.solve_triangular(R, qty, lower=False)
+        # ridge fallback for a rank-deficient design (NaN/Inf pivots)
+        G = jnp.einsum("np,nq->pq", Dm, Dm, precision=P_HI)
         lam = 1e-8 * jnp.trace(G) / G.shape[0]
         theta_r = jnp.linalg.solve(
-            G + lam * jnp.eye(G.shape[0], dtype=G.dtype), b)
+            G + lam * jnp.eye(G.shape[0], dtype=G.dtype),
+            jnp.einsum("np,n->p", Dm, ym, precision=P_HI))
         theta = jnp.where(jnp.all(jnp.isfinite(theta)), theta, theta_r)
         mu = theta[:M]
         Phi = theta[M:].reshape(M, M)
@@ -735,7 +748,7 @@ def estimate_steps(spec: ModelSpec, data, all_params, param_groups: Sequence[str
             if not inds:
                 continue
             if closed_ok[g]:
-                # exact block optimum in one trajectory pass + 12×12 solve
+                # exact block optimum in one trajectory pass + QR solve
                 # (see _jitted_group_opt_msed_closed) — strictly dominates
                 # any iterative minimizer of the same sub-objective, and the
                 # accept-if-improved guard keeps descent monotone regardless
