@@ -1,19 +1,21 @@
 """Merge a device-side measurement log into benchmarks/results.json.
 
-The round-3 probe loop (BASELINE.md "TPU availability" note) runs
-``run_all.py --side device`` for all six configs when the relay recovers
+The recovery loop (BASELINE.md "TPU availability" note) runs
+``run_all.py --side device`` for the configs when the relay recovers
 and appends the JSON lines to its log.  This script folds those lines into
 ``results.json`` as COHERENT pairs against the round's clean CPU walls, so
 the whole device sequence needs no manual bookkeeping:
 
-    python benchmarks/merge_device.py /tmp/r3/probe_loop.log
+    python benchmarks/merge_device.py /tmp/r4/probe_loop.log
 
 CPU walls of record (measured this round / carried where the kernel is
-unchanged — see BASELINE.md round-3 section):
+unchanged — see BASELINE.md round-4 section):
   dns3-mle 4.252 (r2, code unchanged), afns5-mle64 648.665 (r2),
   afns5-sv-pf 307.3 (r2 lane-major re-measure), rolling-240 442.936 (r2),
-  bootstrap-2000 0.957 (r2 MXU-fused re-measure), ssd-nns-m3 177.803 (r3
-  clean window).
+  bootstrap-2000 0.957 (r2 MXU-fused; r4 re-measure 1.014 agrees),
+  ssd-nns-m3 199.614 (r4 HEAD — the closed-form group-2 code the device
+  runs; the r3 177.803 paired the OLD iterative code),
+  bootstrap-xl 15.917 (r4).
 """
 
 from __future__ import annotations
@@ -30,7 +32,8 @@ CPU_WALLS = {
     "afns5-sv-pf": 307.3,
     "rolling-240": 442.936,
     "bootstrap-2000": 0.957,
-    "ssd-nns-m3": 177.803,
+    "ssd-nns-m3": 199.614,
+    "bootstrap-xl": 15.917,
 }
 
 
@@ -95,4 +98,4 @@ def main(log_path: str) -> None:
 
 
 if __name__ == "__main__":
-    main(sys.argv[1] if len(sys.argv) > 1 else "/tmp/r3/probe_loop.log")
+    main(sys.argv[1] if len(sys.argv) > 1 else "/tmp/r4/probe_loop.log")
