@@ -397,3 +397,30 @@ def test_neural_closed_form_matches_numpy_oracle(maturities, yields_panel):
     np.testing.assert_allclose(got[lo_d:hi_d], want_delta, rtol=2e-5)
     np.testing.assert_allclose(got[lo_p:hi_p].reshape(3, 3).T, want_Phi,
                                rtol=2e-5, atol=1e-7)
+
+
+def test_closed_form_mixed_lanes_are_independent(maturities, yields_panel, rng):
+    """vmap edge: a garbage lane (non-stationary Φ ⇒ penalty objective) must
+    not perturb a healthy lane's closed-form solution, and must itself come
+    back unchanged (accept-guard refuses per lane)."""
+    from yieldfactormodels_jl_tpu.models.params import untransform_params
+
+    spec, _ = create_model("SD-NS", tuple(maturities), float_type="float64")
+    cons = _sd_point(spec, rng)
+    lo_d, _ = spec.layout["delta"]
+    _, hi_p = spec.layout["phi"]
+    cons[lo_d:hi_p] *= 0.8
+    raw_ok = np.asarray(untransform_params(spec, jnp.asarray(cons)))
+    raw_bad = raw_ok.copy()
+    raw_bad[hi_p - 9:hi_p] = 50.0  # Φ far outside stationarity in raw space
+
+    T = yields_panel.shape[1]
+    runner = opt._jitted_group_opt_msed_closed(spec, T)
+    X2, f2 = runner(jnp.asarray(np.stack([raw_ok, raw_bad])),
+                    jnp.asarray(yields_panel), jnp.asarray(0), jnp.asarray(T))
+    X1, f1 = runner(jnp.asarray(raw_ok)[None], jnp.asarray(yields_panel),
+                    jnp.asarray(0), jnp.asarray(T))
+    # healthy lane identical whether or not a garbage lane rides along
+    np.testing.assert_allclose(np.asarray(X2)[0], np.asarray(X1)[0],
+                               rtol=1e-12)
+    np.testing.assert_allclose(float(f2[0]), float(f1[0]), rtol=1e-12)
