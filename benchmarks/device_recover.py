@@ -15,7 +15,7 @@ an unbounded wait.  Strictly one client at a time: the loop is sequential and
 nothing else in the session may open a TPU client while it runs.
 
 On the first successful probe it runs, in order (same order as VERDICT r2 #1):
-  1. run_all.py --side device --configs all   (six configs, JSON lines)
+  1. run_all.py --side device --configs all   (seven configs, JSON lines)
   2. hw_verify.py                             (on-chip kernel verification)
   3. bench.py                                 (headline JSON line)
   4. merge_device.py <log>                    (fold device walls into

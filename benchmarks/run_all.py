@@ -1,4 +1,4 @@
-"""The six BASELINE.md benchmark configs, measured device-vs-CPU.
+"""The seven BASELINE.md benchmark configs, measured device-vs-CPU.
 
 Workloads (full scale, from BASELINE.json + VERDICT r2 #3):
   1. dns3-mle        3-factor DNS, single-start MLE (LBFGS)
