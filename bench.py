@@ -319,6 +319,34 @@ def main():
     except Exception as e:  # never kill the bench line
         ssd_ctx = f"; ssd bench failed ({type(e).__name__}: {e})"
 
+    # ---- online serving microbenchmark (opt-in: BENCH_SERVING=1) ----
+    # p50/p99 update+forecast latency at the headline config through the
+    # serving layer's precompiled programs (serving/) — a context line only,
+    # the stdout JSON schema is unchanged.  Runs inside the same watchdog/
+    # CPU-fallback orchestration as everything else in main().
+    serving_ctx = ""
+    if os.environ.get("BENCH_SERVING", "0") not in ("0", ""):
+        try:
+            from yieldfactormodels_jl_tpu.serving import (YieldCurveService,
+                                                          freeze_snapshot)
+
+            reps = int(os.environ.get("BENCH_SERVING_REPS", "200"))
+            snap = freeze_snapshot(spec, dev_batch[0], dev_data)
+            svc = YieldCurveService(snap)
+            svc.warmup(horizons=(12,), batch_sizes=(1,))
+            for i in range(reps):
+                svc.update(i, dev_data[:, i % T_MONTHS])
+                svc.forecast(12)
+            s = svc.latency_summary()
+            serving_ctx = (
+                f"; serving latency ms (reps={reps}): "
+                f"update p50 {s['update']['p50'] * 1e3:.3f} / "
+                f"p99 {s['update']['p99'] * 1e3:.3f} | "
+                f"forecast-h12 p50 {s['forecast']['p50'] * 1e3:.3f} / "
+                f"p99 {s['forecast']['p99'] * 1e3:.3f}")
+        except Exception as e:  # never kill the bench line
+            serving_ctx = f"; serving bench failed ({type(e).__name__}: {e})"
+
     n_finite = int(np.isfinite(np.asarray(out)).sum())
     # the joint form runs its matmuls/Cholesky through bf16 MXU passes on TPU
     # f32, so cross-check with a loose tolerance on the finite intersection
@@ -365,7 +393,7 @@ def main():
           f"api/univariate {dev_evals_per_sec:.2f} | joint {BATCH / t_joint:.2f} "
           f"| pallas {pallas_rate} evals/s; kernels agree: joint={agree} "
           f"pallas={pallas_agree}; finite: {n_finite}/{BATCH}; "
-          f"cpu ll sample {ll_cpu:.2f}{grad_ctx}{ssd_ctx}; "
+          f"cpu ll sample {ll_cpu:.2f}{grad_ctx}{ssd_ctx}{serving_ctx}; "
           f"roofline: {flops_per_eval/1e6:.3f} MFLOP/eval -> "
           f"univariate {gflops(dev_evals_per_sec):.1f} | "
           f"joint {gflops(BATCH / t_joint):.1f} | "

@@ -602,6 +602,88 @@ def stable_ns_params(spec, dtype=np.float32):
     return p
 
 
+def online_filter(Z, d, Phi, delta, Omega_state, obs_var, data):
+    """Element-masked sequential (univariate) Kalman filter — the online
+    serving recursion (serving/online.py): per column, PREDICT (β ← δ + Φβ,
+    P ← ΦPΦᵀ + Ω) then N scalar measurement updates skipping NaN elements
+    individually (a partially-quoted curve conditions on the observed subset
+    only — the offline filter would drop the whole column).  Starts from the
+    unconditional moments; returns the FILTERED (β_{t|t}, P_{t|t}) per column
+    and per-column loglik contributions.  Straight float64 loops, no JAX."""
+    N, T = data.shape
+    beta, P = kalman_init(Phi, delta, Omega_state)
+    betas, Ps, lls = [], [], []
+    for t in range(T):
+        # predict from the previous filtered state (t=0: kalman_init moments
+        # are the transition's fixed point, so predict is a no-op — identical
+        # to the library's predicted-state start)
+        beta = delta + Phi @ beta
+        P = Phi @ P @ Phi.T + Omega_state
+        ll = 0.0
+        for i in range(N):
+            y_i = data[i, t]
+            if np.isnan(y_i):
+                continue
+            z = Z[i]
+            zP = z @ P
+            f = zP @ z + obs_var
+            v = (y_i - d[i]) - z @ beta
+            K = zP / f
+            beta = beta + K * v
+            P = P - np.outer(K, zP)
+            ll -= 0.5 * (np.log(f) + v * v / f + LOG_2PI)
+        betas.append(beta.copy())
+        Ps.append(P.copy())
+        lls.append(ll)
+    return np.asarray(betas), np.asarray(Ps), np.asarray(lls)
+
+
+def online_filter_tvl(Phi, delta, Omega_state, obs_var, maturities, data,
+                      exact_jacobian=False):
+    """Element-masked sequential TVλ EKF — the online serving recursion for
+    the ``kalman_tvl`` family (serving/online.py): per column, PREDICT, then
+    linearize ONCE at β_pred (λ = 1e-2 + e^{β₄}, Jacobian column as
+    kalman/filter.jl:38-46) and form the fixed-linearization effective
+    observation y_eff = y + jac·β₄_pred; the N scalar updates then move β
+    against that frozen (Z, y_eff) pair, skipping NaN elements individually.
+    Straight float64 loops, no JAX."""
+    N, T = data.shape
+    beta, P = kalman_init(Phi, delta, Omega_state)
+    betas, Ps, lls = [], [], []
+    for t in range(T):
+        beta = delta + Phi @ beta
+        P = Phi @ P @ Phi.T + Omega_state
+        lam = LAMBDA_FLOOR + np.exp(beta[3])
+        tau = lam * maturities
+        z = np.exp(-tau)
+        z2 = (1 - z) / tau
+        z3 = z2 - z
+        dlam = lam - LAMBDA_FLOOR
+        if exact_jacobian:
+            dz2 = z / lam - (1 - z) / (lam * lam * maturities)
+        else:
+            dz2 = z / lam - z / (lam * lam * maturities)
+        jac = ((beta[1] + beta[2]) * dz2 + beta[2] * maturities * z) * dlam
+        Zd = np.column_stack([np.ones(N), z2, z3, jac])
+        y_eff = data[:, t] + jac * beta[3]  # fixed-linearization offset
+        ll = 0.0
+        for i in range(N):
+            if np.isnan(data[i, t]):
+                continue
+            zi = Zd[i]
+            zP = zi @ P
+            f = zP @ zi + obs_var
+            v = y_eff[i] - zi @ beta
+            K = zP / f
+            beta = beta + K * v
+            P = P - np.outer(K, zP)
+            ll -= 0.5 * (np.log(f) + v * v / f + LOG_2PI)
+        betas.append(beta.copy())
+        Ps.append(P.copy())
+        lls.append(ll)
+    return np.asarray(betas), np.asarray(Ps), np.asarray(lls)
+
+
 def rts_smoother(Z, Phi, delta, Omega_state, obs_var, data):
     """Forward KF (library scan conventions: one step per column, masked
     update on NaN columns) + RTS backward pass.  Returns (beta_smooth (T, Ms),

@@ -1,0 +1,40 @@
+"""Guard: every ``YFM_*`` engine env knob referenced anywhere in source is
+documented in CLAUDE.md's Conventions (an undocumented knob is a silent
+behavior switch the next session can't discover) — grep-based, fails loudly
+on the first undocumented name."""
+
+import os
+import re
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+KNOB = re.compile(r"\bYFM_[A-Z0-9_]+\b")
+
+
+def _source_files():
+    for dirpath, _, names in os.walk(
+            os.path.join(ROOT, "yieldfactormodels_jl_tpu")):
+        for name in names:
+            if name.endswith(".py"):
+                yield os.path.join(dirpath, name)
+    yield os.path.join(ROOT, "bench.py")
+    bench_dir = os.path.join(ROOT, "benchmarks")
+    for name in os.listdir(bench_dir):
+        if name.endswith(".py"):
+            yield os.path.join(bench_dir, name)
+
+
+def test_every_yfm_knob_is_documented_in_claude_md():
+    knobs = set()
+    for path in _source_files():
+        with open(path) as fh:
+            knobs |= set(KNOB.findall(fh.read()))
+    # vacuity guard: the knobs this repo is known to ship; if the grep rots
+    # and finds nothing, fail instead of green-lighting
+    assert {"YFM_SSD_PALLAS", "YFM_FUSED_CHECK", "YFM_MSED_CLOSED",
+            "YFM_PF_PALLAS"} <= knobs, f"grep drifted: found only {knobs}"
+    with open(os.path.join(ROOT, "CLAUDE.md")) as fh:
+        doc = fh.read()
+    undocumented = sorted(k for k in knobs if k not in doc)
+    assert not undocumented, (
+        f"undocumented YFM_* env knobs: {undocumented} — add them to the "
+        f"'Engine env knobs' bullet in CLAUDE.md's Conventions")

@@ -108,3 +108,26 @@ def test_legacy_export_matches_hand_derived_fixture(tmp_path):
         "NS__thread_id__1__expanding_window_forecasts.csv")
     assert paths["fitted_params"].endswith(
         "NS__thread_id__1__expanding_window_fitted_params.csv")
+
+
+def test_read_all_task_params_roundtrip(tmp_path):
+    """Bulk snapshot-loading read (one query, one deser pass) returns exactly
+    what the per-task reads return — and params survive unrounded."""
+    base = os.path.join(str(tmp_path), "db", "forecasts_expanding.sqlite3")
+    dummy = np.zeros((1, 2))
+    results = _results(dummy, dummy, dummy, dummy, dummy)
+    params = {3: np.array([0.123456789, -1.0, 42.0]),
+              9: np.array([7.5, 0.000123456, -0.25])}
+    for task, p in params.items():
+        db.save_oos_forecast_sharded(base, "NS", "1", "expanding", task,
+                                     results, loss=-1.0, params=p,
+                                     forecast_horizon=1)
+    merged = db.merge_forecast_shards(base, task_ids=sorted(params))
+
+    got = db.read_all_task_params(merged)
+    assert sorted(got) == [3, 9]
+    for task, p in params.items():
+        np.testing.assert_array_equal(got[task], p)  # NOT rounded (ser/deser)
+        np.testing.assert_array_equal(got[task],
+                                      db.read_task_params(merged, task))
+    assert db.read_all_task_params(os.path.join(str(tmp_path), "nope.sqlite3")) == {}

@@ -17,6 +17,56 @@ def test_stage_timer_accumulates():
     assert t.mean("never") == 0.0
 
 
+def test_summary_percentiles_nearest_rank():
+    """summary()/to_json() — deterministic via record(): nearest-rank p50/p99
+    so serving latency percentiles can land in the BENCH ledger."""
+    t = StageTimer()
+    for ms in range(1, 101):          # 0.001 .. 0.100 s
+        t.record("update", ms / 1000.0)
+    t.record("forecast", 0.5)
+    s = t.summary()
+    assert s["update"]["count"] == 100
+    assert abs(s["update"]["p50"] - 0.050) < 1e-12   # ⌈0.5·100⌉ = 50th
+    assert abs(s["update"]["p99"] - 0.099) < 1e-12   # ⌈0.99·100⌉ = 99th
+    assert abs(s["update"]["max"] - 0.100) < 1e-12
+    assert abs(s["update"]["mean"] - 0.0505) < 1e-12
+    # single sample: every percentile is that sample
+    assert s["forecast"]["p50"] == s["forecast"]["p99"] == 0.5
+
+    import json
+
+    j = json.loads(t.to_json(config="headline"))
+    assert j["config"] == "headline"
+    assert j["stages"]["update"]["count"] == 100
+
+    # stage() feeds the same sample store as record()
+    with t.stage("est"):
+        time.sleep(0.001)
+    assert t.summary()["est"]["count"] == 1
+    assert t.summary()["est"]["p50"] > 0.0
+
+
+def test_sample_window_is_bounded_but_totals_exact():
+    """Percentiles ride a bounded sliding window (long-lived serving
+    process); count/total/mean stay exact over the full history."""
+    t = StageTimer(max_samples=4)
+    for ms in range(1, 11):
+        t.record("u", ms / 1000.0)
+    s = t.summary()
+    assert s["u"]["count"] == 10
+    assert abs(s["u"]["total"] - 0.055) < 1e-12
+    assert len(t.samples["u"]) == 4          # only the last 4 retained
+    assert abs(s["u"]["p50"] - 0.008) < 1e-12  # window = 7,8,9,10 ms
+
+
+def test_summary_empty_timer():
+    t = StageTimer()
+    assert t.summary() == {}
+    import json
+
+    assert json.loads(t.to_json()) == {"stages": {}}
+
+
 def test_device_trace_noop_and_annotation():
     with device_trace(None):  # no logdir -> must be a pure no-op
         x = 1

@@ -1,0 +1,514 @@
+"""Online serving layer: snapshots, recursive updates, bucketed batching.
+
+Fast-tier coverage of serving/ (acceptance: the end-to-end flow below runs
+on CPU, state parity vs a from-scratch re-filter at 1e-6 against the f64
+NumPy oracle, and the no-recompile bucket bound holds):
+
+- merged-DB fixture → load_snapshot → 5 online updates (one partially-NaN
+  curve) → forecast h=12, with oracle parity for the filtered state,
+- 50 mixed-shape requests compile at most ``lattice.n_programs`` programs
+  (trace counters incremented inside the traced bodies),
+- ``config.set_kalman_engine`` invalidates the serving caches (the
+  tests/test_engines.py pattern extended to the serving builders).
+"""
+
+import dataclasses
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import yieldfactormodels_jl_tpu as yfm
+from tests import oracle
+from yieldfactormodels_jl_tpu import serving
+from yieldfactormodels_jl_tpu.models.params import unpack_kalman
+from yieldfactormodels_jl_tpu.ops.smoother import forward_moments
+from yieldfactormodels_jl_tpu.persistence import database as db
+from yieldfactormodels_jl_tpu.serving import batcher as sb
+from yieldfactormodels_jl_tpu.serving import online as so
+
+MATS = tuple(np.array([3, 12, 24, 60, 120, 240, 360]) / 12.0)
+T_PANEL = 40
+T_ORIGIN = 34  # snapshot origin: columns 0..33 conditioned, 34..39 arrive live
+
+
+@pytest.fixture(scope="module")
+def dns_setup():
+    rng = np.random.default_rng(7)
+    spec, _ = yfm.create_model("1C", MATS, float_type="float64")
+    p = oracle.stable_1c_params(spec, np.float64)
+    data = oracle.simulate_dns_panel(rng, np.asarray(MATS), T=T_PANEL)
+    return spec, p, data
+
+
+@pytest.fixture()
+def merged_db(tmp_path, dns_setup):
+    """A merged forecast DB holding fitted params for two tasks — the
+    artifact the rolling-forecast pipeline leaves behind."""
+    spec, p, data = dns_setup
+    base = os.path.join(str(tmp_path), "db", "forecasts_expanding.sqlite3")
+    dummy = np.zeros((2, 3))
+    results = {k: dummy for k in ("preds", "factors", "states",
+                                  "factor_loadings_1", "factor_loadings_2")}
+    for task in (T_ORIGIN, T_ORIGIN - 2):
+        db.save_oos_forecast_sharded(base, spec.model_string, "1", "expanding",
+                                     task, results, loss=-1.0, params=p,
+                                     forecast_horizon=2)
+    return db.merge_forecast_shards(base, task_ids=[T_ORIGIN, T_ORIGIN - 2])
+
+
+def _live_curves(data):
+    """The five post-origin curves; the third is partially quoted."""
+    curves = [data[:, t].copy() for t in range(T_ORIGIN, T_ORIGIN + 5)]
+    curves[2][1] = np.nan
+    curves[2][4] = np.nan
+    return curves
+
+
+def _oracle_state(spec, p, data, curves):
+    """From-scratch f64 re-filter (predict → element-masked update) over the
+    conditioning sample plus the live curves; returns final (β, P)."""
+    kp = unpack_kalman(spec, jnp.asarray(p, dtype=jnp.float64))
+    Z = np.asarray(oracle.dns_loadings(float(np.asarray(kp.gamma)[0]),
+                                       np.asarray(MATS)))
+    panel = np.concatenate([data[:, :T_ORIGIN], np.stack(curves, axis=1)],
+                           axis=1)
+    betas, Ps, _ = oracle.online_filter(
+        Z, np.zeros(spec.N), np.asarray(kp.Phi), np.asarray(kp.delta),
+        np.asarray(kp.Omega_state), float(kp.obs_var), panel)
+    return betas[-1], Ps[-1]
+
+
+# ---------------------------------------------------------------------------
+# acceptance flow: merged DB → snapshot → updates (one partial) → forecast
+# ---------------------------------------------------------------------------
+
+def test_service_end_to_end_oracle_parity(dns_setup, merged_db):
+    spec, p, data = dns_setup
+    snap = serving.load_snapshot(merged_db, spec, T_ORIGIN, data)
+    assert snap.meta.task_id == T_ORIGIN and snap.meta.n_obs == T_ORIGIN
+    svc = serving.YieldCurveService(snap)
+    # BOTH online engines ride the same 5 curves (incl. the partial one), so
+    # the element-masked Potter update is pinned to the NumPy oracle too —
+    # never to another JAX path alone (CLAUDE.md parity rule)
+    svc_sqrt = serving.YieldCurveService(
+        serving.load_snapshot(merged_db, spec, T_ORIGIN, data), engine="sqrt")
+
+    curves = _live_curves(data)
+    for k, y in enumerate(curves):
+        ll = svc.update(date=T_ORIGIN + k, yields=y)
+        assert np.isfinite(ll)
+        np.testing.assert_allclose(svc_sqrt.update(T_ORIGIN + k, y), ll,
+                                   rtol=1e-9)
+    assert svc.version == 5 and svc.snapshot.meta.n_updates == 5
+
+    beta_ref, P_ref = _oracle_state(spec, p, data, curves)
+    np.testing.assert_allclose(np.asarray(svc.snapshot.beta), beta_ref,
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(svc.snapshot.P), P_ref, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(svc_sqrt.snapshot.beta), beta_ref,
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(svc_sqrt.snapshot.P), P_ref,
+                               atol=1e-6)
+
+    # h=12 forecast from the online state == oracle propagation of (β, P)
+    fc = svc.forecast(12, quantiles=(0.1, 0.9))
+    kp = unpack_kalman(spec, jnp.asarray(p, dtype=jnp.float64))
+    Z = np.asarray(oracle.dns_loadings(float(np.asarray(kp.gamma)[0]),
+                                       np.asarray(MATS)))
+    Phi, delta = np.asarray(kp.Phi), np.asarray(kp.delta)
+    Om, ov = np.asarray(kp.Omega_state), float(kp.obs_var)
+    b, P = beta_ref.copy(), P_ref.copy()
+    for h in range(12):
+        b = delta + Phi @ b
+        P = Phi @ P @ Phi.T + Om
+        np.testing.assert_allclose(fc["means"][h], Z @ b, atol=1e-6)
+        np.testing.assert_allclose(fc["covs"][h],
+                                   Z @ P @ Z.T + ov * np.eye(spec.N),
+                                   atol=1e-6)
+    # quantiles bracket the mean and are ordered
+    assert np.all(fc["quantiles"][0.1] < fc["means"])
+    assert np.all(fc["means"] < fc["quantiles"][0.9])
+
+    # stage latencies recorded for the ledger
+    s = svc.latency_summary()
+    assert s["update"]["count"] == 5 and s["forecast"]["count"] == 1
+    assert s["update"]["p99"] >= s["update"]["p50"] > 0.0
+
+
+def test_online_matches_library_refilter_and_sqrt_engine(dns_setup):
+    """All-finite updates: the online chain continues the library filter
+    exactly (f64, 1e-9), and the sqrt engine tracks it to 1e-6."""
+    spec, p, data = dns_setup
+    snap = serving.freeze_snapshot(spec, p, data, end=T_ORIGIN)
+    services = {e: serving.YieldCurveService(
+        serving.freeze_snapshot(spec, p, data, end=T_ORIGIN), engine=e)
+        for e in serving.ONLINE_ENGINES}
+    del snap
+    for t in range(T_ORIGIN, T_PANEL):
+        for svc in services.values():
+            svc.update(t, data[:, t])
+    _, outs = forward_moments(spec, jnp.asarray(p, dtype=jnp.float64),
+                              jnp.asarray(data), 0, T_PANEL, "univariate")
+    beta_ref = np.asarray(outs["beta_upd"][-1])
+    P_ref = np.asarray(outs["P_upd"][-1])
+    np.testing.assert_allclose(np.asarray(services["univariate"].snapshot.beta),
+                               beta_ref, atol=1e-9)
+    np.testing.assert_allclose(np.asarray(services["univariate"].snapshot.P),
+                               P_ref, atol=1e-9)
+    np.testing.assert_allclose(np.asarray(services["sqrt"].snapshot.beta),
+                               beta_ref, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(services["sqrt"].snapshot.P),
+                               P_ref, atol=1e-6)
+
+
+@pytest.mark.parametrize("k", [4, 3])  # exact bucket and padded (3 → kb 4)
+def test_update_k_equals_repeated_single_steps(dns_setup, k):
+    """The k-bucketed catch-up program equals k single steps exactly —
+    including when k pads up to the next K_BUCKET (padded steps must be
+    true no-ops, not extra transitions)."""
+    spec, p, data = dns_setup
+    snap = serving.freeze_snapshot(spec, p, data, end=T_ORIGIN)
+    params = jnp.asarray(p, dtype=jnp.float64)
+    st = serving.OnlineState(snap.beta, snap.P)
+    Y = data[:, T_ORIGIN:T_ORIGIN + k]
+    st_k, lls, oks = serving.update_k(spec, params, st, Y)
+    assert lls.shape == (k,) and bool(np.asarray(oks).all())
+    st_1 = st
+    for j in range(k):
+        st_1, ll1, _ = serving.update(spec, params, st_1, Y[:, j])
+        np.testing.assert_allclose(float(lls[j]), float(ll1), rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(st_k.beta), np.asarray(st_1.beta),
+                               rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(st_k.cov), np.asarray(st_1.cov),
+                               rtol=1e-12, atol=1e-15)
+
+
+@pytest.mark.parametrize("exact_jac", [False, True])
+def test_online_tvl_matches_oracle(exact_jac):
+    """The ``kalman_tvl`` branch of the online update (EKF: linearize ONCE at
+    β_pred, fixed-linearization effective observation) is pinned to the
+    independent NumPy oracle — never to another JAX path alone (CLAUDE.md
+    parity rule).  Both online engines, both Jacobian variants, and the
+    element-masked partial curve ride the same 5 live updates."""
+    rng = np.random.default_rng(11)
+    spec, _ = yfm.create_model("TVλ", MATS, float_type="float64")
+    spec = dataclasses.replace(spec, exact_jacobian=exact_jac)
+    p = oracle.stable_tvl_params(spec)
+    data = oracle.simulate_dns_panel(rng, np.asarray(MATS), T=T_PANEL)
+    curves = _live_curves(data)
+
+    services = {e: serving.YieldCurveService(
+        serving.freeze_snapshot(spec, p, data, end=T_ORIGIN), engine=e)
+        for e in serving.ONLINE_ENGINES}
+    lls = {e: [svc.update(T_ORIGIN + k, y) for k, y in enumerate(curves)]
+           for e, svc in services.items()}
+
+    kp = unpack_kalman(spec, jnp.asarray(p, dtype=jnp.float64))
+    panel = np.concatenate([data[:, :T_ORIGIN], np.stack(curves, axis=1)],
+                           axis=1)
+    betas, Ps, lls_ref = oracle.online_filter_tvl(
+        np.asarray(kp.Phi), np.asarray(kp.delta), np.asarray(kp.Omega_state),
+        float(kp.obs_var), np.asarray(MATS), panel, exact_jacobian=exact_jac)
+    for e, svc in services.items():
+        np.testing.assert_allclose(np.asarray(svc.snapshot.beta), betas[-1],
+                                   atol=1e-6, err_msg=e)
+        np.testing.assert_allclose(np.asarray(svc.snapshot.P), Ps[-1],
+                                   atol=1e-6, err_msg=e)
+        np.testing.assert_allclose(lls[e], lls_ref[T_ORIGIN:], rtol=1e-6,
+                                   atol=1e-9, err_msg=e)
+
+
+def test_update_k_bucket_shares_programs(dns_setup):
+    """Distinct gap lengths within one K_BUCKET share one compiled program."""
+    spec, p, data = dns_setup
+    snap = serving.freeze_snapshot(spec, p, data, end=T_ORIGIN)
+    params = jnp.asarray(p, dtype=jnp.float64)
+    st = serving.OnlineState(snap.beta, snap.P)
+    serving.reset_trace_counts()
+    for k in (5, 6, 7, 8):  # all bucket to kb=8
+        serving.update_k(spec, params, st, data[:, T_ORIGIN:T_ORIGIN + k])
+    assert serving.trace_counts["update_k"] <= 1, \
+        dict(serving.trace_counts)
+
+
+def test_update_failure_is_structured_error_and_rolls_back(dns_setup):
+    """Non-PD innovation chain → NaN sentinel inside the kernel → structured
+    ServingError at the driver, with the last good snapshot retained."""
+    spec, p, data = dns_setup
+    bad = np.asarray(p, dtype=np.float64).copy()
+    bad[spec.layout["obs_var"][0]] = -10.0  # f = zPz + σ² < 0 ⇒ ok=False
+    snap = serving.freeze_snapshot(spec, p, data, end=T_ORIGIN)
+    svc = serving.YieldCurveService(dataclasses.replace(
+        snap, params=jnp.asarray(bad)))
+    v0, beta0 = svc.version, np.asarray(svc.snapshot.beta).copy()
+    with pytest.raises(serving.ServingError) as ei:
+        svc.update(0, data[:, T_ORIGIN])
+    assert ei.value.stage == "update" and ei.value.context["version"] == v0
+    assert svc.version == v0  # rolled back: no NaN state escapes the driver
+    np.testing.assert_array_equal(np.asarray(svc.snapshot.beta), beta0)
+
+
+def test_freeze_failure_raises_loudly(dns_setup):
+    spec, p, data = dns_setup
+    bad = np.asarray(p, dtype=np.float64).copy()
+    lo, hi = spec.layout["phi"]
+    bad[lo:hi] = (1.05 * np.eye(spec.state_dim)).reshape(-1)  # explosive
+    with pytest.raises(serving.ServingError):
+        serving.freeze_snapshot(spec, bad, data, engine="joint")
+
+
+def test_registry_bulk_load_one_query(dns_setup, merged_db):
+    spec, p, data = dns_setup
+    params_by_task = db.read_all_task_params(merged_db)
+    assert sorted(params_by_task) == [T_ORIGIN - 2, T_ORIGIN]
+    for task_id, params in params_by_task.items():
+        np.testing.assert_array_equal(params,
+                                      db.read_task_params(merged_db, task_id))
+    reg = serving.SnapshotRegistry()
+    keys = reg.load_all(merged_db, spec, data)
+    assert len(reg) == 2 and keys == reg.keys()
+    s1 = reg.get(spec.model_string, T_ORIGIN)
+    s2 = reg.get(spec.model_string, T_ORIGIN - 2)
+    assert s1.meta.n_obs == T_ORIGIN and s2.meta.n_obs == T_ORIGIN - 2
+    assert not np.allclose(np.asarray(s1.beta), np.asarray(s2.beta))
+    with pytest.raises(serving.ServingError):
+        reg.get(spec.model_string, 999)
+
+
+def test_registry_quarantines_malformed_params_row(dns_setup, merged_db):
+    """A corrupt/wrong-shape params blob must not take the bulk boot down:
+    the row is skipped with its error recorded, healthy tasks register."""
+    import sqlite3
+
+    spec, p, data = dns_setup
+    con = sqlite3.connect(merged_db)
+    con.execute(
+        "INSERT OR REPLACE INTO forecasts("
+        "model,thread,window,task_id,loss,params,preds,fl1,fl2,factors,states"
+        ") VALUES(?,?,?,?,?,?,?,?,?,?,?)",
+        (spec.model_string, "1", "expanding", 5, -1.0,
+         db.ser(np.zeros(3)),  # wrong length for this spec
+         *[db.ser(np.zeros((1, 1)))] * 5))
+    con.commit()
+    con.close()
+    reg = serving.SnapshotRegistry()
+    keys = reg.load_all(merged_db, spec, data)
+    assert len(keys) == 2 and len(reg) == 2  # the two healthy tasks
+    assert list(reg.last_errors) == [5]
+
+
+def test_shared_batcher_banks_other_submitters_results(dns_setup):
+    """A service flushing a SHARED batcher must not drop another submitter's
+    pending results — they stay banked until collected by ticket."""
+    spec, p, data = dns_setup
+    lattice = serving.BucketLattice(horizons=(4,), batch_sizes=(1, 4),
+                                    scenario_counts=(4,))
+    m = serving.MicroBatcher(lattice)
+    snap_b = serving.freeze_snapshot(spec, p, data, end=T_ORIGIN - 2)
+    svc = serving.YieldCurveService(
+        serving.freeze_snapshot(spec, p, data, end=T_ORIGIN),
+        batcher=m)
+    tb = m.submit(snap_b, serving.ForecastRequest(3))
+    fc = svc.forecast(4)          # flushes tb too
+    assert fc["means"].shape == (4, spec.N)
+    out_b = m.result(tb)          # banked, still collectible
+    assert out_b["means"].shape == (3, spec.N)
+    with pytest.raises(serving.ServingError):
+        m.result(tb)              # collect-once
+
+
+def test_failed_bucket_error_carries_request_stage(dns_setup):
+    """A failed scenario ticket surfaces as ``stage="scenarios"`` and a
+    failed forecast chunk as ``stage="forecast"`` — callers dispatch on
+    ``err.stage`` (the documented vocabulary in ServingError)."""
+    spec, p, data = dns_setup
+    lattice = serving.BucketLattice(horizons=(4,), batch_sizes=(1,),
+                                    scenario_counts=(4,))
+    m = serving.MicroBatcher(lattice)
+    snap = serving.freeze_snapshot(spec, p, data, end=T_ORIGIN)
+    bad = dataclasses.replace(snap, params=snap.params[:3])  # unpack blows up
+    ts = m.submit(bad, serving.ScenarioRequest(4, 4))
+    tf = m.submit(bad, serving.ForecastRequest(4))
+    m.flush()
+    with pytest.raises(serving.ServingError) as ei:
+        m.result(ts)
+    assert ei.value.stage == "scenarios"
+    with pytest.raises(serving.ServingError) as ei:
+        m.result(tf)
+    assert ei.value.stage == "forecast"
+
+
+def test_scenarios_match_predictive_moments(dns_setup):
+    """Scenario draws are distributed per the predictive density, pinned to
+    an independent NumPy (δ, Φ, Ω) moment recursion — never to another JAX
+    path alone (CLAUDE.md parity rule).  The served density must equal the
+    NumPy moments tightly; the MC mean matches them loosely (seeded)."""
+    spec, p, data = dns_setup
+    svc = serving.YieldCurveService(
+        serving.freeze_snapshot(spec, p, data, end=T_ORIGIN),
+        lattice=serving.BucketLattice(horizons=(4,), batch_sizes=(1,),
+                                      scenario_counts=(256,)))
+    fc = svc.forecast(4)
+    sc = svc.scenarios(n=256, h=4, seed=3)
+    assert sc["paths"].shape == (spec.N, 4, 256)
+
+    kp = unpack_kalman(spec, jnp.asarray(p, dtype=jnp.float64))
+    Z = np.asarray(oracle.dns_loadings(float(np.asarray(kp.gamma)[0]),
+                                       np.asarray(MATS)))
+    Phi, delta = np.asarray(kp.Phi), np.asarray(kp.delta)
+    Om, ov = np.asarray(kp.Omega_state), float(kp.obs_var)
+    b = np.asarray(svc.snapshot.beta, dtype=np.float64)
+    P = np.asarray(svc.snapshot.P, dtype=np.float64)
+    means, sds = [], []
+    for _ in range(4):
+        b = delta + Phi @ b
+        P = Phi @ P @ Phi.T + Om
+        means.append(Z @ b)
+        sds.append(np.sqrt(np.diag(Z @ P @ Z.T) + ov))
+    means, sds = np.stack(means), np.stack(sds)
+    np.testing.assert_allclose(fc["means"], means, rtol=1e-8, atol=1e-10)
+    mc_mean = sc["paths"].mean(axis=-1).T  # (4, N)
+    assert np.all(np.abs(mc_mean - means) < 5 * sds / np.sqrt(256) + 1e-9)
+
+
+# ---------------------------------------------------------------------------
+# no-recompile bucketing + engine-cache invalidation
+# ---------------------------------------------------------------------------
+
+def test_no_recompile_50_mixed_requests(dns_setup):
+    """50 heterogeneous requests (random horizons, scenario counts, across
+    two snapshots) trigger at most ``lattice.n_programs`` compilations."""
+    spec, p, data = dns_setup
+    lattice = serving.BucketLattice(horizons=(4, 8), batch_sizes=(1, 4),
+                                    scenario_counts=(4,))
+    snap_a = serving.freeze_snapshot(spec, p, data, end=T_ORIGIN)
+    snap_b = serving.freeze_snapshot(spec, p, data, end=T_ORIGIN - 2)
+    m = serving.MicroBatcher(lattice)
+
+    serving.reset_trace_counts()
+    rng = np.random.default_rng(0)
+    tickets = []
+    for batch in range(10):  # 10 flushes × 5 requests = 50
+        for j in range(5):
+            snap = snap_a if (batch + j) % 2 else snap_b
+            if j == 4 and batch % 3 == 0:
+                req = serving.ScenarioRequest(n=int(rng.integers(1, 5)),
+                                              horizon=int(rng.integers(1, 9)),
+                                              seed=j)
+            else:
+                req = serving.ForecastRequest(int(rng.integers(1, 9)))
+            tickets.append(m.submit(snap, req))
+        res = m.flush()
+        assert len(res) == 5
+        for r in res.values():
+            key = "means" if "means" in r else "paths"
+            assert np.all(np.isfinite(r[key]))
+    n_compiles = sum(serving.trace_counts.values())
+    assert 0 < n_compiles <= lattice.n_programs, (
+        f"{n_compiles} compilations for 50 requests exceeds the lattice "
+        f"bound {lattice.n_programs}: {dict(serving.trace_counts)}")
+
+    # the same mix again is compile-free
+    before = sum(serving.trace_counts.values())
+    for j in range(5):
+        m.submit(snap_a if j % 2 else snap_b,
+                 serving.ForecastRequest(int(rng.integers(1, 9))))
+    m.flush()
+    assert sum(serving.trace_counts.values()) == before
+
+
+def test_oversized_request_rejected_at_submit(dns_setup):
+    spec, p, data = dns_setup
+    lattice = serving.BucketLattice(horizons=(4,), batch_sizes=(1,),
+                                    scenario_counts=(4,))
+    snap = serving.freeze_snapshot(spec, p, data, end=T_ORIGIN)
+    m = serving.MicroBatcher(lattice)
+    with pytest.raises(serving.ServingError):
+        m.submit(snap, serving.ForecastRequest(5))
+    with pytest.raises(serving.ServingError):
+        m.submit(snap, serving.ScenarioRequest(n=5, horizon=4))
+    # non-positive sizes must error, not round up and return truncated junk
+    for bad in (serving.ForecastRequest(0), serving.ForecastRequest(-2),
+                serving.ScenarioRequest(n=0, horizon=4),
+                serving.ScenarioRequest(n=4, horizon=0)):
+        with pytest.raises(serving.ServingError):
+            m.submit(snap, bad)
+    assert len(m) == 0
+
+
+def test_banked_results_are_bounded(dns_setup):
+    """Orphaned tickets (submitter never collects) evict oldest-first at
+    ``max_banked`` — no unbounded growth in a long-lived process."""
+    spec, p, data = dns_setup
+    lattice = serving.BucketLattice(horizons=(4,), batch_sizes=(1, 4),
+                                    scenario_counts=(4,))
+    snap = serving.freeze_snapshot(spec, p, data, end=T_ORIGIN)
+    m = serving.MicroBatcher(lattice, max_banked=3)
+    tickets = [m.submit(snap, serving.ForecastRequest(4)) for _ in range(5)]
+    m.flush()
+    assert len(m._done) == 3
+    for t in tickets[:2]:  # evicted
+        with pytest.raises(serving.ServingError):
+            m.result(t)
+    for t in tickets[2:]:  # retained, newest
+        assert m.result(t)["means"].shape == (4, spec.N)
+
+
+def test_engine_switch_invalidates_serving_caches(dns_setup):
+    """set_kalman_engine must clear the serving trace-time builders too —
+    the estimation-layer invalidation contract (tests/test_engines.py)
+    extended to serving."""
+    spec, p, data = dns_setup
+    snap = serving.freeze_snapshot(spec, p, data, end=T_ORIGIN)
+    svc = serving.YieldCurveService(
+        snap, lattice=serving.BucketLattice(horizons=(4,), batch_sizes=(1,),
+                                            scenario_counts=(4,)))
+    svc.update(0, data[:, T_ORIGIN])
+    svc.forecast(4)
+    svc.scenarios(n=4, h=4)
+    builders = (so._jitted_update, so._jitted_update_k, so._jitted_scenarios,
+                sb._jitted_forecast_bucket)
+    populated = [b for b in builders if b.cache_info().currsize]
+    assert so._jitted_update in populated
+    assert sb._jitted_forecast_bucket in populated
+    try:
+        yfm.set_kalman_engine("sqrt")
+        for b in builders:
+            assert b.cache_info().currsize == 0, b
+    finally:
+        yfm.set_kalman_engine("univariate")
+
+
+def test_warmup_empty_axes_mean_none_not_all(dns_setup):
+    """An explicit EMPTY warmup axis means "none of these", never "the whole
+    lattice" (the falsy-container trap): scenario-only warmup must not trace
+    any forecast program, and ``horizons=()`` pre-traces nothing."""
+    spec, p, data = dns_setup
+    # bucket values unused elsewhere in this module, so the trace counters
+    # see fresh compilations (shared lru caches persist across tests)
+    lattice = serving.BucketLattice(horizons=(5,), batch_sizes=(2,),
+                                    scenario_counts=(3,))
+    snap = serving.freeze_snapshot(spec, p, data, end=T_ORIGIN)
+    m = serving.MicroBatcher(lattice)
+    serving.reset_trace_counts()
+    n = m.warmup(snap, batch_sizes=(), scenario_counts=(3,))
+    assert n == 1 and serving.trace_counts["scenarios"] == 1
+    assert serving.trace_counts["forecast"] == 0
+    assert m.warmup(snap, horizons=()) == 0
+
+
+def test_warmup_pretraces_then_serving_is_compile_free(dns_setup):
+    spec, p, data = dns_setup
+    lattice = serving.BucketLattice(horizons=(4, 8), batch_sizes=(1,),
+                                    scenario_counts=(4,))
+    svc = serving.YieldCurveService(
+        serving.freeze_snapshot(spec, p, data, end=T_ORIGIN), lattice=lattice)
+    svc.warmup(scenario_counts=(4,))
+    serving.reset_trace_counts()
+    svc.update(0, data[:, T_ORIGIN])
+    svc.forecast(7)
+    svc.scenarios(n=3, h=4)
+    assert sum(serving.trace_counts.values()) == 0, \
+        dict(serving.trace_counts)

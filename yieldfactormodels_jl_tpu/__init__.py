@@ -103,4 +103,12 @@ def __getattr__(name):
         from .persistence.io import save_results
 
         return save_results
+    if name in ("YieldCurveService", "ServingSnapshot", "SnapshotRegistry",
+                "freeze_snapshot", "load_snapshot", "serving"):
+        # importlib, not `from . import`: the latter re-enters this
+        # __getattr__ through _handle_fromlist's hasattr and recurses
+        import importlib
+
+        mod = importlib.import_module(".serving", __name__)
+        return mod if name == "serving" else getattr(mod, name)
     raise AttributeError(name)

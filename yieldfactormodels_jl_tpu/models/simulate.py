@@ -28,7 +28,8 @@ from .specs import ModelSpec
 
 
 def simulate(spec: ModelSpec, params, T: int, key,
-             sv_phi: float = 0.0, sv_sigma: float = 0.0):
+             sv_phi: float = 0.0, sv_sigma: float = 0.0,
+             start_state=None):
     """Simulate a (N, T) panel plus its latent paths.
 
     Returns a dict: ``data`` (N, T), ``states`` (Ms, T) the sampled β path,
@@ -36,6 +37,12 @@ def simulate(spec: ModelSpec, params, T: int, key,
     With ``sv_sigma = 0`` the DGP is exactly the homoskedastic model the
     Kalman loglik assumes; with SV it matches ``ops/particle.py``'s model
     (draw-then-observe order, h₀ = 0 before the first step).
+
+    ``start_state``: optional ``(beta, P)`` moments to draw β₀ from instead
+    of the unconditional distribution.  With the FILTERED moments
+    (β_{t|t}, P_{t|t}) of a fitted model, the simulated panel is an exact
+    draw from the h-step predictive distribution given the data — the
+    scenario generator of the online serving layer (``serving/``).
     """
     if not spec.is_kalman:
         raise ValueError(
@@ -50,8 +57,13 @@ def simulate(spec: ModelSpec, params, T: int, key,
     if Z_const is not None and d_const is None:
         d_const = jnp.zeros((N,), dtype=dtype)
 
-    st0 = init_state(spec, kp)
-    P0 = 0.5 * (st0.P + st0.P.T) + 1e-9 * jnp.eye(Ms, dtype=dtype)
+    if start_state is None:
+        st0 = init_state(spec, kp)
+        beta_mean, P_start = st0.beta, st0.P
+    else:
+        beta_mean = jnp.asarray(start_state[0], dtype=dtype)
+        P_start = jnp.asarray(start_state[1], dtype=dtype)
+    P0 = 0.5 * (P_start + P_start.T) + 1e-9 * jnp.eye(Ms, dtype=dtype)
     S0 = jnp.linalg.cholesky(P0)
     Om = 0.5 * (kp.Omega_state + kp.Omega_state.T) \
         + 1e-12 * jnp.eye(Ms, dtype=dtype)
@@ -59,7 +71,7 @@ def simulate(spec: ModelSpec, params, T: int, key,
     sig = jnp.sqrt(kp.obs_var)
 
     key, k0 = jax.random.split(jnp.asarray(key))
-    beta0 = st0.beta + S0 @ jax.random.normal(k0, (Ms,), dtype=dtype)
+    beta0 = beta_mean + S0 @ jax.random.normal(k0, (Ms,), dtype=dtype)
 
     def step(carry, k):
         beta, h = carry

@@ -25,6 +25,37 @@ from ..models.kalman import _tvl_measurement
 from ..models.specs import ModelSpec
 
 
+def density_from_state(spec: ModelSpec, kp, beta, P, horizon: int):
+    """The propagate-then-emit predictive-density scan, from a FILTERED state
+    (β_{t|t}, P_{t|t}): step k emits the (k+1)-step-ahead yield density.  The
+    single source of the density recursion, shared by ``forecast_density``
+    (which filters to the origin first) and the online serving layer
+    (``serving/batcher.py``), whose snapshots already hold the filtered state.
+    No failure gating here — callers own the sentinel/poison policy."""
+    dtype = kp.Phi.dtype
+    mats = spec.maturities_array
+    Z_const, d_const = K.measurement_setup(spec, kp, dtype)
+    if Z_const is not None and d_const is None:
+        d_const = jnp.zeros((spec.N,), dtype=dtype)
+    eyeN = jnp.eye(spec.N, dtype=dtype)
+
+    def step(carry, _):
+        b, Pm = carry
+        b = kp.delta + kp.Phi @ b
+        Pm = kp.Phi @ Pm @ kp.Phi.T + kp.Omega_state
+        if spec.family == "kalman_tvl":
+            Z, y_mean = _tvl_measurement(spec, b, mats)
+        else:
+            Z = Z_const
+            y_mean = Z @ b + d_const
+        cov = Z @ Pm @ Z.T + kp.obs_var * eyeN
+        return (b, Pm), (y_mean, cov, b, Pm)
+
+    (_, _), (means, covs, sb, sP) = lax.scan(step, (beta, P), None,
+                                             length=horizon)
+    return {"means": means, "covs": covs, "state_means": sb, "state_covs": sP}
+
+
 def forecast_density(spec: ModelSpec, params, data, horizon: int,
                      start=0, end=None, engine=None):
     """h-step-ahead predictive densities from the forecast ORIGIN ``end``.
@@ -58,33 +89,8 @@ def forecast_density(spec: ModelSpec, params, data, horizon: int,
     data = data[:, :end]  # the origin: condition on start..end-1 only
     params = jnp.asarray(params, dtype=spec.dtype)
     kp, outs = forward_moments(spec, params, data, start, end, engine)
-    beta = outs["beta_upd"][-1]
-    P = outs["P_upd"][-1]
-    mats = spec.maturities_array
-    Z_const, d_const = K.measurement_setup(spec, kp, params.dtype)
-    if Z_const is not None and d_const is None:
-        d_const = jnp.zeros((spec.N,), dtype=params.dtype)
-    eyeN = jnp.eye(spec.N, dtype=params.dtype)
-
-    def step(carry, _):
-        b, Pm = carry
-        b = kp.delta + kp.Phi @ b
-        Pm = kp.Phi @ Pm @ kp.Phi.T + kp.Omega_state
-        if spec.family == "kalman_tvl":
-            Z, y_mean = _tvl_measurement(spec, b, mats)
-        else:
-            Z = Z_const
-            y_mean = Z @ b + d_const
-        cov = Z @ Pm @ Z.T + kp.obs_var * eyeN
-        return (b, Pm), (y_mean, cov, b, Pm)
-
-    (_, _), (means, covs, sb, sP) = lax.scan(step, (beta, P), None,
-                                             length=horizon)
+    dens = density_from_state(spec, kp, outs["beta_upd"][-1],
+                              outs["P_upd"][-1], horizon)
     ok = jnp.all(outs["ll"] > -jnp.inf)
     nan = jnp.asarray(jnp.nan, dtype=params.dtype)
-    return {
-        "means": jnp.where(ok, means, nan),
-        "covs": jnp.where(ok, covs, nan),
-        "state_means": jnp.where(ok, sb, nan),
-        "state_covs": jnp.where(ok, sP, nan),
-    }
+    return {k: jnp.where(ok, v, nan) for k, v in dens.items()}

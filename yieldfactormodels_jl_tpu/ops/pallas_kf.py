@@ -43,6 +43,11 @@ from ..models.loadings import LAMBDA_FLOOR as _FLOOR, dns_slope_curvature
 from ..models.params import unpack_kalman
 from ..models.specs import ModelSpec
 
+# jax ≥ 0.6 renamed pltpu.TPUCompilerParams → pltpu.CompilerParams; resolve
+# whichever this install has (shared by every Pallas kernel module here)
+CompilerParams = getattr(pltpu, "CompilerParams",
+                         getattr(pltpu, "TPUCompilerParams", None))
+
 _LOG_2PI = math.log(2.0 * math.pi)
 
 _SUB, _LANE = 8, 128
@@ -288,7 +293,7 @@ def batched_loglik(spec: ModelSpec, params_batch, data, start=0, end=None,
         out_specs=pl.BlockSpec((rows, _LANE), lambda g: (g, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((nb * rows, _LANE), f32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel",)),
         interpret=interpret,
     )(*args)

@@ -51,8 +51,8 @@ from jax.experimental.pallas import tpu as pltpu
 from ..models.kalman import init_state, loglik_contrib_mask, measurement_setup
 from ..models.params import unpack_kalman
 from ..models.specs import ModelSpec
-from .pallas_kf import (_LANE, _SUB, TILE, _lay, tvl_rows, window_array,
-                        window_masks)
+from .pallas_kf import (_LANE, _SUB, TILE, CompilerParams, _lay, tvl_rows,
+                        window_array, window_masks)
 
 _LOG_2PI = math.log(2.0 * math.pi)
 
@@ -595,7 +595,7 @@ def _core_tvl_fwd(spec, interpret, windowed, Phi, delta, Om, ovar, beta0, P0,
                    tile_spec(nC * D)),
         out_shape=(jax.ShapeDtypeStruct((nb * _SUB, _LANE), f32),
                    jax.ShapeDtypeStruct((nC * D, nb * _SUB, _LANE), f32)),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel",)),
         interpret=interpret,
     )(*args)
@@ -637,7 +637,7 @@ def _core_tvl_bwd(spec, interpret, windowed, res, g):
             jax.ShapeDtypeStruct((rows, nb * _SUB, _LANE), f32)
             for rows in (Ms * Ms, Ms, Ms * Ms, 1, Ms, Ms * Ms)),
         scratch_shapes=[pltpu.VMEM((S * D, _SUB, _LANE), f32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(args[0], args[1], args[2], args[3], args[6], args[7], args[8], chk,
@@ -706,7 +706,7 @@ def _call_fwd(spec, interpret, windowed, Z, d, Phi, delta, Om, ovar, beta0, P0,
                    tile_spec(nC * D)),
         out_shape=(jax.ShapeDtypeStruct((nb * _SUB, _LANE), f32),
                    jax.ShapeDtypeStruct((nC * D, nb * _SUB, _LANE), f32)),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel",)),
         interpret=interpret,
     )(*args)
@@ -758,7 +758,7 @@ def _core_bwd(spec, interpret, windowed, res, g):
             jax.ShapeDtypeStruct((rows, nb * _SUB, _LANE), f32)
             for rows in (N * Ms, N, Ms * Ms, Ms, Ms * Ms, 1, Ms, Ms * Ms)),
         scratch_shapes=[pltpu.VMEM((S * D, _SUB, _LANE), f32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(args[0], args[1], args[2], args[3], args[4], args[5], args[8], args[9],

@@ -46,6 +46,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..models.params import unpack_kalman
 from ..models.specs import ModelSpec
+from .pallas_kf import CompilerParams
 from .particle import _measurement, factored_init
 
 _LOG_2PI = math.log(2.0 * math.pi)
@@ -312,7 +313,7 @@ def pf_loglik_batch(
         out_specs=pl.BlockSpec((1, _LANE), lambda g: (g, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((D, _LANE), ft),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel",)),
         interpret=interpret,
     )(rows, jnp.asarray(data, dtype=ft).T,

@@ -44,7 +44,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..models.params import unpack_msed
 from ..models.specs import ModelSpec
-from .pallas_kf import _lay
+from .pallas_kf import CompilerParams, _lay
 
 _SUB, _LANE = 8, 128
 _EPS = 1e-7        # nn_transform._EPS
@@ -427,7 +427,7 @@ def batched_loss(spec: ModelSpec, params_batch, data, start=0, end=None,
         out_specs=pl.BlockSpec((rows, _LANE), lambda g: (g, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((nb * rows, _LANE), ft),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel",)),
         interpret=interpret,
     )(*args)

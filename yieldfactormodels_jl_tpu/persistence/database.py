@@ -172,6 +172,21 @@ def _merged_db_path(results_folder: str, model_name: str, window_type: str) -> s
     return os.path.join(results_dir, model_name, "db", f"forecasts_{window_type}_merged.sqlite3")
 
 
+def read_all_task_params(db_path: str) -> Dict[int, np.ndarray]:
+    """Every task's fitted params from a merged DB in ONE query and one
+    deserialization pass — the serving snapshot-registry warm-boot read
+    (serving/snapshot.py), replacing a per-task ``read_task_params`` SELECT
+    loop.  Returns {task_id: flat float64 params}; {} when the DB is absent."""
+    if not os.path.isfile(db_path):
+        return {}
+    db = sqlite3.connect(db_path, timeout=10.0)
+    try:
+        rows = db.execute("SELECT task_id, params FROM forecasts").fetchall()
+    finally:
+        db.close()
+    return {int(task_id): deser(blob).reshape(-1) for task_id, blob in rows}
+
+
 def read_task_params(db_path: str, task_id: int) -> Optional[np.ndarray]:
     if not os.path.isfile(db_path):
         return None

@@ -1,0 +1,38 @@
+"""Online serving layer (docs/DESIGN.md §9; QUICKSTART "Serving").
+
+Turns the batch reproduction into the serving stack the ROADMAP asks for:
+snapshot registry over the merged SQLite DBs (``snapshot``), O(1) jitted
+recursive filter updates (``online``), shape-bucketed micro-batching onto a
+small lattice of precompiled programs (``batcher``), and the
+``YieldCurveService`` driver with per-stage latency accounting (``service``).
+"""
+
+from .batcher import (BucketLattice, DEFAULT_LATTICE, ForecastRequest,
+                      MicroBatcher, ScenarioRequest)
+from .online import (ONLINE_ENGINES, OnlineState, reset_trace_counts,
+                     scenario_paths, trace_counts, update, update_k)
+from .service import YieldCurveService
+from .snapshot import (ServingError, ServingSnapshot, SnapshotMeta,
+                       SnapshotRegistry, freeze_snapshot, load_snapshot)
+
+__all__ = [
+    "BucketLattice",
+    "DEFAULT_LATTICE",
+    "ForecastRequest",
+    "MicroBatcher",
+    "ScenarioRequest",
+    "ONLINE_ENGINES",
+    "OnlineState",
+    "reset_trace_counts",
+    "scenario_paths",
+    "trace_counts",
+    "update",
+    "update_k",
+    "YieldCurveService",
+    "ServingError",
+    "ServingSnapshot",
+    "SnapshotMeta",
+    "SnapshotRegistry",
+    "freeze_snapshot",
+    "load_snapshot",
+]

@@ -1,0 +1,324 @@
+"""Shape-bucketed micro-batching: arbitrary request mixes, fixed programs.
+
+A serving mix is heterogeneous — forecast horizons, scenario counts, and the
+number of curves asking at once all vary per request — but XLA programs are
+shape-monomorphic: every new shape is a retrace + recompile on the hot path.
+The front end here rounds every request onto a small LATTICE of padded fixed
+shapes (docs/DESIGN.md §9):
+
+- forecast requests bucket on (horizon, batch): requests against snapshots of
+  the same spec stack into one padded batch with the BATCH AXIS LAST —
+  params (n_params, B), β (Ms, B), P (Ms, Ms, B) — per the lane-dim rule
+  (docs/DESIGN.md §2): B rides the 128-wide lane axis, the small state dims
+  sit on sublanes.
+- scenario requests bucket on (horizon, n_draws): the draws axis IS the
+  batch and already rides last ((N, h, n) outputs).
+
+Every trace-time builder is ``@register_engine_cache`` + ``@lru_cache`` (in
+that order — config.py), so an engine switch invalidates serving programs
+exactly like the estimation caches, and the total number of distinct
+compilations is bounded by ``BucketLattice.n_programs`` regardless of the
+request mix (pinned in tests/test_serving.py via the trace counters in
+serving/online.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import defaultdict
+from functools import lru_cache
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import register_engine_cache
+from ..models.params import unpack_kalman
+from ..models.specs import ModelSpec
+from .online import note_trace, scenario_paths
+from .snapshot import ServingError, ServingSnapshot
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketLattice:
+    """The padded-shape lattice.  Small on purpose: its product bounds the
+    number of live compiled programs (and the warmup cost)."""
+
+    horizons: Tuple[int, ...] = (4, 8, 16, 32, 64)
+    batch_sizes: Tuple[int, ...] = (1, 4, 16)
+    scenario_counts: Tuple[int, ...] = (8, 32, 128)
+
+    def __post_init__(self):
+        for name in ("horizons", "batch_sizes", "scenario_counts"):
+            vals = getattr(self, name)
+            if not vals or list(vals) != sorted(set(vals)) or min(vals) < 1:
+                raise ValueError(f"{name} must be strictly increasing ≥ 1, "
+                                 f"got {vals}")
+
+    @property
+    def n_programs(self) -> int:
+        """Upper bound on distinct compiled serving programs."""
+        return (len(self.horizons) * len(self.batch_sizes)
+                + len(self.horizons) * len(self.scenario_counts))
+
+    @staticmethod
+    def _round_up(value: int, axis: Tuple[int, ...], name: str) -> int:
+        stage = "forecast" if name == "horizons" else "scenarios"
+        if value < 1:
+            # a non-positive size would otherwise round UP to the first
+            # bucket and come back silently truncated to an empty/short array
+            raise ServingError(stage, f"request {name[:-1]}={value} must be "
+                               "≥ 1", lattice=axis)
+        for v in axis:
+            if value <= v:
+                return v
+        raise ServingError(stage,
+                           f"request {name[:-1]}={value} exceeds the lattice "
+                           f"maximum {axis[-1]} — widen the BucketLattice",
+                           lattice=axis)
+
+    def horizon_bucket(self, h: int) -> int:
+        return self._round_up(int(h), self.horizons, "horizons")
+
+    def batch_bucket(self, b: int) -> int:
+        return self._round_up(int(b), self.batch_sizes, "batch_sizes")
+
+    def scenario_bucket(self, n: int) -> int:
+        return self._round_up(int(n), self.scenario_counts, "scenario_counts")
+
+
+DEFAULT_LATTICE = BucketLattice()
+
+
+@dataclasses.dataclass(frozen=True)
+class ForecastRequest:
+    """h-step predictive density; optional per-maturity Gaussian quantiles."""
+
+    horizon: int
+    quantiles: Optional[Tuple[float, ...]] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ScenarioRequest:
+    """n sampled h-step paths from the current predictive distribution."""
+
+    n: int
+    horizon: int
+    seed: int = 0
+
+
+# ---------------------------------------------------------------------------
+# jitted bucket programs
+# ---------------------------------------------------------------------------
+
+@register_engine_cache
+@lru_cache(maxsize=128)
+def _jitted_forecast_bucket(spec: ModelSpec, horizon: int, batch: int):
+    """One padded forecast program: (params (P, B), β (Ms, B), P (Ms, Ms, B))
+    → density dict with the batch on the trailing axis of every output."""
+    from ..ops.forecast import density_from_state
+
+    def one(params, beta, P):
+        note_trace("forecast")
+        kp = unpack_kalman(spec, params)
+        return density_from_state(spec, kp, beta, P, horizon)
+
+    del batch  # shape is carried by the (padded) arguments; key keeps programs apart
+    return jax.jit(jax.vmap(one, in_axes=-1, out_axes=-1))
+
+
+def _stack_last(arrs) -> jnp.ndarray:
+    """Stack equal-shape arrays on a NEW TRAILING axis (the lane-dim rule)."""
+    return jnp.stack([jnp.asarray(a) for a in arrs], axis=-1)
+
+
+def _normal_quantiles(means: np.ndarray, covs: np.ndarray,
+                      qs: Tuple[float, ...]) -> Dict[float, np.ndarray]:
+    """Per-maturity Gaussian quantile curves from an (h, N) mean path and
+    (h, N, N) covariance path (driver-side NumPy; tiny)."""
+    from scipy.special import ndtri
+
+    sd = np.sqrt(np.maximum(np.diagonal(covs, axis1=-2, axis2=-1), 0.0))
+    return {float(q): means + ndtri(q) * sd for q in qs}
+
+
+# ---------------------------------------------------------------------------
+# the micro-batcher
+# ---------------------------------------------------------------------------
+
+class MicroBatcher:
+    """Collects (snapshot, request) pairs, groups them onto the lattice, runs
+    one padded program per occupied bucket, and hands results back in
+    submission order.
+
+    Usage::
+
+        t0 = batcher.submit(snap_a, ForecastRequest(12))
+        t1 = batcher.submit(snap_b, ForecastRequest(9, quantiles=(0.05, 0.95)))
+        results = batcher.flush()          # {t0: {...}, t1: {...}}
+
+    Forecast requests whose snapshots share a ``ModelSpec`` batch together
+    even across different snapshots (different tasks/curves) — that is the
+    point: one program serves every curve of a model family.
+
+    Tickets are stable monotonic ids, never reused; ``flush()`` also banks
+    every completed result so a submitter whose requests were flushed by
+    ANOTHER caller (shared batcher) can still ``result(ticket)`` them —
+    collect promptly: only the ``max_banked`` most recent uncollected
+    results are retained (a dead submitter's orphaned tickets must not grow
+    the long-lived serving process without bound), oldest evicted first.
+    """
+
+    def __init__(self, lattice: Optional[BucketLattice] = None,
+                 max_banked: int = 4096):
+        self.lattice = lattice or DEFAULT_LATTICE
+        self.max_banked = int(max_banked)
+        self._pending: List[Tuple[int, ServingSnapshot, object]] = []
+        self._done: Dict[int, dict] = {}
+        self._next_ticket = 0
+
+    def __len__(self) -> int:
+        return len(self._pending)
+
+    def submit(self, snapshot: ServingSnapshot, request) -> int:
+        """Queue a request; returns its ticket (key into flush()'s dict and
+        ``result()``)."""
+        if not isinstance(request, (ForecastRequest, ScenarioRequest)):
+            raise ServingError("forecast", f"unknown request type "
+                               f"{type(request).__name__}")
+        # validate the bucket eagerly so a too-large request fails at submit
+        self.lattice.horizon_bucket(request.horizon)
+        if isinstance(request, ScenarioRequest):
+            self.lattice.scenario_bucket(request.n)
+        ticket = self._next_ticket
+        self._next_ticket += 1
+        self._pending.append((ticket, snapshot, request))
+        return ticket
+
+    def result(self, ticket: int) -> dict:
+        """Collect (and release) one flushed result by ticket.  A ticket
+        whose bucket program failed re-raises that failure (structured) to
+        ITS submitter only — other tickets are unaffected."""
+        if ticket not in self._done:
+            raise ServingError("forecast", f"ticket {ticket} has no banked "
+                               "result — not flushed yet, or already "
+                               "collected")
+        out = self._done.pop(ticket)
+        if "error" in out:
+            raise ServingError(out["stage"], f"bucket program failed: "
+                               f"{out['error']!r}", ticket=ticket)
+        return out
+
+    def flush(self) -> Dict[int, dict]:
+        """Run every pending request through its bucket program.  Returns
+        {ticket: result} for the requests flushed by THIS call (all of them
+        are also banked for ``result()``).
+
+        Exception-safe per bucket program: a request that makes its program
+        raise (e.g. a hand-built snapshot with malformed params) banks an
+        ``{"error": exc}`` entry for its chunk's tickets instead of
+        propagating and stranding every OTHER submitter's pending work."""
+        pending, self._pending = self._pending, []
+        results: Dict[int, dict] = {}
+
+        # ---- forecasts: group by (spec, horizon bucket), pad batch --------
+        groups = defaultdict(list)
+        for ticket, snap, req in pending:
+            if isinstance(req, ForecastRequest):
+                hb = self.lattice.horizon_bucket(req.horizon)
+                groups[(snap.spec, hb)].append((ticket, snap, req))
+        for (spec, hb), items in groups.items():
+            # chunk oversized groups at the largest batch bucket
+            bmax = self.lattice.batch_sizes[-1]
+            for lo in range(0, len(items), bmax):
+                chunk = items[lo:lo + bmax]
+                try:
+                    results.update(self._run_forecast_chunk(spec, hb, chunk))
+                except Exception as e:  # noqa: BLE001 — quarantine the chunk
+                    results.update({t: {"error": e, "stage": "forecast"}
+                                    for t, _, _ in chunk})
+
+        # ---- scenarios: bucket on (horizon, n), draws axis is the batch ---
+        for ticket, snap, req in pending:
+            if not isinstance(req, ScenarioRequest):
+                continue
+            try:
+                hb = self.lattice.horizon_bucket(req.horizon)
+                nb = self.lattice.scenario_bucket(req.n)
+                paths = scenario_paths(snap.spec, snap.params, snap.beta,
+                                       snap.P, hb, nb,
+                                       jax.random.PRNGKey(req.seed))
+                results[ticket] = {
+                    "paths": np.asarray(paths)[:, :req.horizon, :req.n],
+                    "version": snap.meta.version,
+                }
+            except Exception as e:  # noqa: BLE001
+                results[ticket] = {"error": e, "stage": "scenarios"}
+        self._done.update(results)  # bank for result() — shared-batcher safe
+        while len(self._done) > self.max_banked:  # evict oldest (ticket order;
+            self._done.pop(min(self._done))       # NOT insertion order — one
+            # flush banks forecasts before scenarios, so insertion order can
+            # put a newer ticket in front of an older one)
+        return results
+
+    def _run_forecast_chunk(self, spec, hb: int, chunk) -> Dict[int, dict]:
+        """One padded bucket program over ≤ max-batch forecast requests."""
+        bb = self.lattice.batch_bucket(len(chunk))
+        pad = bb - len(chunk)
+        snaps = [s for _, s, _ in chunk] + [chunk[-1][1]] * pad
+        runner = _jitted_forecast_bucket(spec, hb, bb)
+        dens = runner(_stack_last([s.params for s in snaps]),
+                      _stack_last([s.beta for s in snaps]),
+                      _stack_last([s.P for s in snaps]))
+        means = np.asarray(dens["means"])   # (hb, N, bb)
+        covs = np.asarray(dens["covs"])     # (hb, N, N, bb)
+        smeans = np.asarray(dens["state_means"])
+        scovs = np.asarray(dens["state_covs"])
+        out: Dict[int, dict] = {}
+        for i, (ticket, snap, req) in enumerate(chunk):
+            h = req.horizon
+            res = {
+                "means": means[:h, :, i],
+                "covs": covs[:h, :, :, i],
+                "state_means": smeans[:h, :, i],
+                "state_covs": scovs[:h, :, :, i],
+                "version": snap.meta.version,
+            }
+            if req.quantiles:
+                res["quantiles"] = _normal_quantiles(
+                    res["means"], res["covs"], req.quantiles)
+            out[ticket] = res
+        return out
+
+    # ---- warmup -----------------------------------------------------------
+
+    def warmup(self, snapshot: ServingSnapshot,
+               horizons: Optional[Tuple[int, ...]] = None,
+               batch_sizes: Optional[Tuple[int, ...]] = None,
+               scenario_counts: Tuple[int, ...] = ()) -> int:
+        """Pre-trace the bucket programs with real params/state so first
+        requests hit compiled code.  Returns the number of programs touched
+        (already-cached programs are free)."""
+        n = 0
+        # `is not None`, not `or`: an explicit EMPTY tuple means "none of
+        # these", not "all of them" (same falsy-container trap as service.py)
+        if horizons is None:
+            horizons = self.lattice.horizons
+        if batch_sizes is None:
+            batch_sizes = self.lattice.batch_sizes
+        for hb in horizons:
+            hb = self.lattice.horizon_bucket(hb)
+            for bb in batch_sizes:
+                bb = self.lattice.batch_bucket(bb)
+                runner = _jitted_forecast_bucket(snapshot.spec, hb, bb)
+                runner(_stack_last([snapshot.params] * bb),
+                       _stack_last([snapshot.beta] * bb),
+                       _stack_last([snapshot.P] * bb))
+                n += 1
+            for nb in scenario_counts:
+                nb = self.lattice.scenario_bucket(nb)
+                scenario_paths(snapshot.spec, snapshot.params, snapshot.beta,
+                               snapshot.P, hb, nb, jax.random.PRNGKey(0))
+                n += 1
+        return n

@@ -1,0 +1,329 @@
+"""Jitted recursive filter updates — the O(1)-per-observation serving core.
+
+The reference design is a filter: a new daily curve advances the state with
+ONE Kalman step (SURVEY.md §1), which is exactly the primitive an online
+service needs — no refit, no re-filter of history.  This module provides that
+step as precompiled fixed-shape programs (amortized-update inference in the
+spirit of arxiv 2210.07154 / 2207.00426: trace once, serve forever):
+
+- ``update``   one predict-then-update recursion from the FILTERED state
+  (β_{t|t}, P_{t|t} — what a :class:`~.snapshot.ServingSnapshot` freezes),
+- ``update_k`` the k-step batch of the same recursion as one ``lax.scan``
+  (catch-up after an ingest gap),
+- ``scenario_paths``  n sampled h-step paths from the current predictive
+  distribution (``models/simulate.py`` seeded at the filtered state).
+
+Two engines, same algebra as the offline filters they reuse pieces of:
+``"univariate"`` propagates P itself (sequential scalar updates,
+ops/univariate_kf.py); ``"sqrt"`` propagates a square-root factor S with
+P = S Sᵀ (Potter updates + QR time update, ops/sqrt_kf.py) for f32-robust
+long-horizon serving.
+
+Beyond the offline filters: the measurement update is NaN-masked PER ELEMENT,
+so a partially-observed curve (late auction, stale tenor) updates the state
+from the quoted maturities only — the offline kernels drop any column with a
+NaN entirely (/root/reference/src/models/kalman/filter.jl:126-140 semantics),
+which wastes real quotes in a live feed.  The sequential-observation decomposition makes the partial
+update exact, not approximate: each scalar observation conditions the state
+independently (Koopman–Durbin), so skipping the missing ones IS the correct
+posterior given the observed subset.
+
+Sentinel convention (CLAUDE.md): a failed innovation-variance chain inside
+the jitted kernel poisons the state to NaN and lowers ``ok``; only the driver
+layer (serving/service.py) converts that into a structured error.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+from functools import lru_cache
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..config import register_engine_cache
+from ..models.kalman import _tvl_measurement, measurement_setup
+from ..models.params import unpack_kalman
+from ..models.specs import ModelSpec
+
+_LOG_2PI = math.log(2.0 * math.pi)
+
+#: online-update engines (subset of config.KALMAN_ENGINES: the joint/assoc
+#: forms bring nothing to a single-step update — the univariate form IS the
+#: joint posterior, and assoc is a parallel-in-time reformulation)
+ONLINE_ENGINES = ("univariate", "sqrt")
+
+# ---------------------------------------------------------------------------
+# trace counters — incremented INSIDE the traced function bodies, so they
+# count actual (re)compilations; the no-recompile serving tests pin their sum
+# against the bucket-lattice bound (tests/test_serving.py)
+# ---------------------------------------------------------------------------
+
+trace_counts: collections.Counter = collections.Counter()
+
+
+def note_trace(kind: str) -> None:
+    """Call at the top of a to-be-jitted function body: runs once per trace."""
+    trace_counts[kind] += 1
+
+
+def reset_trace_counts() -> None:
+    trace_counts.clear()
+
+
+class OnlineState(NamedTuple):
+    """The serving scan carry: filtered mean + covariance representation —
+    ``cov`` holds P_{t|t} for the univariate engine, its square-root factor S
+    (P = S Sᵀ) for the sqrt engine."""
+
+    beta: jnp.ndarray   # (Ms,)
+    cov: jnp.ndarray    # (Ms, Ms)
+
+
+# ---------------------------------------------------------------------------
+# element-masked measurement updates
+# ---------------------------------------------------------------------------
+
+def _masked_sequential_update(Z, y_eff, mask, beta, P, obs_var):
+    """N scalar updates skipping masked elements (ops/univariate_kf.py's
+    ``_sequential_update`` with a per-observation mask; identical arithmetic
+    on fully-observed curves — the mask factor is an exact 1.0 multiply)."""
+
+    def body(carry, inp):
+        b, Pm, ll, ok = carry
+        z, y_i, m = inp
+        mf = m.astype(P.dtype)
+        zP = z @ Pm                     # (Ms,)
+        f = zP @ z + obs_var
+        ok = ok & (~m | ((f > 0) & jnp.isfinite(f)))
+        fsafe = jnp.where(f > 0, f, 1.0)
+        v = y_i - z @ b
+        K = zP / fsafe
+        b = b + K * (v * mf)
+        Pm = Pm - mf * jnp.outer(K, zP)
+        ll = ll - 0.5 * mf * (jnp.log(fsafe) + v * v / fsafe + _LOG_2PI)
+        return (b, Pm, ll, ok), None
+
+    zero = jnp.zeros((), dtype=P.dtype)
+    (beta_u, P_u, ll, ok), _ = lax.scan(
+        body, (beta, P, zero, jnp.bool_(True)), (Z, y_eff, mask),
+        length=Z.shape[0])
+    # same drift insurance as the offline kernel
+    P_u = 0.5 * (P_u + P_u.T)
+    return beta_u, P_u, ll, ok
+
+
+def _masked_potter_update(Z, y_eff, mask, beta, S, obs_var):
+    """Element-masked Potter square-root updates (ops/sqrt_kf.py's
+    ``_potter_update`` + the per-observation mask)."""
+
+    def body(carry, inp):
+        b, Sm, ll, ok = carry
+        z, y_i, m = inp
+        mf = m.astype(S.dtype)
+        phi = Sm.T @ z                    # (Ms,)
+        f = phi @ phi + obs_var
+        ok = ok & (~m | ((f > 0) & jnp.isfinite(f)))
+        fsafe = jnp.where(f > 0, f, 1.0)
+        v = y_i - z @ b
+        Sphi = Sm @ phi                   # = P z
+        b = b + Sphi * (v * mf / fsafe)
+        alpha = 1.0 / (fsafe + jnp.sqrt(jnp.maximum(obs_var, 0.0) * fsafe))
+        Sm = Sm - (alpha * mf) * jnp.outer(Sphi, phi)
+        ll = ll - 0.5 * mf * (jnp.log(fsafe) + v * v / fsafe + _LOG_2PI)
+        return (b, Sm, ll, ok), None
+
+    zero = jnp.zeros((), dtype=S.dtype)
+    (beta_u, S_u, ll, ok), _ = lax.scan(
+        body, (beta, S, zero, jnp.bool_(True)), (Z, y_eff, mask),
+        length=Z.shape[0])
+    return beta_u, S_u, ll, ok
+
+
+# ---------------------------------------------------------------------------
+# one recursion step (predict → element-masked update)
+# ---------------------------------------------------------------------------
+
+def _omega_sqrt_factor(kp, Ms, dtype):
+    """Upper factor C with Ω_state = CᵀC and its validity flag
+    (ops/sqrt_kf.py's jittered form + its ``fac_ok`` gate: a failed
+    factorization must poison the step, never silently serve with Ω = 0)."""
+    Om = 0.5 * (kp.Omega_state + kp.Omega_state.T) \
+        + 1e-12 * jnp.eye(Ms, dtype=dtype)
+    C = jnp.linalg.cholesky(Om).T
+    fac_ok = jnp.all(jnp.isfinite(C))
+    return jnp.where(jnp.isfinite(C), C, jnp.zeros_like(C)), fac_ok
+
+
+def filter_step(spec: ModelSpec, kp, state: OnlineState, y, engine: str):
+    """Advance the filtered state by one observation.
+
+    Predict-then-update: the snapshot holds β_{t|t}, so the transition runs
+    FIRST, then the element-masked measurement update with ``y`` (N,) — the
+    exact continuation of the offline filter's update-then-propagate scan.
+    Returns ``(OnlineState, ll, ok)``; on failure (``ok`` false) the state is
+    poisoned to NaN (sentinel), never raised here.
+    """
+    dtype = kp.Phi.dtype
+    Ms = spec.state_dim
+    mats = spec.maturities_array
+    beta, cov = state
+
+    beta_pred = kp.delta + kp.Phi @ beta
+    fac_ok = jnp.bool_(True)
+    if engine == "sqrt":
+        C, fac_ok = _omega_sqrt_factor(kp, Ms, dtype)
+        pre = jnp.concatenate([cov.T @ kp.Phi.T, C], axis=0)  # (2Ms, Ms)
+        cov_pred = jnp.linalg.qr(pre, mode="r").T
+    else:
+        cov_pred = kp.Phi @ cov @ kp.Phi.T + kp.Omega_state
+
+    mask = jnp.isfinite(y)
+    ysafe = jnp.where(mask, y, 0.0)  # masked elements never reach the update
+    if spec.family == "kalman_tvl":
+        # fixed-linearization effective observation (ops/univariate_kf.py)
+        Z, y_pred0 = _tvl_measurement(spec, beta_pred, mats)
+        y_eff = ysafe - y_pred0 + Z @ beta_pred
+    else:
+        Z, d_const = measurement_setup(spec, kp, dtype)
+        if d_const is None:
+            d_const = jnp.zeros((spec.N,), dtype=dtype)
+        y_eff = ysafe - d_const
+
+    if engine == "sqrt":
+        beta_u, cov_u, ll, ok = _masked_potter_update(
+            Z, y_eff, mask, beta_pred, cov_pred, kp.obs_var)
+    else:
+        beta_u, cov_u, ll, ok = _masked_sequential_update(
+            Z, y_eff, mask, beta_pred, cov_pred, kp.obs_var)
+    ok = ok & fac_ok
+
+    nan = jnp.asarray(jnp.nan, dtype=dtype)
+    beta_u = jnp.where(ok, beta_u, nan)   # bad update → NaN state (sentinel)
+    cov_u = jnp.where(ok, cov_u, nan)
+    return OnlineState(beta_u, cov_u), ll, ok
+
+
+# ---------------------------------------------------------------------------
+# jitted fixed-shape programs (trace-time builders: engine-cache registered)
+# ---------------------------------------------------------------------------
+
+def _check_engine(engine: str) -> None:
+    if engine not in ONLINE_ENGINES:
+        raise ValueError(
+            f"unknown online engine {engine!r}; pick from {ONLINE_ENGINES}")
+
+
+@register_engine_cache
+@lru_cache(maxsize=64)
+def _jitted_update(spec: ModelSpec, engine: str):
+    """One-step update program: (params, β, cov, y) → (β′, cov′, ll, ok)."""
+    _check_engine(engine)
+
+    def one(params, beta, cov, y):
+        note_trace("update")
+        kp = unpack_kalman(spec, params)
+        st, ll, ok = filter_step(spec, kp, OnlineState(beta, cov), y, engine)
+        return st.beta, st.cov, ll, ok
+
+    return jax.jit(one)
+
+
+#: catch-up length buckets: like the batcher's lattice, distinct gap lengths
+#: must not mean distinct compiled programs on the hot path (DESIGN.md §9)
+K_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
+
+
+def _k_bucket(k: int) -> int:
+    for v in K_BUCKETS:
+        if k <= v:
+            return v
+    return k  # beyond the lattice: one exact-size program (rare giant gap)
+
+
+@register_engine_cache
+@lru_cache(maxsize=64)
+def _jitted_update_k(spec: ModelSpec, engine: str, kb: int):
+    """Padded k-step catch-up program: (params, β, cov, Y (N, kb),
+    valid (kb,)) → (β′, cov′, lls (kb,), oks (kb,)) — one scan, params
+    unpacked once.  Steps with ``valid`` false are EXACT no-ops (the carry
+    passes through unchanged — NaN-padding alone would still apply the
+    transition), so any k ≤ kb runs through this one program."""
+    _check_engine(engine)
+
+    def many(params, beta, cov, Y, valid):
+        note_trace("update_k")
+        kp = unpack_kalman(spec, params)
+
+        def body(carry, inp):
+            y, v = inp
+            b0, c0 = carry
+            st, ll, ok = filter_step(spec, kp, OnlineState(b0, c0), y, engine)
+            b = jnp.where(v, st.beta, b0)
+            c = jnp.where(v, st.cov, c0)
+            return (b, c), (jnp.where(v, ll, 0.0), ok | ~v)
+
+        (b, c), (lls, oks) = lax.scan(body, (beta, cov), (Y.T, valid),
+                                      length=kb)
+        return b, c, lls, oks
+
+    return jax.jit(many)
+
+
+@register_engine_cache
+@lru_cache(maxsize=64)
+def _jitted_scenarios(spec: ModelSpec, horizon: int, n: int):
+    """n sampled h-step yield paths from the filtered state: (params, β, P,
+    keys (n, ·)) → (N, horizon, n) — draws ride the trailing (lane) axis."""
+    from ..models.simulate import simulate
+
+    def paths(params, beta, P, keys):
+        note_trace("scenarios")
+        return jax.vmap(
+            lambda k: simulate(spec, params, horizon, k,
+                               start_state=(beta, P))["data"],
+            out_axes=-1)(keys)
+
+    return jax.jit(paths)
+
+
+# ---------------------------------------------------------------------------
+# public (still sentinel-level: drivers own the error policy)
+# ---------------------------------------------------------------------------
+
+def update(spec: ModelSpec, params, state: OnlineState, y,
+           engine: str = "univariate"):
+    """One recursive update.  Returns ``(OnlineState, ll, ok)`` — all traced
+    outputs; the caller decides whether NaN state is an error."""
+    runner = _jitted_update(spec, engine)
+    b, c, ll, ok = runner(params, state.beta, state.cov, jnp.asarray(y))
+    return OnlineState(b, c), ll, ok
+
+
+def update_k(spec: ModelSpec, params, state: OnlineState, Y,
+             engine: str = "univariate"):
+    """k-step catch-up over the columns of ``Y`` (N, k).  Returns
+    ``(OnlineState, lls (k,), oks (k,))``.  ``k`` is rounded up onto
+    ``K_BUCKETS`` (padded steps are exact no-ops), so varying gap lengths
+    share a handful of compiled programs."""
+    Y = jnp.asarray(Y)
+    k = int(Y.shape[1])
+    kb = _k_bucket(k)
+    if kb > k:
+        pad = jnp.full(Y.shape[:1] + (kb - k,), jnp.nan, dtype=Y.dtype)
+        Y = jnp.concatenate([Y, pad], axis=1)
+    valid = jnp.arange(kb) < k
+    runner = _jitted_update_k(spec, engine, kb)
+    b, c, lls, oks = runner(params, state.beta, state.cov, Y, valid)
+    return OnlineState(b, c), lls[:k], oks[:k]
+
+
+def scenario_paths(spec: ModelSpec, params, beta, P, horizon: int, n: int,
+                   key):
+    """n h-step scenario paths (N, horizon, n) from filtered moments (β, P)."""
+    runner = _jitted_scenarios(spec, int(horizon), int(n))
+    keys = jax.random.split(jnp.asarray(key), n)
+    return runner(params, beta, P, keys)

@@ -1,0 +1,184 @@
+"""`YieldCurveService` — the online serving driver.
+
+Wraps one :class:`~.snapshot.ServingSnapshot` with the three serving verbs:
+
+- ``update(date, yields)``   advance the filtered state by one curve (O(1),
+  precompiled; partial curves OK — NaN entries are masked per element),
+- ``forecast(h, quantiles)`` h-step predictive densities through the
+  shape-bucketed micro-batcher (ops/forecast.py's density recursion),
+- ``scenarios(n, h, seed)``  n sampled paths from the predictive
+  distribution (models/simulate.py seeded at the filtered state).
+
+Driver-layer responsibilities (CLAUDE.md conventions): the jitted kernels
+only emit sentinels (NaN state / −Inf ll); THIS layer turns them into
+structured :class:`~.snapshot.ServingError`s, keeps the last good snapshot on
+a failed update (no silent NaN propagation into later requests), stamps
+versions, and records per-stage latency through
+``utils/profiling.StageTimer`` so p50/p99 land in the BENCH ledger
+(``latency_summary()`` → ``StageTimer.summary()``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.profiling import StageTimer
+from .batcher import (BucketLattice, ForecastRequest, MicroBatcher,
+                      ScenarioRequest)
+from .online import OnlineState, _check_engine, _jitted_update, update_k
+from .snapshot import ServingError, ServingSnapshot
+
+
+class YieldCurveService:
+    """One curve family, served online.
+
+    ``engine`` picks the recursive-update kernel: ``"univariate"``
+    (propagates P) or ``"sqrt"`` (propagates a square-root factor —
+    f32-robust over long serving horizons).  Forecasts/scenarios always read
+    the (β, P) moments, so the engine choice is invisible downstream.
+
+    By default each service owns its batcher.  A shared
+    :class:`MicroBatcher` (``batcher=``) lets requests micro-batch ACROSS
+    services; ``forecast``/``scenarios`` here flush whatever is pending and
+    collect their own ticket — other submitters' results stay banked on the
+    batcher until they collect them (``MicroBatcher.result``).
+    """
+
+    def __init__(self, snapshot: ServingSnapshot,
+                 lattice: Optional[BucketLattice] = None,
+                 engine: str = "univariate",
+                 timer: Optional[StageTimer] = None,
+                 batcher: Optional[MicroBatcher] = None):
+        _check_engine(engine)
+        self.engine = engine
+        self.timer = timer if timer is not None else StageTimer()
+        # `is not None`, not `or`: an EMPTY shared batcher is falsy (__len__)
+        self.batcher = batcher if batcher is not None else MicroBatcher(lattice)
+        self._set_snapshot(snapshot)
+        self.last_update = None  # date of the last accepted update
+
+    # ---- state plumbing ---------------------------------------------------
+
+    def _set_snapshot(self, snapshot: ServingSnapshot) -> None:
+        self.snapshot = snapshot
+        cov = snapshot.P
+        if self.engine == "sqrt":
+            # factor once per (re)load; afterwards the sqrt kernel propagates
+            # the factor itself and P is re-formed only for the snapshot record
+            Ms = cov.shape[0]
+            sym = 0.5 * (cov + cov.T) + 1e-12 * jnp.eye(Ms, dtype=cov.dtype)
+            cov = jnp.linalg.cholesky(sym)
+            if not bool(jnp.all(jnp.isfinite(cov))):
+                raise ServingError("snapshot", "filtered covariance is not "
+                                   "PSD — cannot start the sqrt engine",
+                                   version=snapshot.meta.version)
+        self._state = OnlineState(snapshot.beta, cov)
+
+    @property
+    def version(self) -> int:
+        return self.snapshot.meta.version
+
+    # ---- the serving verbs ------------------------------------------------
+
+    def update(self, date, yields) -> float:
+        """Advance the state with one observed curve (N,).  NaN entries are
+        treated as unquoted maturities (masked per element; an all-NaN curve
+        is a pure transition step).  Returns the update's loglik contribution.
+
+        Raises :class:`ServingError` on a failed innovation chain; the
+        service keeps the last good snapshot (version unchanged)."""
+        y = jnp.asarray(yields, dtype=self.snapshot.spec.dtype).reshape(-1)
+        if y.shape[0] != self.snapshot.spec.N:
+            raise ServingError("update", f"curve has {y.shape[0]} maturities, "
+                               f"spec has {self.snapshot.spec.N}", date=date)
+        with self.timer.stage("update"):
+            runner = _jitted_update(self.snapshot.spec, self.engine)
+            b, c, ll, ok = runner(self.snapshot.params, self._state.beta,
+                                  self._state.cov, y)
+            ok = bool(ok)  # device sync: the driver decides, not the kernel
+        if not ok:
+            raise ServingError(
+                "update", "non-PD innovation variance — state poisoned to "
+                "NaN by the kernel; snapshot left at the last good version",
+                date=date, version=self.version)
+        self._state = OnlineState(b, c)
+        P = c @ c.T if self.engine == "sqrt" else c
+        self.snapshot = self.snapshot.advanced(b, P)
+        self.last_update = date
+        return float(ll)
+
+    def update_many(self, date, curves) -> np.ndarray:
+        """k-step catch-up over the columns of ``curves`` (N, k) — one scan
+        program.  All-or-nothing: a failed step anywhere rolls back."""
+        Y = jnp.asarray(curves, dtype=self.snapshot.spec.dtype)
+        with self.timer.stage("update"):
+            st, lls, oks = update_k(self.snapshot.spec, self.snapshot.params,
+                                    self._state, Y, engine=self.engine)
+            oks = np.asarray(oks)
+        if not oks.all():
+            raise ServingError(
+                "update", f"step {int(np.argmin(oks))} of {Y.shape[1]} failed "
+                "(non-PD innovation variance)", date=date,
+                version=self.version)
+        self._state = st
+        P = st.cov @ st.cov.T if self.engine == "sqrt" else st.cov
+        self.snapshot = self.snapshot.advanced(st.beta, P, n=int(Y.shape[1]))
+        self.last_update = date
+        return np.asarray(lls)
+
+    def forecast(self, h: int, quantiles: Optional[Tuple[float, ...]] = None
+                 ) -> dict:
+        """h-step predictive density from the current state: ``means``
+        (h, N), ``covs`` (h, N, N), state paths, optional ``quantiles``
+        {q: (h, N)}.  Runs through the micro-batcher, so it shares bucket
+        programs with every other service on the same spec."""
+        with self.timer.stage("forecast"):
+            ticket = self.batcher.submit(
+                self.snapshot, ForecastRequest(int(h), tuple(quantiles)
+                                               if quantiles else None))
+            self.batcher.flush()
+            out = self.batcher.result(ticket)
+        self._check_finite("forecast", out["means"])
+        return out
+
+    def scenarios(self, n: int, h: int, seed: int = 0) -> dict:
+        """n sampled h-step yield paths: ``paths`` (N, h, n), draws on the
+        trailing (lane) axis."""
+        with self.timer.stage("scenarios"):
+            ticket = self.batcher.submit(
+                self.snapshot, ScenarioRequest(int(n), int(h), int(seed)))
+            self.batcher.flush()
+            out = self.batcher.result(ticket)
+        self._check_finite("scenarios", out["paths"])
+        return out
+
+    def _check_finite(self, stage: str, arr) -> None:
+        if not np.all(np.isfinite(arr)):
+            raise ServingError(stage, "non-finite output (NaN sentinel from "
+                               "the kernels)", version=self.version)
+
+    # ---- warmup / observability ------------------------------------------
+
+    def warmup(self, horizons: Optional[Tuple[int, ...]] = None,
+               batch_sizes: Tuple[int, ...] = (1,),
+               scenario_counts: Tuple[int, ...] = ()) -> int:
+        """Pre-trace the update kernel and the bucket-lattice programs so the
+        first live request pays no compile.  Returns programs touched."""
+        spec = self.snapshot.spec
+        with self.timer.stage("warmup"):
+            runner = _jitted_update(spec, self.engine)
+            nan_curve = jnp.full((spec.N,), jnp.nan, dtype=spec.dtype)
+            # all-NaN warmup curve: a pure transition step, real params/state
+            runner(self.snapshot.params, self._state.beta, self._state.cov,
+                   nan_curve)
+            n = 1 + self.batcher.warmup(self.snapshot, horizons=horizons,
+                                        batch_sizes=batch_sizes,
+                                        scenario_counts=scenario_counts)
+        return n
+
+    def latency_summary(self) -> dict:
+        """Per-stage latency percentiles (StageTimer.summary())."""
+        return self.timer.summary()

@@ -1,0 +1,198 @@
+"""Model-snapshot registry: fitted params → frozen serving state.
+
+A :class:`ServingSnapshot` is the unit of deployment for the online layer:
+the fitted flat parameter vector (loaded from the merged SQLite DBs the
+rolling-forecast pipeline writes — persistence/database.py), the filtered
+state moments (β_{t|t}, P_{t|t}) from ONE offline filter pass over the
+conditioning sample, and version-stamped metadata.  After the freeze, serving
+never touches the history again: a new observation advances the state through
+``serving/online.py``'s O(1) recursive update, and forecasts/scenarios read
+the state directly (amortized posterior-update inference — PAPERS.md,
+arxiv 2210.07154).
+
+Snapshots are registered pytrees (params/β/P are leaves, spec + meta are
+static aux data), so they pass through ``jit``/``vmap`` boundaries unchanged
+and stack naturally into the micro-batcher's padded batches.
+
+Driver-layer error policy (CLAUDE.md): a freeze that fails structurally — no
+params in the DB, a −Inf filter pass — raises :class:`ServingError` loudly;
+inside the jitted kernels the same failures stay sentinels.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models.specs import ModelSpec
+from ..persistence.database import read_all_task_params, read_task_params
+
+
+class ServingError(RuntimeError):
+    """Structured serving failure, raised only at the driver layer.  Carries
+    ``stage`` (``"snapshot" | "update" | "forecast" | "scenarios"``) and a
+    ``context`` dict (date, task_id, version, ...) for the caller's logs."""
+
+    def __init__(self, stage: str, detail: str, **context):
+        self.stage = stage
+        self.detail = detail
+        self.context = dict(context)
+        ctx = f" [{', '.join(f'{k}={v}' for k, v in self.context.items())}]" \
+            if self.context else ""
+        super().__init__(f"{stage}: {detail}{ctx}")
+
+
+@dataclasses.dataclass(frozen=True)
+class SnapshotMeta:
+    """Version-stamped provenance (hashable: rides the static side of the
+    pytree).  ``version`` bumps on every accepted online update;
+    ``n_updates`` counts updates since the freeze (``n_obs`` columns were
+    conditioned on at freeze time)."""
+
+    model_string: str = ""
+    window_type: str = "expanding"
+    task_id: int = -1
+    n_obs: int = 0
+    version: int = 0
+    n_updates: int = 0
+
+    def bump(self, n: int = 1) -> "SnapshotMeta":
+        return dataclasses.replace(self, version=self.version + n,
+                                   n_updates=self.n_updates + n)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class ServingSnapshot:
+    """Frozen serving state: params + filtered (β_{t|t}, P_{t|t}) + meta."""
+
+    spec: ModelSpec
+    params: jnp.ndarray   # (n_params,) constrained flat vector
+    beta: jnp.ndarray     # (Ms,)
+    P: jnp.ndarray        # (Ms, Ms)
+    meta: SnapshotMeta = SnapshotMeta()
+
+    def tree_flatten(self):
+        return (self.params, self.beta, self.P), (self.spec, self.meta)
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        spec, meta = aux
+        params, beta, P = leaves
+        return cls(spec, params, beta, P, meta)
+
+    def advanced(self, beta, P, n: int = 1) -> "ServingSnapshot":
+        """The snapshot after ``n`` accepted online updates (version bump
+        of ``n`` — one per observation, O(1) regardless of n)."""
+        return dataclasses.replace(self, beta=beta, P=P,
+                                   meta=self.meta.bump(n))
+
+
+def freeze_snapshot(spec: ModelSpec, params, data, start: int = 0,
+                    end: Optional[int] = None, engine=None,
+                    meta: Optional[SnapshotMeta] = None) -> ServingSnapshot:
+    """Run the filter once over ``data[:, start:end]`` and freeze the final
+    filtered moments.  ``engine`` follows the ``forward_moments`` contract
+    ("univariate"/"joint" emit moments; None reads the process engine, with a
+    fallback to "univariate" when the process engine has no moments path).
+
+    Raises :class:`ServingError` on a failed filter pass (−Inf loglik) —
+    first-iteration structural failures are loud at the driver layer.
+    """
+    from .. import config
+    from ..ops.smoother import forward_moments
+
+    if not spec.is_kalman:
+        raise ServingError(
+            "snapshot", f"online serving needs a Kalman family with a state "
+            f"posterior; {spec.family!r} has no filtered covariance",
+            model=spec.model_string)
+    if engine is None and config.kalman_engine() not in ("joint", "univariate"):
+        engine = "univariate"  # loglik-only engines have no moments path
+    data = jnp.asarray(data, dtype=spec.dtype)
+    T = data.shape[1]
+    end = T if end is None else min(int(end), T)
+    data = data[:, :end]  # condition on start..end-1 only (forecast origin)
+    params = jnp.asarray(params, dtype=spec.dtype).reshape(-1)
+    _, outs = forward_moments(spec, params, data, start, end, engine)
+    if not bool(jnp.all(outs["ll"] > -jnp.inf)):
+        raise ServingError(
+            "snapshot", "filter pass failed (−Inf loglik sentinel) — params "
+            "invalid for this panel", model=spec.model_string, end=end)
+    if meta is None:
+        meta = SnapshotMeta(model_string=spec.model_string)
+    meta = dataclasses.replace(meta, n_obs=end - start)
+    return ServingSnapshot(spec, params, outs["beta_upd"][-1],
+                           outs["P_upd"][-1], meta)
+
+
+def load_snapshot(db_path: str, spec: ModelSpec, task_id: int, data,
+                  window_type: str = "expanding", engine=None
+                  ) -> ServingSnapshot:
+    """Read task ``task_id``'s fitted params from a merged forecast DB
+    (persistence/database.py contract) and freeze a snapshot conditioned on
+    ``data[:, :task_id]`` (the task's estimation sample)."""
+    params = read_task_params(db_path, task_id)
+    if params is None:
+        raise ServingError("snapshot", f"no fitted params for task {task_id}",
+                           db_path=db_path, task_id=task_id)
+    meta = SnapshotMeta(model_string=spec.model_string,
+                        window_type=window_type, task_id=int(task_id))
+    return freeze_snapshot(spec, params, data, end=int(task_id),
+                           engine=engine, meta=meta)
+
+
+class SnapshotRegistry:
+    """In-process registry of live snapshots, keyed (model_string, task_id).
+
+    ``load_all`` bulk-loads every task in a merged DB with ONE query
+    (``read_all_task_params``) and one filter freeze per task — the serving
+    warm-boot path, no per-task SELECT loop."""
+
+    def __init__(self):
+        self._snaps: Dict[Tuple[str, int], ServingSnapshot] = {}
+        self.last_errors: Dict[int, Exception] = {}
+
+    def __len__(self) -> int:
+        return len(self._snaps)
+
+    def keys(self):
+        return sorted(self._snaps)
+
+    def put(self, snap: ServingSnapshot) -> Tuple[str, int]:
+        key = (snap.meta.model_string, snap.meta.task_id)
+        self._snaps[key] = snap
+        return key
+
+    def get(self, model_string: str, task_id: int = -1) -> ServingSnapshot:
+        key = (model_string, task_id)
+        if key not in self._snaps:
+            raise ServingError("snapshot", f"no snapshot registered for {key}",
+                               known=self.keys())
+        return self._snaps[key]
+
+    def load_all(self, db_path: str, spec: ModelSpec, data,
+                 window_type: str = "expanding", engine=None):
+        """Freeze one snapshot per task found in ``db_path``; returns the
+        registered keys.  Tasks whose freeze fails are skipped with their
+        errors collected on ``self.last_errors`` (a dead task must not take
+        the whole registry down — including malformed params rows, which
+        raise shape errors from unpack, not ServingError)."""
+        all_params = read_all_task_params(db_path)
+        keys, errors = [], {}
+        for task_id in sorted(all_params):
+            meta = SnapshotMeta(model_string=spec.model_string,
+                                window_type=window_type, task_id=int(task_id))
+            try:
+                snap = freeze_snapshot(spec, all_params[task_id], data,
+                                       end=int(task_id), engine=engine,
+                                       meta=meta)
+            except Exception as e:  # noqa: BLE001 — quarantine the row
+                errors[int(task_id)] = e
+                continue
+            keys.append(self.put(snap))
+        self.last_errors = errors
+        return keys
