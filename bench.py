@@ -347,6 +347,28 @@ def main():
         except Exception as e:  # never kill the bench line
             serving_ctx = f"; serving bench failed ({type(e).__name__}: {e})"
 
+    # ---- orchestration microbenchmark (opt-in: BENCH_ORCH=1) ----
+    # tasks/sec and chaos-resume overhead for a 2-worker in-process rolling
+    # run through the leased queue (orchestration/).  Runs in a CPU-pinned
+    # subprocess (same idiom as the grad-parity child): the workload is
+    # host-side coordination + tiny RW predicts, and a TPU claim for it
+    # would violate the relay-safety rules for zero benefit.
+    orch_ctx = ""
+    if os.environ.get("BENCH_ORCH", "0") not in ("0", ""):
+        try:
+            oenv = {**os.environ, "JAX_PLATFORMS": "cpu"}
+            oenv.pop("PALLAS_AXON_POOL_IPS", None)
+            oenv.pop("JAX_COMPILATION_CACHE_DIR", None)
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--orch-bench"],
+                env=oenv, capture_output=True, text=True, timeout=600)
+            tail = (proc.stdout.strip().splitlines() or ["no output"])[-1]
+            orch_ctx = (f"; {tail}" if "orch-bench" in tail else
+                        f"; orch-bench subprocess failed rc="
+                        f"{proc.returncode} ({tail[:200]})")
+        except Exception as e:  # never kill the bench line
+            orch_ctx = f"; orch bench failed ({type(e).__name__}: {e})"
+
     n_finite = int(np.isfinite(np.asarray(out)).sum())
     # the joint form runs its matmuls/Cholesky through bf16 MXU passes on TPU
     # f32, so cross-check with a loose tolerance on the finite intersection
@@ -393,7 +415,8 @@ def main():
           f"api/univariate {dev_evals_per_sec:.2f} | joint {BATCH / t_joint:.2f} "
           f"| pallas {pallas_rate} evals/s; kernels agree: joint={agree} "
           f"pallas={pallas_agree}; finite: {n_finite}/{BATCH}; "
-          f"cpu ll sample {ll_cpu:.2f}{grad_ctx}{ssd_ctx}{serving_ctx}; "
+          f"cpu ll sample {ll_cpu:.2f}{grad_ctx}{ssd_ctx}{serving_ctx}"
+          f"{orch_ctx}; "
           f"roofline: {flops_per_eval/1e6:.3f} MFLOP/eval -> "
           f"univariate {gflops(dev_evals_per_sec):.1f} | "
           f"joint {gflops(BATCH / t_joint):.1f} | "
@@ -438,6 +461,58 @@ def _grad_parity():
     print(f"grad-parity[interpret f64, B={gB} T={gT}]: "
           f"{'PASS' if ok else 'FAIL'} ({detail})")
     return 0 if ok else 1
+
+
+def _orch_bench():
+    """2-worker in-process orchestration bench (CPU-pinned subprocess mode):
+    tasks/sec on a clean RW rolling run through the leased queue, plus the
+    wall-clock overhead of a chaos-killed worker being stolen from and the
+    run completing anyway (the recovery path priced, not just tested)."""
+    import tempfile
+    import numpy as np
+
+    from yieldfactormodels_jl_tpu import create_model
+    from yieldfactormodels_jl_tpu.orchestration import chaos
+    from yieldfactormodels_jl_tpu.orchestration import supervisor as sup
+
+    mats = tuple(MATURITIES[::4])
+    T, in_end, h = 84, 61, 4  # 24 origins + 1 merge barrier
+    rng = np.random.default_rng(0)
+    data = np.cumsum(rng.standard_normal((len(mats), T)) * 0.1, axis=1) + 5.0
+    n_tasks = T - in_end + 1
+
+    def run_once(root, with_chaos):
+        spec, _ = create_model("RW", mats, float_type="float64",
+                               results_location=root + os.sep)
+        init = np.zeros((spec.n_params, 1))
+        # ttl balances spurious steals on a loaded 1-core box (too low)
+        # against the dead-worker takeover wait priced into the resume wall
+        kw = dict(window_type="expanding", lease_ttl=2.0, poll_interval=0.02,
+                  reestimate=False)
+        if with_chaos:
+            # one worker dies at its 8th shard write; the survivor steals
+            # the expired lease and finishes the whole run
+            chaos.configure("shard_write:@8")
+        t0 = time.perf_counter()
+        stats = sup.run_orchestrated(spec, data, "1", in_end, 1, h, init,
+                                     n_workers=2, **kw)
+        wall = time.perf_counter() - t0
+        chaos.reset()
+        merged = os.path.join(root, "db",
+                              "forecasts_expanding_merged.sqlite3")
+        assert os.path.isfile(merged), "orchestrated run did not merge"
+        assert with_chaos == any(s.died for s in stats)
+        return wall
+
+    with tempfile.TemporaryDirectory() as d:
+        run_once(os.path.join(d, "warmup"), False)  # pay jit compiles once
+        wall_clean = run_once(os.path.join(d, "clean"), False)
+        wall_chaos = run_once(os.path.join(d, "resume"), True)
+    print(f"orch-bench[RW, {n_tasks} tasks, 2 workers]: "
+          f"{n_tasks / wall_clean:.2f} tasks/s (wall {wall_clean:.2f}s); "
+          f"worker-death resume wall {wall_chaos:.2f}s -> overhead "
+          f"{wall_chaos / wall_clean:.2f}x")
+    return 0
 
 
 def _orchestrate():
@@ -510,6 +585,8 @@ def _orchestrate():
 if __name__ == "__main__":
     if "--grad-parity" in sys.argv:
         sys.exit(_grad_parity())
+    elif "--orch-bench" in sys.argv:
+        sys.exit(_orch_bench())
     elif "--inner" in sys.argv:
         main()
     else:

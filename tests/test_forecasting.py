@@ -213,6 +213,183 @@ def test_crash_recovery_stale_lock(tmp_path):
     assert tasks == list(range(30, 37))
 
 
+def test_merge_skips_corrupt_shard_with_summary(tmp_path):
+    """A truncated/corrupt shard DB is skipped with a recorded reason in the
+    merge summary instead of aborting the whole merge; the corrupt file is
+    kept on disk for repair, healthy shards still fold and delete."""
+    spec = _spec(tmp_path)
+    base = os.path.join(str(tmp_path), "db", "forecasts_expanding.sqlite3")
+    results = {k: np.ones((2, 6)) for k in
+               ("preds", "factors", "states", "factor_loadings_1",
+                "factor_loadings_2")}
+    for task in (30, 31, 32):
+        db.save_oos_forecast_sharded(base, spec.model_string, "1", "expanding",
+                                     task, results, -0.5, np.arange(3.0),
+                                     forecast_horizon=2)
+    # truncate task 31's shard mid-file (a worker killed mid-write)
+    with open(db.forecast_path(base, 31), "r+b") as fh:
+        fh.truncate(100)
+    out = db.merge_forecast_shards(base, task_ids=[30, 31, 32],
+                                   delete_shards=True)
+    assert os.path.isfile(out)
+    assert sorted(out.merged) == [30, 32]
+    assert [t for t, _ in out.skipped] == [31]
+    assert "corrupt" in out.skipped[0][1]
+    conn = sqlite3.connect(out)
+    tasks = [r[0] for r in conn.execute(
+        "SELECT task_id FROM forecasts ORDER BY task_id").fetchall()]
+    conn.close()
+    assert tasks == [30, 32]
+    assert os.path.isfile(db.forecast_path(base, 31))  # kept for repair
+    assert not os.path.isfile(db.forecast_path(base, 32))  # healthy: deleted
+
+
+def test_merge_survives_corrupt_first_shard(tmp_path):
+    """The fold target itself may be the corrupt one — the merge must pick
+    the next healthy shard instead of renaming garbage to _merged."""
+    spec = _spec(tmp_path)
+    base = os.path.join(str(tmp_path), "db", "forecasts_expanding.sqlite3")
+    results = {k: np.ones((2, 6)) for k in
+               ("preds", "factors", "states", "factor_loadings_1",
+                "factor_loadings_2")}
+    for task in (30, 31):
+        db.save_oos_forecast_sharded(base, spec.model_string, "1", "expanding",
+                                     task, results, -0.5, np.arange(3.0),
+                                     forecast_horizon=2)
+    with open(db.forecast_path(base, 30), "wb") as fh:
+        fh.write(b"\x00" * 64)
+    out = db.merge_forecast_shards(base, task_ids=[30, 31])
+    assert out.merged == [31] and [t for t, _ in out.skipped] == [30]
+    conn = sqlite3.connect(out)
+    assert conn.execute("SELECT COUNT(*) FROM forecasts").fetchone()[0] == 1
+    conn.close()
+
+
+def test_merge_publish_is_at_most_once(tmp_path):
+    """A slow duplicate merger (its lease was stolen while it was still
+    alive) must NOT overwrite an already-published merged DB with a partial
+    one — the publish is an at-most-once link, first merger wins."""
+    spec = _spec(tmp_path)
+    base = os.path.join(str(tmp_path), "db", "forecasts_expanding.sqlite3")
+    results = {k: np.ones((2, 6)) for k in
+               ("preds", "factors", "states", "factor_loadings_1",
+                "factor_loadings_2")}
+    for task in (30, 31, 32):
+        db.save_oos_forecast_sharded(base, spec.model_string, "1", "expanding",
+                                     task, results, -0.5, np.arange(3.0),
+                                     forecast_horizon=2)
+    first = db.merge_forecast_shards(base, task_ids=[30, 31, 32],
+                                     delete_shards=True)
+    assert sorted(first.merged) == [30, 31, 32]
+    # the loser re-runs after the winner published + deleted the shards:
+    # it must not clobber the complete merged DB with its empty view
+    second = db.merge_forecast_shards(base, task_ids=[30, 31, 32],
+                                      delete_shards=True)
+    assert str(second) == str(first)
+    assert second.merged == []  # discarded, not published
+    conn = sqlite3.connect(first)
+    tasks = [r[0] for r in conn.execute(
+        "SELECT task_id FROM forecasts ORDER BY task_id").fetchall()]
+    conn.close()
+    assert tasks == [30, 31, 32]  # winner's rows intact
+
+
+def test_merge_concurrent_duplicate_mergers(tmp_path):
+    """Two mergers racing over the same shard set (the lease-steal double
+    execution): exactly one publishes, the merged DB holds every row, no
+    shard row is lost regardless of interleaving."""
+    import threading
+
+    spec = _spec(tmp_path)
+    base = os.path.join(str(tmp_path), "db", "forecasts_expanding.sqlite3")
+    results = {k: np.ones((2, 6)) for k in
+               ("preds", "factors", "states", "factor_loadings_1",
+                "factor_loadings_2")}
+    tasks = list(range(30, 38))
+    for task in tasks:
+        db.save_oos_forecast_sharded(base, spec.model_string, "1", "expanding",
+                                     task, results, -0.5, np.arange(3.0),
+                                     forecast_horizon=2)
+    outs, errs = [], []
+
+    def go():
+        try:
+            outs.append(db.merge_forecast_shards(base, task_ids=tasks,
+                                                 delete_shards=True))
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    ts = [threading.Thread(target=go) for _ in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs, errs
+    published = [o for o in outs if o.merged]
+    assert len(published) == 1  # at-most-once publish
+    conn = sqlite3.connect(outs[0])
+    got = [r[0] for r in conn.execute(
+        "SELECT task_id FROM forecasts ORDER BY task_id").fetchall()]
+    conn.close()
+    assert got == tasks  # complete, no lost rows
+
+
+def test_held_lock_broken_via_env_ttl(tmp_path, monkeypatch):
+    """YFM_LOCK_TTL arms break_stale_lock inside the task loop: a dead
+    worker's 2h-old lock no longer starves its task even WITHOUT the
+    explicit stale_lock_ttl entry sweep."""
+    import time as _time
+
+    spec = _spec(tmp_path)
+    data = _panel(T=36)
+    init = np.zeros((spec.n_params, 1))
+    lockroot = os.path.join(spec.results_location, "db", "locks")
+    stale = os.path.join(lockroot, "expanding", "task_31.lock")
+    os.makedirs(stale)
+    old = _time.time() - 7200
+    os.utime(stale, (old, old))
+    monkeypatch.setenv("YFM_LOCK_TTL", "3600")
+    run_forecast_window_database(
+        spec, data, "1", 30, 1, 4, "expanding", init,
+        param_groups=[], reestimate=False, printing=False)
+    merged = os.path.join(str(tmp_path), "db",
+                          "forecasts_expanding_merged.sqlite3")
+    assert os.path.isfile(merged)
+    conn = sqlite3.connect(merged)
+    tasks = [r[0] for r in conn.execute(
+        "SELECT task_id FROM forecasts ORDER BY task_id").fetchall()]
+    conn.close()
+    assert tasks == list(range(30, 37))
+
+
+def test_held_lock_broken_via_env_ttl_batched(tmp_path, monkeypatch):
+    """The batched driver honors YFM_LOCK_TTL for its per-task locks too —
+    a dead worker's stale lock must not starve the origin (and with it the
+    all-shards merge gate) on the device-batched path."""
+    import time as _time
+
+    spec = _spec(tmp_path)
+    data = _panel(T=36)
+    init = np.zeros((spec.n_params, 1))
+    lockroot = os.path.join(spec.results_location, "db", "locks")
+    stale = os.path.join(lockroot, "expanding", "task_31.lock")
+    os.makedirs(stale)
+    old = _time.time() - 7200
+    os.utime(stale, (old, old))
+    monkeypatch.setenv("YFM_LOCK_TTL", "3600")
+    run_forecast_window_batched(
+        spec, data, "1", 30, 1, 4, "expanding", init,
+        param_groups=[], reestimate=False, printing=False)
+    merged = os.path.join(str(tmp_path), "db",
+                          "forecasts_expanding_merged.sqlite3")
+    assert os.path.isfile(merged)
+    conn = sqlite3.connect(merged)
+    tasks = [r[0] for r in conn.execute(
+        "SELECT task_id FROM forecasts ORDER BY task_id").fetchall()]
+    conn.close()
+    assert tasks == list(range(30, 37))
+
+
 def test_batched_window_predicts_equal_truncated_per_task(maturities, yields_panel):
     """The fused one-program per-origin predict (masked uniform panel) must
     equal the per-task truncated predict column-for-column over the saved

@@ -1,0 +1,417 @@
+"""Orchestration subsystem: leased queue, checkpoint/resume, chaos recovery.
+
+The flagship test simulates a worker dying mid-window (chaos-injected, no
+SIGKILL), then asserts a second supervisor steals the lease, resumes the
+estimation cascade from the checkpoint, and produces a merged forecast DB
+whose every row — loss floats and result blobs byte-for-byte — equals a
+fault-free single-worker run, with the resumed worker demonstrably skipping
+the group iterations the dead worker already completed (recorded call
+counts in ``orchestration.checkpoint.ITERS_EXECUTED``).
+"""
+
+import os
+import sqlite3
+import time
+
+import numpy as np
+import pytest
+
+from yieldfactormodels_jl_tpu import create_model
+from yieldfactormodels_jl_tpu.orchestration import chaos
+from yieldfactormodels_jl_tpu.orchestration import checkpoint as ckpt_mod
+from yieldfactormodels_jl_tpu.orchestration.checkpoint import WindowCheckpoint
+from yieldfactormodels_jl_tpu.orchestration.queue import (LeaseLost, TaskQueue)
+from yieldfactormodels_jl_tpu.orchestration.retry import (RetryPolicy,
+                                                          SentinelFailure,
+                                                          backoff_delay)
+from yieldfactormodels_jl_tpu.orchestration import supervisor as sup
+from yieldfactormodels_jl_tpu.persistence import database as db
+from yieldfactormodels_jl_tpu.persistence.locks import break_stale_lock
+
+MATS = tuple(np.array([3.0, 12.0, 24.0, 60.0, 120.0, 360.0]) / 12.0)
+
+
+@pytest.fixture(autouse=True)
+def _chaos_clean():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+def _spec(tmp_path, code="RW"):
+    spec, _ = create_model(code, MATS, float_type="float64",
+                           results_location=str(tmp_path) + os.sep)
+    return spec
+
+
+def _panel(T=40):
+    rng = np.random.default_rng(5)
+    return np.cumsum(rng.standard_normal((len(MATS), T)) * 0.1, axis=1) + 5.0
+
+
+def _ns_init(spec):
+    p = np.zeros(spec.n_params)
+    p[0] = np.log(0.5)
+    p[1:4] = [0.3, -0.1, 0.05]
+    p[4:13] = np.diag([0.9, 0.85, 0.8]).T.reshape(-1)
+    return p[:, None]
+
+
+def _merged_rows(path):
+    conn = sqlite3.connect(path)
+    try:
+        return conn.execute(
+            "SELECT model,thread,window,task_id,loss,params,preds,fl1,fl2,"
+            "factors,states FROM forecasts ORDER BY task_id").fetchall()
+    finally:
+        conn.close()
+
+
+# ---------------------------------------------------------------------------
+# queue
+# ---------------------------------------------------------------------------
+
+def test_queue_lease_lifecycle_and_steal(tmp_path):
+    q = TaskQueue(str(tmp_path / "q.sqlite3"))
+    assert q.enqueue(["a", "b"]) == 2
+    assert q.enqueue(["a", "b"]) == 0  # idempotent
+
+    # unexpired leases are exclusive (generous TTL: this box is 1-core and
+    # a loaded scheduler must not fake an expiry)
+    l1 = q.claim("w1", ttl=120.0)
+    l2 = q.claim("w2", ttl=120.0)
+    assert {l1.key, l2.key} == {"a", "b"}
+    assert q.claim("w3", ttl=120.0) is None
+
+    q.complete(l2)
+    assert q.counts()["done"] == 1
+
+    # TTL expiry -> atomic steal; the dead owner's late writes are rejected
+    q2 = TaskQueue(str(tmp_path / "q2.sqlite3"))
+    q2.enqueue(["t"])
+    dead = q2.claim("dead", ttl=0.1)
+    time.sleep(0.15)
+    stolen = q2.claim("alive", ttl=120.0)
+    assert stolen is not None and stolen.key == "t" and stolen.attempts == 2
+    assert q2.heartbeat(dead) is False
+    with pytest.raises(LeaseLost):
+        q2.complete(dead)
+    q2.complete(stolen)
+    assert q2.counts()["done"] == 1
+
+
+def test_queue_retry_backoff_and_quarantine(tmp_path):
+    q = TaskQueue(str(tmp_path / "q.sqlite3"))
+    q.enqueue(["poison"])
+    lease = q.claim("w1", ttl=120.0)
+    q.fail(lease, "boom", retry_in=30.0)
+    assert q.claim("w1", ttl=120.0) is None  # backoff holds it
+    snap = q.snapshot()[0]
+    assert snap["status"] == "pending" and snap["last_error"] == "boom"
+
+    # zero backoff -> claimable again; quarantine is terminal w/ cause
+    q2 = TaskQueue(str(tmp_path / "q2.sqlite3"))
+    q2.enqueue(["poison"])
+    l1 = q2.claim("w1", ttl=120.0)
+    q2.fail(l1, "first", retry_in=0.0)
+    l2 = q2.claim("w1", ttl=120.0)
+    assert l2.attempts == 2
+    q2.fail(l2, "ZeroDivisionError: the cause", quarantine=True)
+    assert q2.claim("w1", ttl=120.0) is None
+    assert q2.all_terminal()
+    row = q2.snapshot()[0]
+    assert row["status"] == "quarantined" and "the cause" in row["last_error"]
+
+    # release gives the claim back without burning an attempt (merge barrier)
+    q3 = TaskQueue(str(tmp_path / "q3.sqlite3"))
+    q3.enqueue(["merge"])
+    lr = q3.claim("w1", ttl=120.0)
+    q3.release(lr, retry_in=0.0)
+    assert q3.claim("w1", ttl=120.0).attempts == 1
+
+
+def test_queue_degraded_mkdir_fallback(tmp_path):
+    # journal path under a FILE -> unreachable -> mkdir-lock protocol
+    blocker = tmp_path / "blocker"
+    blocker.write_text("not a dir")
+    q = TaskQueue(str(blocker / "q.sqlite3"),
+                  fallback_lockroot=str(tmp_path / "locks"))
+    assert q.degraded
+    q.enqueue(["a", "b"])
+    l1 = q.claim("w1", ttl=120.0)
+    assert l1 is not None and l1.token == "mkdir"
+    assert os.path.isdir(os.path.join(str(tmp_path / "locks"), "a.lock"))
+    assert q.heartbeat(l1) is True  # utime on the lock dir
+    # a second degraded queue (another process) cannot double-claim
+    q2 = TaskQueue(str(blocker / "q.sqlite3"),
+                   fallback_lockroot=str(tmp_path / "locks"))
+    q2.enqueue(["a", "b"])
+    assert q2.claim("w2", ttl=120.0).key == "b"
+    q.complete(l1)
+    assert not os.path.isdir(os.path.join(str(tmp_path / "locks"), "a.lock"))
+    assert q.counts()["done"] == 1
+
+
+# ---------------------------------------------------------------------------
+# chaos / retry / checkpoint / locks units
+# ---------------------------------------------------------------------------
+
+def test_chaos_count_and_probability_triggers():
+    chaos.configure("estimate:@2")
+    chaos.maybe_fail("estimate")
+    with pytest.raises(chaos.ChaosInjected):
+        chaos.maybe_fail("estimate")
+    chaos.maybe_fail("estimate")  # only the N-th hit fires
+    chaos.maybe_fail("other_seam")  # unarmed seams never fire
+    assert chaos.hits("estimate") == 3
+
+    # probability triggers replay under a fixed seed
+    def run(seed):
+        chaos.configure("s:0.5", seed=seed)
+        fired = []
+        for _ in range(32):
+            try:
+                chaos.maybe_fail("s")
+                fired.append(0)
+            except chaos.ChaosInjected:
+                fired.append(1)
+        return fired
+
+    assert run(7) == run(7)
+    assert any(run(7))
+
+
+def test_backoff_delay_grows_and_is_bounded():
+    pol = RetryPolicy(max_attempts=5, base_delay=1.0, factor=2.0,
+                      max_delay=5.0, jitter=0.0)
+    assert [backoff_delay(pol, k) for k in (1, 2, 3, 4, 5)] == \
+        [1.0, 2.0, 4.0, 5.0, 5.0]
+
+
+def test_checkpoint_roundtrip_signature_and_clear(tmp_path):
+    ck = WindowCheckpoint(str(tmp_path), "expanding", 31)
+    sig = dict(model="NS", T=36, groups="1,2")
+    assert ck.load(sig) is None
+    state = dict(X=np.arange(6, dtype=np.float64).reshape(2, 3),
+                 prev_ll=np.array([-1.5, -2.5]), next_it=2)
+    ck.save(sig, state)
+    got = ck.load(sig)
+    np.testing.assert_array_equal(got["X"], state["X"])
+    assert int(got["next_it"]) == 2 and ck.resumed_iters == 2
+    # any signature drift (different data length) discards the checkpoint
+    assert ck.load(dict(sig, T=40)) is None
+    # corrupt file is refit-from-scratch, not a crash
+    with open(ck.path, "wb") as fh:
+        fh.write(b"garbage")
+    assert ck.load(sig) is None
+    ck.clear()
+    assert not os.path.isfile(ck.path)
+
+
+def test_break_stale_lock(tmp_path):
+    lock = str(tmp_path / "task_7.lock")
+    os.makedirs(lock)
+    assert not break_stale_lock(lock, ttl_seconds=3600.0)  # fresh: kept
+    old = time.time() - 7200
+    os.utime(lock, (old, old))
+    assert break_stale_lock(lock, ttl_seconds=3600.0)
+    assert not os.path.isdir(lock)
+    assert not break_stale_lock(lock, ttl_seconds=3600.0)  # gone: no-op
+
+
+# ---------------------------------------------------------------------------
+# supervisor
+# ---------------------------------------------------------------------------
+
+def test_worker_completes_rw_run_and_status_reports(tmp_path):
+    """Fast tier-1 smoke: one worker drains an RW rolling run through the
+    queue (claim → shard → complete → merge barrier → export)."""
+    spec = _spec(tmp_path)
+    data = _panel(T=36)
+    init = np.zeros((spec.n_params, 1))
+    stats = sup.run_worker(
+        spec, data, "1", 30, 1, 4, init, window_type="expanding",
+        worker_id="solo", lease_ttl=120.0, poll_interval=0.05,
+        reestimate=False)
+    assert not stats.died
+    assert stats.merged == ["expanding"]
+    assert stats.completed == 7 + 1  # 7 origins + merge barrier
+    merged = os.path.join(str(tmp_path), "db",
+                          "forecasts_expanding_merged.sqlite3")
+    rows = _merged_rows(merged)
+    assert [r[3] for r in rows] == list(range(30, 37))
+    # exported the legacy CSVs too
+    assert os.path.isfile(os.path.join(
+        str(tmp_path), "RW__thread_id__1__expanding_window_forecasts.csv"))
+    st = sup.status(sup.default_queue_path(spec))
+    assert st["counts"]["done"] == 8 and st["progress"] == 1.0
+    assert "progress 100.0%" in sup.format_status(sup.default_queue_path(spec))
+    # a rerun against the terminal queue is a no-op
+    stats2 = sup.run_worker(
+        spec, data, "1", 30, 1, 4, init, window_type="expanding",
+        worker_id="again", lease_ttl=120.0, poll_interval=0.05,
+        reestimate=False)
+    assert stats2.completed == 0
+
+
+def test_chaos_shard_write_death_then_restart_completes(tmp_path):
+    """Worker dies (chaos) before a shard write; a restarted worker steals
+    the expired lease and finishes the run — the mkdir-era bug (forever-
+    leaked lock) becomes a bounded TTL wait."""
+    spec = _spec(tmp_path)
+    data = _panel(T=40)
+    init = np.zeros((spec.n_params, 1))
+    chaos.configure("shard_write:@4")
+    w1 = sup.run_worker(
+        spec, data, "1", 31, 1, 3, init, window_type="expanding",
+        worker_id="w1", lease_ttl=0.4, poll_interval=0.05, reestimate=False)
+    assert w1.died and w1.completed == 3
+    chaos.reset()  # the restarted worker is healthy
+    w2 = sup.run_worker(
+        spec, data, "1", 31, 1, 3, init, window_type="expanding",
+        worker_id="w2", lease_ttl=0.4, poll_interval=0.05, reestimate=False)
+    assert not w2.died and w2.stolen >= 1
+    merged = os.path.join(str(tmp_path), "db",
+                          "forecasts_expanding_merged.sqlite3")
+    rows = _merged_rows(merged)
+    assert [r[3] for r in rows] == list(range(31, 41))
+
+
+def test_chaos_merge_death_then_restart_remerges(tmp_path):
+    spec = _spec(tmp_path)
+    data = _panel(T=36)
+    init = np.zeros((spec.n_params, 1))
+    chaos.configure("merge:@1")
+    w1 = sup.run_worker(
+        spec, data, "1", 32, 1, 3, init, window_type="expanding",
+        worker_id="w1", lease_ttl=0.4, poll_interval=0.05, reestimate=False)
+    assert w1.died and w1.merged == []
+    chaos.reset()
+    w2 = sup.run_worker(
+        spec, data, "1", 32, 1, 3, init, window_type="expanding",
+        worker_id="w2", lease_ttl=0.4, poll_interval=0.05, reestimate=False)
+    assert w2.merged == ["expanding"]
+    merged = os.path.join(str(tmp_path), "db",
+                          "forecasts_expanding_merged.sqlite3")
+    assert [r[3] for r in _merged_rows(merged)] == list(range(32, 37))
+
+
+def test_sentinel_loss_raises_retriable_failure(tmp_path, monkeypatch):
+    """−Inf at the driver boundary becomes a retriable task failure under
+    sentinel_policy='retry' (the queue path), while the legacy path keeps
+    the reference behavior of saving the NULL loss."""
+    from yieldfactormodels_jl_tpu import forecasting as fc
+
+    spec = _spec(tmp_path, code="NS")
+    data = _panel(T=36)
+    monkeypatch.setattr(
+        fc, "_estimate_for_window",
+        lambda *a, **k: (float("-inf"), np.zeros(spec.n_params)))
+    with pytest.raises(SentinelFailure, match="non-finite loss sentinel"):
+        fc.run_single_window_task(
+            spec, data, "1", 33, "expanding", 33, 1, 3,
+            np.zeros((spec.n_params, 1)), param_groups=["1"] * spec.n_params,
+            sentinel_policy="retry")
+    # legacy policy: shard written with NULL loss
+    p = fc.run_single_window_task(
+        spec, data, "1", 33, "expanding", 33, 1, 3,
+        np.zeros((spec.n_params, 1)), param_groups=["1"] * spec.n_params,
+        sentinel_policy="save")
+    assert os.path.isfile(p)
+
+
+def test_poison_task_quarantined_with_cause(tmp_path):
+    """A structurally failing estimation burns its attempts and lands in
+    quarantine with the recorded cause; the merge barrier then quarantines
+    too (cannot merge) instead of hanging the worker loop."""
+    spec = _spec(tmp_path, code="NS")
+    data = np.full((len(MATS), 34), 1e308)  # objective non-finite everywhere
+    init = _ns_init(spec)
+    stats = sup.run_worker(
+        spec, data, "1", 33, 1, 3, init, window_type="expanding",
+        worker_id="w1", lease_ttl=120.0, poll_interval=0.05,
+        retry=RetryPolicy(max_attempts=2, base_delay=0.01, max_delay=0.02),
+        param_groups=list(spec.default_param_groups()), max_group_iters=1)
+    assert stats.failed > 0 and not stats.died
+    st = sup.status(sup.default_queue_path(spec))
+    assert st["counts"]["quarantined"] == 2 + 1  # 2 windows + merge barrier
+    window_errs = [r for r in st["quarantined"]
+                   if not r["task"].startswith("merge:")]
+    assert all(r["attempts"] == 2 for r in window_errs)
+    assert any("non-finite" in (r["error"] or "") for r in window_errs)
+    assert any("cannot merge" in (r["error"] or "")
+               for r in st["quarantined"] if r["task"].startswith("merge:"))
+    rendered = sup.format_status(sup.default_queue_path(spec))
+    assert "QUARANTINED" in rendered
+
+
+# ---------------------------------------------------------------------------
+# the acceptance scenario: mid-estimation death, steal, checkpoint resume
+# ---------------------------------------------------------------------------
+
+def test_mid_window_death_lease_steal_checkpoint_resume(tmp_path):
+    """YFM_CHAOS-style injected death MID-ESTIMATION (after one of two
+    block-coordinate iterations of the second window): the restarted
+    supervisor must steal the lease, resume the cascade from the
+    checkpoint, and produce a merged DB identical row-for-row (losses,
+    params and forecast blobs byte-exact) to a fault-free single-worker
+    run, with no duplicate shards and with the resumed worker's recorded
+    group-iteration counts proving the completed multi-starts were
+    skipped, not refit."""
+    data = _panel(T=36)
+    in_end, h = 34, 3  # windows 34, 35, 36
+    n_windows, iters_per_window = 3, 2
+
+    # ---- fault-free reference run (its own results dir) ----
+    spec_ref = _spec(tmp_path / "ref", code="NS")
+    groups = list(spec_ref.default_param_groups())
+    kw = dict(window_type="expanding", poll_interval=0.05,
+              param_groups=groups, max_group_iters=iters_per_window,
+              group_tol=0.0, reestimate=True)  # tol=0: fixed iteration count
+    ckpt_mod.ITERS_EXECUTED.clear()
+    ref = sup.run_worker(spec_ref, data, "1", in_end, 1, h, _ns_init(spec_ref),
+                         worker_id="ref", lease_ttl=120.0, **kw)
+    assert not ref.died and ref.merged == ["expanding"]
+    ref_iters = dict(ckpt_mod.ITERS_EXECUTED)
+    assert sum(ref_iters.values()) == n_windows * iters_per_window
+
+    # ---- chaos run: worker 1 dies after iteration 1 of its 2nd window ----
+    spec = _spec(tmp_path / "chaos", code="NS")
+    hit = iters_per_window + 1  # the 3rd 'estimate' seam hit = mid-window 2
+    chaos.configure(f"estimate:@{hit}")
+    ckpt_mod.ITERS_EXECUTED.clear()
+    w1 = sup.run_worker(spec, data, "1", in_end, 1, h, _ns_init(spec),
+                        worker_id="w1", lease_ttl=1.0, **kw)
+    assert w1.died and w1.completed == 1  # first window done, second in-flight
+    w1_iters = sum(ckpt_mod.ITERS_EXECUTED.values())
+    assert w1_iters == hit
+    # the in-flight window left a live checkpoint behind
+    ckroot = os.path.join(spec.results_location, "db", "checkpoints")
+    left = [f for f in os.listdir(os.path.join(ckroot, "expanding"))]
+    assert left == ["task_35.ckpt.npz"]
+
+    # ---- restarted supervisor: steal + resume + finish + merge ----
+    chaos.reset()
+    ckpt_mod.ITERS_EXECUTED.clear()
+    w2 = sup.run_worker(spec, data, "1", in_end, 1, h, _ns_init(spec),
+                        worker_id="w2", lease_ttl=1.0, **kw)
+    assert not w2.died and w2.stolen >= 1 and w2.merged == ["expanding"]
+    w2_iters = sum(ckpt_mod.ITERS_EXECUTED.values())
+    # resumed, not refit: w1+w2 together ran exactly the fault-free count,
+    # so w2 skipped every iteration w1 had already checkpointed
+    assert w1_iters + w2_iters == sum(ref_iters.values())
+    assert w2_iters < sum(ref_iters.values())
+    # checkpoints are cleared once their shard is durable
+    assert os.listdir(os.path.join(ckroot, "expanding")) == []
+
+    # ---- artifact equality: merged DB row-for-row vs the fault-free run ----
+    rows_ref = _merged_rows(os.path.join(
+        spec_ref.results_location, "db", "forecasts_expanding_merged.sqlite3"))
+    rows = _merged_rows(os.path.join(
+        spec.results_location, "db", "forecasts_expanding_merged.sqlite3"))
+    assert len(rows) == n_windows  # no duplicate shards
+    assert rows == rows_ref  # losses, params and blobs byte-identical
+    # shards were folded and deleted
+    leftovers = [f for f in os.listdir(os.path.join(spec.results_location, "db"))
+                 if f.startswith("forecasts_expanding") and "merged" not in f]
+    assert leftovers == []

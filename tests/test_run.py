@@ -107,6 +107,27 @@ def test_run_flagship_with_estimation(tmp_path, monkeypatch):
     assert np.isfinite(np.loadtxt(params_csv, delimiter=",")).all()
 
 
+def test_run_orchestrated_rolling_rw(tmp_path, monkeypatch):
+    """run(orchestrated=True): the same rolling windows as the lock-loop
+    driver, executed as leased queue tasks by 2 in-process workers
+    (orchestration/supervisor.py) — merged DB + legacy CSV still land."""
+    monkeypatch.chdir(tmp_path)
+    scratch = str(tmp_path) + os.sep
+    _write_data(scratch, T=36)
+    run("1", 32, 3, True, "RW", "float64",
+        window_type="expanding", run_optimization=False,
+        reestimate=False, orchestrated=True, n_workers=2,
+        scratch_dir=scratch)
+    res = os.path.join(scratch, "YieldFactorModels.jl", "results", "thread_id__1", "RW")
+    merged = os.path.join(res, "db", "forecasts_expanding_merged.sqlite3")
+    assert os.path.isfile(merged)
+    queue = os.path.join(res, "db", "queue.sqlite3")
+    assert os.path.isfile(queue)  # the run was journaled, not mkdir-locked
+    csv = os.path.join(res, "RW__thread_id__1__expanding_window_forecasts.csv")
+    arr = np.loadtxt(csv, delimiter=",")
+    assert arr.shape == (5 * 3, 2 + len(MATS_MONTHS))
+
+
 def test_run_rolling_rw_end_to_end(tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     scratch = str(tmp_path) + os.sep

@@ -37,6 +37,7 @@ from ..models import api
 from ..models.params import transform_params, untransform_params, get_new_initial_params
 from ..models.specs import ModelSpec
 from ..config import register_engine_cache
+from ..orchestration import chaos as _chaos
 from .batched_lbfgs import batched_lbfgs
 from .neldermead import nelder_mead, nelder_mead_batched
 
@@ -161,11 +162,23 @@ def _jitted_batch_loss(spec: ModelSpec, T: int):
 # ---------------------------------------------------------------------------
 
 def _run_lbfgs(fun, x0, max_iters: int, g_tol: float, f_abstol: float):
-    """LBFGS with backtracking linesearch ≈ Optim.LBFGS(BackTracking(order=3))."""
+    """LBFGS with backtracking linesearch ≈ Optim.LBFGS(BackTracking(order=3)).
+
+    max_backtracking_steps=80, not optax's usual ~25: the first iteration's
+    direction is the raw gradient, and a hard-misfit start (e.g. λ far off
+    truth) can carry ‖g‖ ~ 3e6 while the finite region sits within ~1e-6 of
+    x0 — 25 halvings of 0.8 only reach 4e-3·‖g‖, every probe lands on the
+    1e12 penalty plateau (zero gradient), and the run NaNs out
+    (tests/test_simulate.py::test_estimation_recovers_simulating_lambda was
+    exactly this).  The extra budget is consumed ONLY when 25 steps would
+    have failed — the search exits on the first Armijo success — so
+    converging runs are unchanged.  Optim.jl survives the same start because
+    its backtracking interpolates and handles Inf natively (SURVEY.md §7).
+    """
     opt = optax.lbfgs(
         memory_size=10,
         linesearch=optax.scale_by_backtracking_linesearch(
-            max_backtracking_steps=25, store_grad=True
+            max_backtracking_steps=80, store_grad=True
         ),
     )
     value_and_grad = optax.value_and_grad_from_state(fun)
@@ -674,7 +687,7 @@ def estimate_steps(spec: ModelSpec, data, all_params, param_groups: Sequence[str
                    max_group_iters: int = 10, tol: float = 1e-8,
                    optimizers: Optional[Dict[str, Tuple[str, dict]]] = None,
                    start=0, end=None, max_tries: int = 0, printing: bool = False,
-                   _force_scan: bool = False):
+                   _force_scan: bool = False, checkpoint=None):
     """Block-coordinate estimation over parameter groups.
 
     Faithful to the reference control flow: improved initializations for the
@@ -684,6 +697,13 @@ def estimate_steps(spec: ModelSpec, data, all_params, param_groups: Sequence[str
     on the very first group iteration raises (the reference rethrows first-
     iteration errors); on later iterations the group loop aborts quietly.
     Returns (init_params, ll, best_params, Convergence(converged, iterations)).
+
+    ``checkpoint`` (an ``orchestration.checkpoint.WindowCheckpoint``):
+    persists the full lockstep state after every group iteration and, on a
+    signature-matching reload, resumes the remaining iterations bit-for-bit
+    — each iteration is a deterministic function of (raw, X, prev_ll, done)
+    and the arrays round-trip in native dtype, so a preempted-and-resumed
+    cascade equals an uninterrupted one exactly.
     """
     data = jnp.asarray(data, dtype=spec.dtype)
     T = data.shape[1]
@@ -696,14 +716,6 @@ def estimate_steps(spec: ModelSpec, data, all_params, param_groups: Sequence[str
     all_params = np.asarray(all_params, dtype=np.float64)
     if all_params.ndim == 1:
         all_params = all_params[:, None]
-    all_params = try_initializations(spec, all_params[:, 0], data, max_tries=max_tries,
-                                     start=start, end=end,
-                                     _force_scan=_force_scan)
-    n_starts = all_params.shape[1]
-    raw = np.stack(
-        [_sanitize(np.asarray(untransform_params(spec, jnp.asarray(c)))) for c in all_params.T],
-        axis=1,
-    )  # (P, S)
 
     _loss = _jitted_loss(spec, T)
     _start_j, _end_j = jnp.asarray(start), jnp.asarray(end)
@@ -711,34 +723,78 @@ def estimate_steps(spec: ModelSpec, data, all_params, param_groups: Sequence[str
     def loss_at(p):
         return _loss(transform_params(spec, p), data, _start_j, _end_j)
 
-    # validity rescue on the first start (optimization.jl:173-184)
-    ll0 = float(loss_at(jnp.asarray(raw[:, 0], dtype=spec.dtype)))
-    for _ in range(10):
-        if np.isfinite(ll0):
-            break
-        raw[:, 0] *= 0.95
+    use_ssd = _ssd_kernel_enabled(spec) and not _force_scan
+    sig = None
+    state = None
+    if checkpoint is not None:
+        # everything that determines the cascade's trajectory besides the
+        # data panel itself — including a digest of the caller's initial
+        # parameters and the loss engine; a mismatch silently discards the
+        # checkpoint
+        import hashlib
+
+        init_digest = hashlib.sha1(
+            np.ascontiguousarray(all_params).tobytes()).hexdigest()
+        sig = dict(model=spec.model_string, T=int(T), start=int(start),
+                   end=int(end), groups=",".join(param_groups),
+                   tol=repr(float(tol)),
+                   max_group_iters=int(max_group_iters),
+                   max_tries=int(max_tries), P=int(all_params.shape[0]),
+                   init=init_digest,
+                   engine="ssd" if use_ssd else "scan")
+        state = checkpoint.load(sig)
+    if state is not None:
+        raw = np.asarray(state["raw"], dtype=np.float64)       # (P, S)
+        X = jnp.asarray(state["X"])                            # (S, P)
+        prev_ll = np.asarray(state["prev_ll"], dtype=np.float64)
+        done = np.asarray(state["done"], dtype=bool)
+        converged = np.asarray(state["converged"], dtype=bool)
+        iters_done = np.asarray(state["iters_done"], dtype=np.int64)
+        ll0 = float(state["ll0"])
+        it0 = int(state["next_it"])
+        first_group_of_run = False  # ≥1 iteration completed before the save
+    else:
+        all_params = try_initializations(spec, all_params[:, 0], data,
+                                         max_tries=max_tries,
+                                         start=start, end=end,
+                                         _force_scan=_force_scan)
+        raw = np.stack(
+            [_sanitize(np.asarray(untransform_params(spec, jnp.asarray(c))))
+             for c in all_params.T],
+            axis=1,
+        )  # (P, S)
+
+        # validity rescue on the first start (optimization.jl:173-184)
         ll0 = float(loss_at(jnp.asarray(raw[:, 0], dtype=spec.dtype)))
+        for _ in range(10):
+            if np.isfinite(ll0):
+                break
+            raw[:, 0] *= 0.95
+            ll0 = float(loss_at(jnp.asarray(raw[:, 0], dtype=spec.dtype)))
+
+        X = jnp.asarray(raw.T, dtype=spec.dtype)          # (S, P)
+        prev_ll = np.full(raw.shape[1], -np.inf)
+        done = np.zeros(raw.shape[1], dtype=bool)    # ΔLL met or aborted
+        converged = np.zeros(raw.shape[1], dtype=bool)  # ΔLL met specifically
+        iters_done = np.zeros(raw.shape[1], dtype=np.int64)
+        it0 = 0
+        first_group_of_run = True
 
     # ---- all starts in lockstep: every group optimization runs the whole
     # start batch through ONE vmapped program (the reference loops starts on
     # one core, optimization.jl:205; round 1 still looped them in Python) ----
-    X = jnp.asarray(raw.T, dtype=spec.dtype)          # (S, P)
-    S = n_starts
-    use_ssd = _ssd_kernel_enabled(spec) and not _force_scan
+    n_starts = S = raw.shape[1]
     batch_loss = (_jitted_ssd_batch_loss if use_ssd
                   else _jitted_batch_loss)(spec, T)
-    prev_ll = np.full(S, -np.inf)
-    done = np.zeros(S, dtype=bool)       # own ΔLL criterion met or aborted
-    converged = np.zeros(S, dtype=bool)  # met the ΔLL criterion specifically
-    iters_done = np.zeros(S, dtype=np.int64)
     inds_by_group = {g: tuple(i for i, gg in enumerate(param_groups) if gg == g)
                      for g in group_ids}
     # loop-invariant: one host-side finiteness scan, not one per group per
     # iteration (the gate pulls the data window to host)
     closed_ok = {g: _msed_closed_applicable(spec, inds_by_group[g], data,
                                             start, end) for g in group_ids}
-    first_group_of_run = True
-    for it in range(max_group_iters):
+    for it in range(it0, max_group_iters):
+        if done.all():
+            break
         aborted = np.zeros(S, dtype=bool)
         for g in group_ids:
             if g == "-1":  # placeholder group skipped (:221-223)
@@ -789,8 +845,16 @@ def estimate_steps(spec: ModelSpec, data, all_params, param_groups: Sequence[str
         # an aborted start keeps its pre-iteration LL (the sequential loop
         # breaks before re-evaluating, optimization.jl:251-257)
         prev_ll = np.where(active & ~aborted, lls, prev_ll)
-        if done.all():
-            break
+        if checkpoint is not None:
+            # persist the iteration boundary BEFORE the chaos seam: a death
+            # past the save is exactly "preempted after iteration ``it``",
+            # and the successor resumes at it+1
+            checkpoint.record_executed()
+            checkpoint.save(sig, dict(
+                raw=raw, X=np.asarray(X), prev_ll=prev_ll, done=done,
+                converged=converged, iters_done=iters_done, ll0=ll0,
+                next_it=it + 1))
+        _chaos.maybe_fail("estimate")
     if printing:
         for j in range(S):
             print(f"✓ LL = {prev_ll[j]} from start {j + 1}")
@@ -813,10 +877,13 @@ def estimate_steps(spec: ModelSpec, data, all_params, param_groups: Sequence[str
         if _fused_disagrees(ll_kern, ll_scan):
             _warn_fused_disagreement("estimate_steps()", ll_kern, ll_scan)
             if _fused_check_mode() == "fallback":
+                # keep checkpointing through the scan re-run: its signature
+                # carries engine="scan", so it ignores the fused state and
+                # overwrites the file with its own resumable progress
                 return estimate_steps(spec, data, all_params, param_groups,
                                       max_group_iters, tol, optimizers,
                                       start, end, max_tries, printing,
-                                      _force_scan=True)
+                                      _force_scan=True, checkpoint=checkpoint)
     if printing:
         print(f"✓ Best overall LL = {prev_ll[best_j]} from start {best_j + 1}")
     return init, float(prev_ll[best_j]), best, Convergence(

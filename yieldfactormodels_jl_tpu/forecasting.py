@@ -37,9 +37,12 @@ from .estimation import optimize as opt
 from .models import api
 from .models.params import transform_params, untransform_params
 from .models.specs import ModelSpec
+from .orchestration import chaos
+from .orchestration.checkpoint import WindowCheckpoint
 from .parallel.multihost import sweep_stale_locks
 from .persistence import database as db
-from .persistence.locks import acquire_task_lock, release_task_lock
+from .persistence.locks import (acquire_task_lock, break_stale_lock,
+                                release_task_lock, task_lock_path)
 from .utils.profiling import StageTimer
 
 
@@ -55,18 +58,115 @@ def _lockroot(spec: ModelSpec) -> str:
     return os.path.join(spec.results_location, "db", "locks")
 
 
+def default_checkpoint_root(spec: ModelSpec) -> str:
+    return os.path.join(spec.results_location, "db", "checkpoints")
+
+
+def _lock_ttl(stale_lock_ttl: float | None) -> float | None:
+    """Effective TTL for breaking a held-but-stale task lock: the driver's
+    ``stale_lock_ttl`` argument, else ``YFM_LOCK_TTL`` (seconds), else None
+    (legacy behavior: a held lock is always trusted)."""
+    if stale_lock_ttl is not None:
+        return stale_lock_ttl
+    env = os.environ.get("YFM_LOCK_TTL", "")
+    return float(env) if env else None
+
+
 def _estimate_for_window(spec: ModelSpec, data, task_id: int, all_params,
-                         param_groups, max_group_iters, group_tol):
-    """run_estimation! equivalent on the expanding sample data[:, :task_id]."""
+                         param_groups, max_group_iters, group_tol,
+                         checkpoint: WindowCheckpoint | None = None):
+    """run_estimation! equivalent on the expanding sample data[:, :task_id].
+
+    ``checkpoint``: per-window multi-start resume state (orchestration
+    layer); only the block-coordinate path has iteration boundaries to
+    checkpoint — plain multi-start LBFGS is one jitted program.
+    """
     if param_groups:
         _, loss, params, _ = opt.estimate_steps(
             spec, data, all_params, param_groups,
             max_group_iters=max_group_iters, tol=group_tol,
-            start=0, end=task_id,
+            start=0, end=task_id, checkpoint=checkpoint,
         )
     else:
         _, loss, params, _ = opt.estimate(spec, data, all_params, start=0, end=task_id)
     return loss, params
+
+
+def run_single_window_task(
+    spec: ModelSpec, data, thread_id: str, task_id: int, window_type: str,
+    in_sample_end: int, in_sample_start: int, forecast_horizon: int,
+    all_params, *, param_groups=(), max_group_iters: int = 10,
+    group_tol: float = 1e-8, reestimate: bool = True,
+    timer: StageTimer | None = None, checkpoint_root: str | None = None,
+    sentinel_policy: str = "save",
+) -> str:
+    """ONE origin's estimate → forecast → shard write; returns the shard path.
+
+    The unit of work both drivers share: the in-process loop in
+    :func:`run_forecast_window_database` and the leased-queue supervisor
+    (``orchestration/supervisor.py``).  Idempotent by the artifact contract
+    (re-running overwrites the same keyed row).  With ``checkpoint_root``
+    set, multi-start estimation progress is persisted per group iteration
+    and resumed after a crash; the checkpoint is cleared only after the
+    shard is durably written.  ``sentinel_policy="retry"`` turns a
+    non-finite estimated loss into a :class:`~..orchestration.retry.
+    SentinelFailure` instead of saving it (the queue's retry/quarantine
+    path); ``"save"`` keeps the reference behavior of persisting the NULL
+    loss.
+    """
+    data = np.asarray(data, dtype=np.float64)
+    base = _forecast_db_base(spec, window_type)
+    cur = db.read_static_params_from_db(spec, task_id, all_params,
+                                        window_type=window_type)
+    ckpt = None
+    if reestimate:
+        if checkpoint_root is not None and param_groups:
+            ckpt = WindowCheckpoint(checkpoint_root, window_type, task_id)
+        from contextlib import nullcontext
+
+        with (timer.stage("estimation") if timer is not None
+              else nullcontext()):
+            loss, params = _estimate_for_window(
+                spec, data, task_id, cur, param_groups, max_group_iters,
+                group_tol, checkpoint=ckpt)
+        if sentinel_policy == "retry" and not np.isfinite(loss):
+            from .orchestration.retry import SentinelFailure
+
+            raise SentinelFailure(
+                f"estimation for {window_type} window {task_id} returned a "
+                f"non-finite loss sentinel ({loss})")
+    else:
+        params = db.read_params_from_db(spec, task_id, cur,
+                                        window_type=window_type)[:, 0]
+        loss = np.nan
+    chaos.maybe_fail("shard_write")
+    fdata = _window_forecast_data(spec, data, task_id, window_type,
+                                  in_sample_end, in_sample_start,
+                                  forecast_horizon)
+    results = api.predict(spec, jnp.asarray(params, dtype=spec.dtype),
+                          jnp.asarray(fdata, dtype=spec.dtype))
+    path = db.save_oos_forecast_sharded(base, spec.model_string, thread_id,
+                                        window_type, task_id, results, loss,
+                                        params,
+                                        forecast_horizon=forecast_horizon)
+    if ckpt is not None:
+        ckpt.clear()  # shard durable; a crash before this just replays fast
+    return path
+
+
+def merge_and_export(spec: ModelSpec, thread_id: str, tasks, window_type: str):
+    """Shared final stage: fold shards into the merged DB, export CSVs.
+
+    The ``merge`` chaos seam lives here so both drivers (lock-loop and
+    supervisor) exercise crash-during-merge recovery: the merge is
+    idempotent until the final rename, so a killed merger's successor just
+    re-runs it."""
+    chaos.maybe_fail("merge")
+    base = _forecast_db_base(spec, window_type)
+    result = db.merge_forecast_shards(base, task_ids=list(tasks),
+                                      delete_shards=True)
+    db.export_all_csv(spec, thread_id, list(tasks), window_type=window_type)
+    return result
 
 
 def run_rolling_forecasts(
@@ -179,12 +279,27 @@ def _batched_window_predicts(spec: ModelSpec, data, task_ids, window_type: str,
             for i, tid in enumerate(his)]
 
 
+def _acquire_or_break(lockroot: str, window_type: str, task_id: int,
+                      ttl: float | None):
+    """Task lock acquire with dead-worker recovery: a held lock whose mtime
+    is older than ``ttl`` is broken (``break_stale_lock``) and re-acquired
+    atomically, fixing the forever-leaked-lock bug on worker crash.  With
+    ``ttl=None`` a held lock is trusted (legacy behavior)."""
+    lockdir = acquire_task_lock(lockroot, window_type, task_id)
+    if lockdir is not None or ttl is None:
+        return lockdir
+    if break_stale_lock(task_lock_path(lockroot, window_type, task_id), ttl):
+        return acquire_task_lock(lockroot, window_type, task_id)
+    return None
+
+
 def run_forecast_window_database(
     spec: ModelSpec, data, thread_id: str, in_sample_end: int, in_sample_start: int,
     forecast_horizon: int, window_type: str, init_params,
     param_groups=(), max_group_iters: int = 10, group_tol: float = 1e-8,
     reestimate: bool = True, printing: bool = True,
     stale_lock_ttl: float | None = None,
+    checkpoint_root: str | None = None,
 ) -> None:
     data = np.asarray(data, dtype=np.float64)
     T = data.shape[1]
@@ -195,6 +310,7 @@ def run_forecast_window_database(
     base = _forecast_db_base(spec, window_type)
     merged = _merged_path(spec, window_type)
     lockroot = _lockroot(spec)
+    ttl = _lock_ttl(stale_lock_ttl)
     if stale_lock_ttl is not None:  # crash recovery (SURVEY.md §5.3 weakness)
         sweep_stale_locks(lockroot, ttl_seconds=stale_lock_ttl)
 
@@ -220,29 +336,16 @@ def run_forecast_window_database(
     for task_id in tasks:
         if os.path.isfile(db.forecast_path(base, task_id)):
             continue
-        lockdir = acquire_task_lock(lockroot, window_type, task_id)
+        lockdir = _acquire_or_break(lockroot, window_type, task_id, ttl)
         if lockdir is None:
             continue
         try:
-            cur = db.read_static_params_from_db(spec, task_id, all_params,
-                                                window_type=window_type)
-            if reestimate:
-                with timer.stage("estimation"):
-                    loss, params = _estimate_for_window(
-                        spec, data, task_id, cur, param_groups, max_group_iters,
-                        group_tol)
-            else:
-                params = db.read_params_from_db(spec, task_id, cur,
-                                                window_type=window_type)[:, 0]
-                loss = np.nan
-            fdata = _window_forecast_data(spec, data, task_id, window_type,
-                                          in_sample_end, in_sample_start,
-                                          forecast_horizon)
-            results = api.predict(spec, jnp.asarray(params, dtype=spec.dtype),
-                                  jnp.asarray(fdata, dtype=spec.dtype))
-            db.save_oos_forecast_sharded(base, spec.model_string, thread_id,
-                                         window_type, task_id, results, loss,
-                                         params, forecast_horizon=forecast_horizon)
+            run_single_window_task(
+                spec, data, thread_id, task_id, window_type, in_sample_end,
+                in_sample_start, forecast_horizon, all_params,
+                param_groups=param_groups, max_group_iters=max_group_iters,
+                group_tol=group_tol, reestimate=reestimate, timer=timer,
+                checkpoint_root=checkpoint_root)
             if printing and timer.counts["estimation"]:
                 print(f"Thread {thread_id}: {timer.counts['estimation']} estimations, "
                       f"avg {timer.mean('estimation'):.2f}s/task")
@@ -250,12 +353,11 @@ def run_forecast_window_database(
             release_task_lock(lockdir)
 
     if all(os.path.isfile(db.forecast_path(base, t)) for t in tasks):
-        lockdir = acquire_task_lock(lockroot, window_type, 0)
+        lockdir = _acquire_or_break(lockroot, window_type, 0, ttl)
         if lockdir is None:
             return
         try:
-            db.merge_forecast_shards(base, task_ids=tasks, delete_shards=True)
-            db.export_all_csv(spec, thread_id, tasks, window_type=window_type)
+            merge_and_export(spec, thread_id, tasks, window_type)
         finally:
             release_task_lock(lockdir)
 
@@ -293,10 +395,11 @@ def run_forecast_window_batched(
         all_params = all_params[:, None]
 
     todo = [t for t in tasks if not os.path.isfile(db.forecast_path(base, t))]
+    ttl = _lock_ttl(stale_lock_ttl)
     locks = {}
     claimed = []
     for t in todo:
-        ld = acquire_task_lock(lockroot, window_type, t)
+        ld = _acquire_or_break(lockroot, window_type, t, ttl)
         if ld is not None:
             locks[t] = ld
             claimed.append(t)
@@ -343,12 +446,11 @@ def run_forecast_window_batched(
             release_task_lock(ld)
 
     if all(os.path.isfile(db.forecast_path(base, t)) for t in tasks):
-        lockdir = acquire_task_lock(lockroot, window_type, 0)
+        lockdir = _acquire_or_break(lockroot, window_type, 0, ttl)
         if lockdir is None:
             return
         try:
-            db.merge_forecast_shards(base, task_ids=tasks, delete_shards=True)
-            db.export_all_csv(spec, thread_id, tasks, window_type=window_type)
+            merge_and_export(spec, thread_id, tasks, window_type)
         finally:
             release_task_lock(lockdir)
 

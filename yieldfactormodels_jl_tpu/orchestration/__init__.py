@@ -1,0 +1,54 @@
+"""Fault-tolerant work distribution for rolling-window estimation.
+
+Supersedes the reference's bare ``mkdir`` task locks (forecasting.jl:53-79,
+kept in ``persistence/locks.py`` as the degraded fallback) with a
+crash-tolerant queue/lease/checkpoint stack for preemptible fleets
+(docs/DESIGN.md §10):
+
+- ``queue``      — SQLite-journaled task queue: heartbeat leases, TTL expiry,
+  atomic lease steal of dead workers, mkdir-lock degraded mode.
+- ``checkpoint`` — per-window multi-start estimation progress persisted after
+  every block-coordinate group iteration, so a preempted worker's successor
+  resumes the cascade instead of refitting from scratch.
+- ``retry``      — exponential backoff with jitter, bounded attempts,
+  poison-task quarantine with recorded failure cause.
+- ``supervisor`` — the worker loop (claim → heartbeat → estimate →
+  shard-write → complete) plus a ``status()`` progress/straggler report.
+- ``chaos``      — env-gated deterministic fault injection (``YFM_CHAOS``)
+  at the estimation / shard-write / merge seams.
+
+Submodules are exposed lazily (PEP 562): ``supervisor`` imports the
+forecasting driver, which itself imports ``chaos``/``checkpoint`` — a light
+package ``__init__`` keeps that loop open.
+"""
+
+from __future__ import annotations
+
+_SUBMODULES = ("chaos", "checkpoint", "queue", "retry", "supervisor")
+
+_EXPORTS = {
+    "ChaosInjected": "chaos",
+    "TaskQueue": "queue",
+    "Lease": "queue",
+    "WindowCheckpoint": "checkpoint",
+    "RetryPolicy": "retry",
+    "SentinelFailure": "retry",
+    "backoff_delay": "retry",
+    "run_worker": "supervisor",
+    "run_orchestrated": "supervisor",
+    "status": "supervisor",
+    "format_status": "supervisor",
+}
+
+__all__ = list(_SUBMODULES) + list(_EXPORTS)
+
+
+def __getattr__(name):
+    import importlib
+
+    if name in _SUBMODULES:
+        return importlib.import_module(f".{name}", __name__)
+    if name in _EXPORTS:
+        mod = importlib.import_module(f".{_EXPORTS[name]}", __name__)
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,115 @@
+"""Deterministic fault injection at the orchestration seams.
+
+Recovery paths (lease steal, checkpoint resume, idempotent re-merge) are only
+trustworthy if they are exercised, and SIGKILLing pytest workers is neither
+portable nor deterministic.  Instead the drivers call :func:`maybe_fail` at
+three seams — ``estimate`` (once per checkpointed group iteration,
+estimation/optimize.py), ``shard_write`` (before a task's shard insert) and
+``merge`` (before the shard merge), both in forecasting.py — and an armed
+seam raises :class:`ChaosInjected`.  The supervisor treats that exception as
+a simulated worker death: stop heartbeating, abandon the lease, exit.  The
+lease then expires by TTL and a surviving worker steals + resumes, exactly
+the path a real preemption takes.
+
+Arming is env-gated and off by default:
+
+- ``YFM_CHAOS``: comma-separated ``seam:trigger`` specs.  A trigger is either
+  ``@N`` (raise on the N-th hit of that seam — fully deterministic) or a
+  probability in (0, 1] drawn from a seeded RNG, e.g.
+  ``YFM_CHAOS="estimate:@3,shard_write:0.05"``.
+- ``YFM_CHAOS_SEED``: seed for probability triggers (default ``0``) so chaos
+  runs replay bit-for-bit.
+
+Tests and benchmarks arm programmatically via :func:`configure` /
+:func:`reset` (reset also re-reads the environment on the next hit).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+from typing import Dict, Optional, Tuple
+
+
+class ChaosInjected(RuntimeError):
+    """Simulated worker death injected at an orchestration seam."""
+
+
+class _Config:
+    def __init__(self, spec: str, seed: int):
+        #: seam -> ("count", N) | ("prob", p)
+        self.arms: Dict[str, Tuple[str, float]] = {}
+        for tok in filter(None, (t.strip() for t in spec.split(","))):
+            seam, _, trig = tok.partition(":")
+            if not trig:
+                raise ValueError(f"YFM_CHAOS entry {tok!r} lacks a trigger "
+                                 f"(want 'seam:@N' or 'seam:prob')")
+            if trig.startswith("@"):
+                self.arms[seam] = ("count", int(trig[1:]))
+            else:
+                p = float(trig)
+                if not 0.0 < p <= 1.0:
+                    raise ValueError(f"YFM_CHAOS probability {p} not in (0, 1]")
+                self.arms[seam] = ("prob", p)
+        self.rng = random.Random(seed)
+
+
+_lock = threading.Lock()
+_config: Optional[_Config] = None
+_env_checked = False
+_hits: Dict[str, int] = {}
+
+
+def configure(spec: Optional[str], seed: int = 0) -> None:
+    """Arm chaos programmatically (``spec`` as in ``YFM_CHAOS``; ``None``
+    disarms).  Resets hit counters."""
+    global _config, _env_checked
+    with _lock:
+        _config = _Config(spec, seed) if spec else None
+        _env_checked = True  # programmatic config overrides the environment
+        _hits.clear()
+
+
+def reset() -> None:
+    """Disarm and forget counters; the environment is re-read on next hit."""
+    global _config, _env_checked
+    with _lock:
+        _config = None
+        _env_checked = False
+        _hits.clear()
+
+
+def hits(seam: str) -> int:
+    """How many times ``seam`` was reached since the last configure/reset."""
+    with _lock:
+        return _hits.get(seam, 0)
+
+
+def maybe_fail(seam: str) -> None:
+    """Raise :class:`ChaosInjected` if ``seam`` is armed and triggers.
+
+    No-op (one dict lookup) when chaos is disarmed — safe on hot driver
+    paths.  Thread-safe: concurrent in-process workers share the counters,
+    so ``@N`` kills whichever worker reaches the seam N-th, like a real
+    preemption would.
+    """
+    global _config, _env_checked
+    with _lock:
+        if not _env_checked:
+            spec = os.environ.get("YFM_CHAOS", "")
+            seed = int(os.environ.get("YFM_CHAOS_SEED", "0"))
+            _config = _Config(spec, seed) if spec else None
+            _env_checked = True
+        _hits[seam] = _hits.get(seam, 0) + 1
+        if _config is None:
+            return
+        arm = _config.arms.get(seam)
+        if arm is None:
+            return
+        kind, val = arm
+        fire = (_hits[seam] == val) if kind == "count" \
+            else (_config.rng.random() < val)
+    if fire:
+        raise ChaosInjected(f"chaos: injected fault at seam {seam!r} "
+                            f"(hit {hits(seam)})")

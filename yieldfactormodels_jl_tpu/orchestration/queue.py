@@ -1,0 +1,361 @@
+"""SQLite-journaled task queue with heartbeat leases and TTL lease steal.
+
+The reference's coordination layer (atomic ``mkdir`` locks + idempotent
+shards) cannot distinguish "worker is computing" from "worker is dead" — a
+SIGKILLed worker's lock starves its task forever (SURVEY §5.3).  This queue
+makes liveness explicit: a claim takes a *lease* with a TTL, the worker
+heartbeats it while computing, and any worker may atomically steal a lease
+whose TTL expired.  Work state is journaled in one SQLite file (WAL,
+``busy_timeout``, IMMEDIATE transactions — the same discipline as the shard
+DBs in ``persistence/database.py``), so ``status()`` reports and retry /
+quarantine bookkeeping survive every process involved dying.
+
+Lease integrity: each claim issues a random token; ``heartbeat`` /
+``complete`` / ``fail`` are conditional updates on (owner, token), so a
+stolen worker's late writes are rejected instead of corrupting the new
+owner's lease.  Task *effects* (shard files) are idempotent regardless —
+the token guard protects queue state, the artifact contract protects data.
+
+Degraded mode: when the journal DB is unreachable (``sqlite3.Error`` on
+connect — e.g. the shared filesystem dropped), the queue falls back to the
+reference's mkdir-lock protocol under ``fallback_lockroot``: claims are
+``mkdir``, heartbeats are ``utime`` on the lock dir, TTL steal is
+``break_stale_lock`` (persistence/locks.py).  Completion tracking is
+process-local in that mode; cross-process dedup degrades to the shard
+existence checks, exactly the reference's semantics.
+
+``YFM_LEASE_TTL`` sets the default lease TTL in seconds (default 60).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import secrets
+import sqlite3
+import time
+from typing import Dict, List, NamedTuple, Optional, Sequence
+
+from ..persistence.locks import break_stale_lock
+
+_SCHEMA = """
+    CREATE TABLE IF NOT EXISTS tasks(
+        task_key     TEXT PRIMARY KEY,
+        status       TEXT NOT NULL DEFAULT 'pending',
+        owner        TEXT,
+        token        TEXT,
+        lease_ttl    REAL,
+        lease_expires REAL,
+        first_leased REAL,
+        not_before   REAL NOT NULL DEFAULT 0,
+        attempts     INTEGER NOT NULL DEFAULT 0,
+        last_error   TEXT,
+        enqueued_at  REAL,
+        done_at      REAL
+    );
+"""
+
+#: queue task states: pending -> leased -> done | pending (retry w/ backoff)
+#:                                      -> quarantined (poison, attempts spent)
+STATUSES = ("pending", "leased", "done", "quarantined")
+
+
+def default_lease_ttl() -> float:
+    """``YFM_LEASE_TTL`` (seconds), default 60 — read per call so tests and
+    workers can retune without re-importing."""
+    return float(os.environ.get("YFM_LEASE_TTL", "60"))
+
+
+class Lease(NamedTuple):
+    key: str
+    owner: str
+    token: str
+    attempts: int
+
+
+class LeaseLost(RuntimeError):
+    """The lease was stolen (TTL expiry) before this write landed."""
+
+
+def _sanitize(key: str) -> str:
+    return re.sub(r"[^A-Za-z0-9._-]", "_", key)
+
+
+class TaskQueue:
+    """One queue = one SQLite file; any number of workers/processes."""
+
+    def __init__(self, path: str, fallback_lockroot: Optional[str] = None):
+        self.path = path
+        self.fallback_lockroot = fallback_lockroot or path + ".locks"
+        self.degraded = False
+        # in-memory mirrors for degraded mode (and for claim iteration order)
+        self._keys: List[str] = []
+        self._done: set = set()
+        self._quarantined: Dict[str, str] = {}
+        self._attempts: Dict[str, int] = {}
+        try:
+            self._with_db(lambda db: None)
+        except sqlite3.Error:
+            self.degraded = True
+
+    # -- journal plumbing ---------------------------------------------------
+
+    def _connect(self) -> sqlite3.Connection:
+        try:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        except OSError as e:
+            # unreachable journal location (e.g. parent is a file, or the
+            # shared filesystem dropped) — same degraded-mode trigger as a
+            # failed connect
+            raise sqlite3.OperationalError(f"queue dir unavailable: {e}")
+        from ..persistence.database import open_wal_db
+
+        db = open_wal_db(self.path)
+        db.execute(_SCHEMA)
+        return db
+
+    def _with_db(self, fn):
+        """Run ``fn(db)`` in one IMMEDIATE transaction; sticky-degrade on
+        an unreachable journal (the mkdir fallback takes over)."""
+        if self.degraded:
+            raise sqlite3.OperationalError("queue journal degraded")
+        db = self._connect()
+        try:
+            db.execute("BEGIN IMMEDIATE;")
+            out = fn(db)
+            db.commit()
+            return out
+        except BaseException:
+            try:
+                db.rollback()
+            except sqlite3.Error:
+                pass
+            raise
+        finally:
+            db.close()
+
+    def _call(self, fn, fallback):
+        try:
+            return self._with_db(fn)
+        except sqlite3.Error:
+            self.degraded = True
+            return fallback()
+
+    # -- enqueue ------------------------------------------------------------
+
+    def enqueue(self, keys: Sequence[str]) -> int:
+        """Idempotent: INSERT OR IGNORE; returns number of NEW tasks."""
+        keys = list(keys)
+        for k in keys:
+            if k not in self._keys:
+                self._keys.append(k)
+        now = time.time()
+
+        def ins(db):
+            n = 0
+            for k in keys:
+                cur = db.execute(
+                    "INSERT OR IGNORE INTO tasks(task_key, enqueued_at) "
+                    "VALUES(?, ?)", (k, now))
+                n += cur.rowcount
+            return n
+
+        return self._call(ins, lambda: len(keys))
+
+    # -- claim / heartbeat / terminal transitions ---------------------------
+
+    def claim(self, owner: str, ttl: Optional[float] = None) -> Optional[Lease]:
+        """Claim a runnable task: pending past its backoff, or leased with an
+        EXPIRED lease (atomic steal of a dead worker's task)."""
+        ttl = default_lease_ttl() if ttl is None else float(ttl)
+        now = time.time()
+        token = secrets.token_hex(8)
+
+        def pick(db):
+            row = db.execute(
+                "SELECT task_key, attempts FROM tasks WHERE "
+                "(status='pending' AND not_before<=?) OR "
+                "(status='leased' AND lease_expires<?) "
+                "ORDER BY enqueued_at, task_key LIMIT 1", (now, now)).fetchone()
+            if row is None:
+                return None
+            key, attempts = row
+            db.execute(
+                "UPDATE tasks SET status='leased', owner=?, token=?, "
+                "lease_ttl=?, lease_expires=?, "
+                "first_leased=COALESCE(first_leased, ?), attempts=attempts+1 "
+                "WHERE task_key=?", (owner, token, ttl, now + ttl, now, key))
+            return Lease(key, owner, token, attempts + 1)
+
+        return self._call(pick, lambda: self._claim_fallback(owner, ttl))
+
+    def heartbeat(self, lease: Lease, ttl: Optional[float] = None) -> bool:
+        """Extend the lease; False (not an exception) when it was stolen —
+        the heartbeat thread polls this and must not kill the worker."""
+        ttl = default_lease_ttl() if ttl is None else float(ttl)
+
+        def beat(db):
+            cur = db.execute(
+                "UPDATE tasks SET lease_expires=? "
+                "WHERE task_key=? AND owner=? AND token=? AND status='leased'",
+                (time.time() + ttl, lease.key, lease.owner, lease.token))
+            return cur.rowcount == 1
+
+        return self._call(beat, lambda: self._heartbeat_fallback(lease))
+
+    def _guarded(self, lease: Lease, sql: str, args: tuple, fallback) -> None:
+        """Conditional lease-holder update; LeaseLost if stolen; degraded
+        fallback if the journal went away mid-run."""
+        def upd(db):
+            cur = db.execute(sql, args + (lease.key, lease.owner, lease.token))
+            if cur.rowcount != 1:
+                raise LeaseLost(f"lease on {lease.key!r} no longer held by "
+                                f"{lease.owner!r}")
+
+        try:
+            self._with_db(upd)
+        except sqlite3.Error:
+            self.degraded = True
+            fallback()
+
+    def complete(self, lease: Lease) -> None:
+        self._guarded(
+            lease,
+            "UPDATE tasks SET status='done', done_at=?, owner=NULL, "
+            "token=NULL WHERE task_key=? AND owner=? AND token=?",
+            (time.time(),),
+            lambda: self._complete_fallback(lease))
+
+    def fail(self, lease: Lease, error: str, retry_in: float = 0.0,
+             quarantine: bool = False) -> None:
+        """Record a failure: back to pending after ``retry_in`` seconds, or
+        straight to quarantined (poison task) with the cause on record."""
+        status = "quarantined" if quarantine else "pending"
+        self._guarded(
+            lease,
+            "UPDATE tasks SET status=?, last_error=?, not_before=?, "
+            "owner=NULL, token=NULL WHERE task_key=? AND owner=? AND token=?",
+            (status, str(error)[:2000], time.time() + max(0.0, retry_in)),
+            lambda: self._fail_fallback(lease, error, quarantine))
+
+    def release(self, lease: Lease, retry_in: float = 0.0) -> None:
+        """Give a claim back WITHOUT burning an attempt (e.g. a merge task
+        claimed before its precondition — all shards present — holds)."""
+        def fb():
+            self._attempts[lease.key] = max(
+                0, self._attempts.get(lease.key, 1) - 1)
+            self._release_lock(lease.key)
+
+        self._guarded(
+            lease,
+            "UPDATE tasks SET status='pending', not_before=?, "
+            "attempts=attempts-1, owner=NULL, token=NULL "
+            "WHERE task_key=? AND owner=? AND token=?",
+            (time.time() + max(0.0, retry_in),), fb)
+
+    # -- introspection ------------------------------------------------------
+
+    def counts(self) -> Dict[str, int]:
+        def cnt(db):
+            rows = db.execute(
+                "SELECT status, COUNT(*) FROM tasks GROUP BY status").fetchall()
+            return {s: 0 for s in STATUSES} | dict(rows)
+
+        def cnt_fallback():
+            out = {s: 0 for s in STATUSES}
+            for k in self._keys:
+                if k in self._done:
+                    out["done"] += 1
+                elif k in self._quarantined:
+                    out["quarantined"] += 1
+                else:
+                    out["pending"] += 1
+            return out
+
+        return self._call(cnt, cnt_fallback)
+
+    def snapshot(self) -> List[dict]:
+        """Every task's row as a dict (the ``status()`` report's raw feed)."""
+        def rows(db):
+            cols = ("task_key", "status", "owner", "lease_ttl",
+                    "lease_expires", "first_leased", "not_before", "attempts",
+                    "last_error", "enqueued_at", "done_at")
+            got = db.execute(
+                f"SELECT {', '.join(cols)} FROM tasks "
+                "ORDER BY enqueued_at, task_key").fetchall()
+            return [dict(zip(cols, r)) for r in got]
+
+        def rows_fallback():
+            return [dict(task_key=k,
+                         status=("done" if k in self._done else
+                                 "quarantined" if k in self._quarantined else
+                                 "pending"),
+                         owner=None, lease_ttl=None, lease_expires=None,
+                         first_leased=None, not_before=0,
+                         attempts=self._attempts.get(k, 0),
+                         last_error=self._quarantined.get(k),
+                         enqueued_at=None, done_at=None)
+                    for k in self._keys]
+
+        return self._call(rows, rows_fallback)
+
+    def all_terminal(self) -> bool:
+        """No task is pending or leased (everything done or quarantined)."""
+        c = self.counts()
+        return c["pending"] == 0 and c["leased"] == 0
+
+    def statuses(self, keys: Sequence[str]) -> Dict[str, str]:
+        snap = {r["task_key"]: r["status"] for r in self.snapshot()}
+        return {k: snap.get(k, "unknown") for k in keys}
+
+    # -- degraded mode: the reference's mkdir protocol ----------------------
+
+    def _lockdir(self, key: str) -> str:
+        return os.path.join(self.fallback_lockroot, _sanitize(key) + ".lock")
+
+    def _release_lock(self, key: str) -> None:
+        try:
+            os.rmdir(self._lockdir(key))
+        except OSError:
+            pass
+
+    def _claim_fallback(self, owner: str, ttl: float) -> Optional[Lease]:
+        os.makedirs(self.fallback_lockroot, exist_ok=True)
+        for key in self._keys:
+            if key in self._done or key in self._quarantined:
+                continue
+            lockdir = self._lockdir(key)
+            try:
+                os.mkdir(lockdir)
+            except FileExistsError:
+                # dead-worker recovery, mkdir edition: steal on stale mtime
+                if not break_stale_lock(lockdir, ttl):
+                    continue
+                try:
+                    os.mkdir(lockdir)
+                except FileExistsError:
+                    continue
+            self._attempts[key] = self._attempts.get(key, 0) + 1
+            return Lease(key, owner, "mkdir", self._attempts[key])
+        return None
+
+    def _heartbeat_fallback(self, lease: Lease) -> bool:
+        lockdir = self._lockdir(lease.key)
+        if not os.path.isdir(lockdir):
+            return False
+        now = time.time()
+        try:
+            os.utime(lockdir, (now, now))
+            return True
+        except OSError:
+            return False
+
+    def _complete_fallback(self, lease: Lease) -> None:
+        self._done.add(lease.key)
+        self._release_lock(lease.key)
+
+    def _fail_fallback(self, lease: Lease, error: str,
+                       quarantine: bool) -> None:
+        if quarantine:
+            self._quarantined[lease.key] = str(error)[:2000]
+        self._release_lock(lease.key)

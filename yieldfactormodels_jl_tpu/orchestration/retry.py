@@ -1,0 +1,49 @@
+"""Retry policy: exponential backoff with jitter, bounded attempts.
+
+The sentinel convention (docs/DESIGN.md §4) keeps failures silent inside
+jitted code — losses go to −Inf, moments to NaN — and loud only at the
+driver.  The orchestration layer adds the third tier: at the TASK boundary a
+sentinel (or a driver-layer exception) becomes a *retriable task failure*
+with exponential backoff, and after ``max_attempts`` the task is quarantined
+in the queue with its recorded failure cause instead of poisoning the worker
+loop forever.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import NamedTuple, Optional
+
+
+class SentinelFailure(RuntimeError):
+    """A sentinel value (−Inf loss / NaN moments) surfaced at the task
+    boundary — retriable, since transient numeric blowups can depend on the
+    warm-start cascade's state at claim time."""
+
+
+class RetryPolicy(NamedTuple):
+    """Bounded exponential backoff with multiplicative jitter.
+
+    Delay for attempt ``k`` (1-based) is
+    ``min(max_delay, base_delay * factor**(k-1)) * (1 + U(0, jitter))`` —
+    jitter decorrelates a fleet of workers retrying the same poisoned task.
+    """
+    max_attempts: int = 3
+    base_delay: float = 0.5
+    factor: float = 2.0
+    max_delay: float = 30.0
+    jitter: float = 0.5
+
+
+def backoff_delay(policy: RetryPolicy, attempt: int,
+                  rng: Optional[random.Random] = None) -> float:
+    """Delay before re-running a task that just failed its ``attempt``-th try."""
+    base = min(policy.max_delay,
+               policy.base_delay * policy.factor ** max(0, attempt - 1))
+    u = (rng or random).random()
+    return base * (1.0 + policy.jitter * u)
+
+
+def should_quarantine(policy: RetryPolicy, attempts: int) -> bool:
+    """True once a task has burned its attempt budget (poison task)."""
+    return attempts >= policy.max_attempts

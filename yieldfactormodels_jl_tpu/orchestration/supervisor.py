@@ -1,0 +1,333 @@
+"""Worker loop driving rolling-forecast tasks off the leased queue.
+
+One task = one (window_type, task_id) origin of ``run_rolling_forecasts``;
+each window type additionally gets one ``merge:<wt>`` task gated on every
+shard existing.  The loop per claim:
+
+    claim → heartbeat thread → estimate (checkpointed) → shard write
+          → complete
+
+with failures routed through ``retry``: ordinary exceptions and sentinel
+losses (−Inf at the driver boundary) send the task back to pending with
+exponential backoff, and after ``RetryPolicy.max_attempts`` the task is
+quarantined with its failure cause on record.  A :class:`chaos.ChaosInjected`
+is handled as a simulated worker DEATH — the worker stops heartbeating and
+exits without touching the queue, so the lease expires by TTL and a
+surviving/restarted worker steals it and resumes from the window checkpoint
+(the crash-recovery contract pinned by tests/test_orchestration.py).
+
+``run_orchestrated`` runs N workers as in-process threads (tests, the
+``BENCH_ORCH=1`` bench, single-host fills); on a real fleet each host just
+calls ``run_worker`` against the shared queue path.  ``status()`` renders
+the queue journal into a progress/straggler report without touching any
+worker.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import uuid
+from typing import List, NamedTuple, Optional, Sequence
+
+import numpy as np
+
+import contextlib
+
+from . import chaos
+from .checkpoint import WindowCheckpoint  # noqa: F401  (re-export for callers)
+from .queue import Lease, LeaseLost, TaskQueue, default_lease_ttl
+from .retry import RetryPolicy, backoff_delay, should_quarantine
+
+
+def _ignore_lease_lost():
+    """A stolen lease makes the loser's queue transition moot (idempotent
+    effects; the thief drives the task now)."""
+    return contextlib.suppress(LeaseLost)
+
+
+class WorkerStats(NamedTuple):
+    worker_id: str
+    completed: int
+    failed: int
+    stolen: int          # claims that took over an expired lease
+    died: bool           # exited via an injected (or real) preemption signal
+    merged: List[str]    # window types whose merge+export this worker ran
+
+
+def default_queue_path(spec) -> str:
+    return os.path.join(spec.results_location, "db", "queue.sqlite3")
+
+
+def _window_types(window_type: str) -> List[str]:
+    if window_type == "both":
+        return ["expanding", "moving"]
+    if window_type in ("expanding", "moving"):
+        return [window_type]
+    raise ValueError(f"orchestrated runs support expanding/moving/both, "
+                     f"not {window_type!r}")
+
+
+def task_keys(window_type: str, in_sample_end: int, T: int) -> List[str]:
+    """Deterministic task enumeration: every origin of every window type,
+    then one merge barrier per window type."""
+    keys = []
+    for wt in _window_types(window_type):
+        keys += [f"{wt}:{tid}" for tid in range(in_sample_end, T + 1)]
+    keys += [f"merge:{wt}" for wt in _window_types(window_type)]
+    return keys
+
+
+class _Heartbeat(threading.Thread):
+    """Extends the lease every ``interval`` until stopped; a lost lease
+    (stolen after a stall) just stops the beat — the queue's token guard
+    rejects the loser's terminal write later."""
+
+    def __init__(self, q: TaskQueue, lease: Lease, ttl: float, interval: float):
+        super().__init__(daemon=True)
+        self.q, self.lease, self.ttl = q, lease, ttl
+        self.interval = interval
+        self._stop = threading.Event()
+
+    def run(self):
+        while not self._stop.wait(self.interval):
+            if not self.q.heartbeat(self.lease, self.ttl):
+                return
+
+    def stop(self):
+        self._stop.set()
+
+
+class _MergeNotReady(RuntimeError):
+    """Merge claimed before all sibling shards exist — release, no attempt."""
+
+
+def run_worker(
+    spec, data, thread_id: str, in_sample_end: int, in_sample_start: int,
+    forecast_horizon: int, init_params, *,
+    window_type: str = "expanding",
+    worker_id: Optional[str] = None,
+    queue_path: Optional[str] = None,
+    lease_ttl: Optional[float] = None,
+    heartbeat_interval: Optional[float] = None,
+    poll_interval: float = 0.2,
+    retry: RetryPolicy = RetryPolicy(),
+    param_groups: Sequence[str] = (),
+    max_group_iters: int = 10,
+    group_tol: float = 1e-8,
+    reestimate: bool = True,
+    checkpoint_root: Optional[str] = None,
+    wait_for_drain: bool = True,
+    max_tasks: Optional[int] = None,
+) -> WorkerStats:
+    """Run one worker against the (shared) queue until the run is terminal.
+
+    Safe to call from any number of processes/threads with the same
+    arguments: enqueue is idempotent, claims are exclusive, effects are
+    idempotent shards.  Returns this worker's :class:`WorkerStats`.
+    """
+    from .. import forecasting as fc
+
+    data = np.asarray(data, dtype=np.float64)
+    T = data.shape[1]
+    wid = worker_id or f"{os.getpid()}-{uuid.uuid4().hex[:6]}"
+    ttl = default_lease_ttl() if lease_ttl is None else float(lease_ttl)
+    hb_every = heartbeat_interval if heartbeat_interval is not None else ttl / 3.0
+    ckroot = checkpoint_root or fc.default_checkpoint_root(spec)
+
+    all_params = np.asarray(init_params, dtype=np.float64)
+    if all_params.ndim == 1:
+        all_params = all_params[:, None]
+
+    q = TaskQueue(queue_path or default_queue_path(spec),
+                  fallback_lockroot=os.path.join(fc._lockroot(spec), "queue"))
+    keys = task_keys(window_type, in_sample_end, T)
+    q.enqueue(keys)
+    window_tasks = {wt: list(range(in_sample_end, T + 1))
+                    for wt in _window_types(window_type)}
+
+    def execute(key: str) -> None:
+        kind, _, rest = key.partition(":")
+        if kind == "merge":
+            wt = rest
+            from ..persistence import database as pdb
+
+            if os.path.isfile(fc._merged_path(spec, wt)):
+                # a predecessor already merged; re-run only the (idempotent,
+                # merged-DB-sourced) CSV export, in case it died in between
+                pdb.export_all_csv(spec, thread_id, window_tasks[wt],
+                                   window_type=wt)
+                return
+            base = fc._forecast_db_base(spec, wt)
+            # barrier = queue state, not shard-file existence: every sibling
+            # window task must be terminal before folding (a leased task may
+            # still be (re)writing its shard)
+            st = q.statuses([f"{wt}:{t}" for t in window_tasks[wt]])
+            open_tasks = [k for k, s in st.items()
+                          if s not in ("done", "quarantined")]
+            if open_tasks:
+                raise _MergeNotReady(f"{len(open_tasks)} window tasks "
+                                     f"not terminal")
+            missing = [t for t in window_tasks[wt]
+                       if not os.path.isfile(pdb.forecast_path(base, t))]
+            if missing:
+                if all(st[f"{wt}:{t}"] == "quarantined" for t in missing):
+                    raise RuntimeError(
+                        f"cannot merge {wt}: {len(missing)} window tasks "
+                        f"quarantined ({sorted(missing)[:8]}...)")
+                raise _MergeNotReady(f"{len(missing)} shards outstanding")
+            fc.merge_and_export(spec, thread_id, window_tasks[wt], wt)
+            stats["merged"].append(wt)
+            return
+        wt, tid = kind, int(rest)
+        from ..persistence import database as pdb
+
+        base = fc._forecast_db_base(spec, wt)
+        if os.path.isfile(fc._merged_path(spec, wt)) or \
+                os.path.isfile(pdb.forecast_path(base, tid)):
+            return  # idempotent: effect already durable
+        fc.run_single_window_task(
+            spec, data, thread_id, tid, wt, in_sample_end, in_sample_start,
+            forecast_horizon, all_params, param_groups=param_groups,
+            max_group_iters=max_group_iters, group_tol=group_tol,
+            reestimate=reestimate, checkpoint_root=ckroot,
+            sentinel_policy="retry")
+
+    stats = dict(completed=0, failed=0, stolen=0, died=False, merged=[])
+    while True:
+        if max_tasks is not None and stats["completed"] >= max_tasks:
+            break
+        lease = q.claim(wid, ttl)
+        if lease is None:
+            if q.all_terminal() or not wait_for_drain:
+                break
+            time.sleep(poll_interval)  # someone else holds live leases
+            continue
+        if lease.attempts > 1:
+            stats["stolen"] += 1  # expired-lease takeover or post-fail retry
+        hb = _Heartbeat(q, lease, ttl, hb_every)
+        hb.start()
+        try:
+            execute(lease.key)
+        except chaos.ChaosInjected:
+            # simulated preemption: stop beating, abandon the lease AS-IS —
+            # recovery must come from TTL expiry + steal, like a real death
+            hb.stop()
+            stats["died"] = True
+            break
+        except _MergeNotReady:
+            hb.stop()
+            with _ignore_lease_lost():
+                q.release(lease, retry_in=poll_interval)
+            time.sleep(poll_interval)
+        except Exception as e:  # noqa: BLE001  — every failure is recorded
+            hb.stop()
+            stats["failed"] += 1
+            err = f"{type(e).__name__}: {e}"
+            with _ignore_lease_lost():
+                if should_quarantine(retry, lease.attempts):
+                    q.fail(lease, err, quarantine=True)
+                else:
+                    q.fail(lease, err,
+                           retry_in=backoff_delay(retry, lease.attempts))
+        else:
+            hb.stop()
+            try:
+                q.complete(lease)
+                stats["completed"] += 1
+            except LeaseLost:
+                # stalled past our TTL and got stolen mid-task: the effect
+                # (shard) is idempotent and durable, the thief owns the
+                # queue transition now — a benign lost race, not a failure
+                pass
+    return WorkerStats(wid, stats["completed"], stats["failed"],
+                       stats["stolen"], stats["died"], stats["merged"])
+
+
+def run_orchestrated(spec, data, thread_id: str, in_sample_end: int,
+                     in_sample_start: int, forecast_horizon: int, init_params,
+                     *, n_workers: int = 2, **worker_kw) -> List[WorkerStats]:
+    """N in-process workers (threads) against one queue; returns their stats.
+
+    In-process threads share the jit caches, so this is also the cheapest
+    way to fill a single host; cross-host fleets run one ``run_worker`` per
+    process against the same ``queue_path`` on the shared filesystem.
+    """
+    out: List[Optional[WorkerStats]] = [None] * n_workers
+    errs: List[BaseException] = []
+    wid_prefix = worker_kw.pop("worker_id", None) or "w"
+
+    def go(i: int) -> None:
+        try:
+            out[i] = run_worker(spec, data, thread_id, in_sample_end,
+                                in_sample_start, forecast_horizon, init_params,
+                                worker_id=f"{wid_prefix}{i}", **worker_kw)
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=go, args=(i,), daemon=True)
+               for i in range(n_workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errs:
+        raise errs[0]
+    return [s for s in out if s is not None]
+
+
+def status(queue_path: str, straggler_after: Optional[float] = None) -> dict:
+    """Progress/straggler report from the queue journal alone (read-only).
+
+    ``stragglers``: leased tasks first claimed more than ``straggler_after``
+    seconds ago (default 3× their lease TTL) — live-but-slow workers, or
+    tasks cycling through steals."""
+    if not os.path.isfile(queue_path):
+        # read-only means read-only: connecting through TaskQueue would
+        # CREATE an empty journal at a mistyped path and report 0/0 progress
+        raise FileNotFoundError(f"no queue journal at {queue_path!r}")
+    q = TaskQueue(queue_path)
+    now = time.time()
+    snap = q.snapshot()
+    counts = q.counts()
+    running, stragglers, quarantined = [], [], []
+    for r in snap:
+        if r["status"] == "leased":
+            age = now - (r["first_leased"] or now)
+            entry = dict(task=r["task_key"], owner=r["owner"],
+                         age_s=round(age, 3), attempts=r["attempts"],
+                         lease_remaining_s=round(
+                             (r["lease_expires"] or now) - now, 3))
+            running.append(entry)
+            limit = straggler_after if straggler_after is not None \
+                else 3.0 * (r["lease_ttl"] or default_lease_ttl())
+            if age > limit:
+                stragglers.append(entry)
+        elif r["status"] == "quarantined":
+            quarantined.append(dict(task=r["task_key"],
+                                    attempts=r["attempts"],
+                                    error=r["last_error"]))
+    total = max(1, len(snap))
+    return dict(counts=counts, total=len(snap),
+                progress=counts.get("done", 0) / total,
+                running=running, stragglers=stragglers,
+                quarantined=quarantined, degraded=q.degraded)
+
+
+def format_status(queue_path: str, **kw) -> str:
+    """One human line per concern — the ``status()`` dict, rendered."""
+    s = status(queue_path, **kw)
+    c = s["counts"]
+    lines = [f"progress {100 * s['progress']:.1f}%  "
+             f"(done {c['done']}/{s['total']}, pending {c['pending']}, "
+             f"leased {c['leased']}, quarantined {c['quarantined']})"
+             + ("  [DEGRADED: mkdir fallback]" if s["degraded"] else "")]
+    for r in s["running"]:
+        tag = "STRAGGLER " if r in s["stragglers"] else ""
+        lines.append(f"  {tag}{r['task']} @{r['owner']} "
+                     f"age {r['age_s']:.1f}s attempts {r['attempts']}")
+    for r in s["quarantined"]:
+        lines.append(f"  QUARANTINED {r['task']} after {r['attempts']} "
+                     f"attempts: {r['error']}")
+    return "\n".join(lines)

@@ -21,7 +21,6 @@ forecast drivers invoke it when ``stale_lock_ttl`` is set.
 from __future__ import annotations
 
 import os
-import time
 from typing import List, Optional, Sequence
 
 import jax
@@ -57,12 +56,14 @@ def sweep_stale_locks(lockroot: str, ttl_seconds: float = 3600.0) -> List[str]:
     """Remove lock dirs older than ``ttl_seconds`` (crash recovery).
 
     The reference never expires locks, so a SIGKILLed worker permanently
-    starves its task (SURVEY.md §5.3).  Locks are re-acquired atomically after
-    removal, so the worst case of an aggressive TTL is duplicated work on an
-    idempotent shard — never corruption.
+    starves its task (SURVEY.md §5.3).  The per-dir primitive (atomicity,
+    worst-case analysis) is ``persistence.locks.break_stale_lock``; this is
+    the whole-tree sweep the forecast drivers run at entry when
+    ``stale_lock_ttl`` is set.
     """
+    from ..persistence.locks import break_stale_lock
+
     removed = []
-    now = time.time()
     if not os.path.isdir(lockroot):
         return removed
     for window in os.listdir(lockroot):
@@ -73,10 +74,6 @@ def sweep_stale_locks(lockroot: str, ttl_seconds: float = 3600.0) -> List[str]:
             if not name.endswith(".lock"):
                 continue
             path = os.path.join(wdir, name)
-            try:
-                if now - os.path.getmtime(path) > ttl_seconds:
-                    os.rmdir(path)
-                    removed.append(path)
-            except OSError:
-                pass
+            if break_stale_lock(path, ttl_seconds):
+                removed.append(path)
     return removed

@@ -18,6 +18,7 @@ import io as _io
 import os
 import sqlite3
 import threading
+import uuid
 from typing import Dict, Optional, Sequence
 
 import numpy as np
@@ -58,6 +59,19 @@ def forecast_path(base: str, k: int) -> str:
     return base if k == 0 else base.replace(".sqlite3", f"_{k}.sqlite3")
 
 
+def open_wal_db(path: str, timeout: float = 10.0) -> sqlite3.Connection:
+    """The one concurrent-SQLite open discipline (shards, merged DBs, the
+    orchestration queue journal): busy_timeout, WAL with a DELETE fallback
+    for filesystems that refuse it, synchronous=NORMAL."""
+    db = sqlite3.connect(path, timeout=timeout)
+    db.execute("PRAGMA busy_timeout=10000;")
+    mode = db.execute("PRAGMA journal_mode=WAL;").fetchone()[0]
+    if str(mode).lower() != "wal":
+        db.execute("PRAGMA journal_mode=DELETE;")
+    db.execute("PRAGMA synchronous=NORMAL;")
+    return db
+
+
 def init_forecast_db(path: str) -> sqlite3.Connection:
     """WAL + busy_timeout + schema, one initializer per path at a time
     (databaseoperations.jl:195-243)."""
@@ -65,13 +79,8 @@ def init_forecast_db(path: str) -> sqlite3.Connection:
     with _DB_INIT_LOCK:
         lock = _DB_INIT_LOCKS.setdefault(path, threading.Lock())
     with lock:
-        db = sqlite3.connect(path, timeout=10.0)
-        db.execute("PRAGMA busy_timeout=10000;")
+        db = open_wal_db(path)
         db.execute("PRAGMA temp_store=MEMORY;")
-        mode = db.execute("PRAGMA journal_mode=WAL;").fetchone()[0]
-        if str(mode).lower() != "wal":
-            db.execute("PRAGMA journal_mode=DELETE;")
-        db.execute("PRAGMA synchronous=NORMAL;")
         db.execute(SCHEMA)
         db.commit()
         return db
@@ -98,8 +107,16 @@ def save_oos_forecast_sharded(
     fl1 = rounded["factor_loadings_1"][:, -h:]
     fl2 = rounded["factor_loadings_2"][:, -h:]
 
+    # build the shard in a writer-unique temp file and publish it with one
+    # atomic rename: ``os.path.isfile(shard)`` then IMPLIES a fully committed
+    # shard, so concurrent mergers never observe a created-but-uncommitted DB
+    # (an empty file with no ``forecasts`` table yet) and misread it as
+    # corrupt; the unique suffix keeps a stalled writer and the thief that
+    # stole its lease from interleaving in one temp file (last publish wins —
+    # both hold identical rows)
     path = forecast_path(base, task_id)
-    db = init_forecast_db(path)
+    tmp = f"{path}.tmp-{os.getpid()}-{uuid.uuid4().hex[:8]}"
+    db = init_forecast_db(tmp)
     try:
         db.execute("BEGIN IMMEDIATE;")
         db.execute(
@@ -113,12 +130,53 @@ def save_oos_forecast_sharded(
             ),
         )
         db.commit()
-        return path
     except Exception:
         db.rollback()
-        raise
-    finally:
         db.close()
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    db.close()  # checkpoints + removes the -wal sidecar before the rename
+    os.replace(tmp, path)
+    with _DB_INIT_LOCK:  # tmp paths are single-use; don't accumulate locks
+        _DB_INIT_LOCKS.pop(tmp, None)
+    return path
+
+
+class MergeResult(str):
+    """The merged-DB path (still a plain ``str`` for every existing caller),
+    carrying the merge summary: ``.merged`` (task ids folded in) and
+    ``.skipped`` (``[(task_id, reason), ...]`` for corrupt/missing shards)."""
+
+    merged: list
+    skipped: list
+
+    def __new__(cls, path: str, merged, skipped):
+        self = super().__new__(cls, path)
+        self.merged = list(merged)
+        self.skipped = list(skipped)
+        return self
+
+
+def _shard_rows(shard: str, task_id: int):
+    """All of one shard's rows for ``task_id``; raises sqlite3.DatabaseError
+    on a truncated/corrupt file (detected on read, not just on connect).
+    Opened read-only via URI so a reader NEVER creates a file at the shard
+    path — a plain connect materializes an empty DB for a path that just
+    went missing, which a later reader would misread as a corrupt shard."""
+    from urllib.request import pathname2url
+
+    new = sqlite3.connect(f"file:{pathname2url(os.path.abspath(shard))}"
+                          "?mode=ro", uri=True, timeout=10.0)
+    try:
+        return new.execute(
+            "SELECT model,thread,window,task_id,loss,params,preds,fl1,fl2,factors,states "
+            "FROM forecasts WHERE task_id = ?", (int(task_id),)
+        ).fetchall()
+    finally:
+        new.close()
 
 
 def merge_forecast_shards(
@@ -126,39 +184,104 @@ def merge_forecast_shards(
     task_ids: Sequence[int],
     out: Optional[str] = None,
     delete_shards: bool = False,
-) -> str:
-    """Fold shards into the first, rename to _merged
-    (databaseoperations.jl:295-364)."""
+) -> MergeResult:
+    """Fold shards into the merged DB (databaseoperations.jl:295-364).
+
+    Hardened for crash-tolerant fleets, where the same merge may run twice
+    (a stalled merger's lease can be stolen while it is still alive):
+
+    - The merged DB is BUILT in a merger-unique temp file from read-only
+      shard opens, and PUBLISHED at most once: ``os.link`` to the final
+      path fails if a concurrent merger already published, so a slow loser
+      can never overwrite a complete merged DB with a partial one.  Shards
+      are deleted only after a successful publish (or when the merged DB
+      already exists — post-crash cleanup), so concurrent readers always
+      find every row somewhere.
+    - A truncated/corrupt shard (a worker killed mid-write on a non-WAL
+      filesystem) is SKIPPED with a warning and recorded in the returned
+      :class:`MergeResult` summary instead of aborting the whole merge —
+      and corrupt shards are never deleted, so the data stays on disk for
+      repair.
+    """
+    import sys as _sys
+
     if out is None:
         out = base.replace(".sqlite3", "_merged.sqlite3")
     task_ids = list(task_ids)
-    src_path = forecast_path(base, task_ids[0])
-    for task_id in task_ids[1:]:
-        shard = forecast_path(base, task_id)
-        if not os.path.isfile(shard):
-            continue
-        src = sqlite3.connect(src_path, timeout=10.0)
-        new = sqlite3.connect(shard, timeout=10.0)
-        rows = new.execute(
-            "SELECT model,thread,window,task_id,loss,params,preds,fl1,fl2,factors,states "
-            "FROM forecasts WHERE task_id = ?", (int(task_id),)
-        ).fetchall()
-        for row in rows:
-            src.execute(
-                "INSERT OR REPLACE INTO forecasts("
-                "model,thread,window,task_id,loss,params,preds,fl1,fl2,factors,states"
-                ") VALUES(?,?,?,?,?,?,?,?,?,?,?)", row
-            )
-        src.commit()
-        new.close()
-        src.close()
-    os.replace(src_path, out)
-    if delete_shards:
+    skipped: list = []
+    merged: list = []
+
+    tmp = f"{out}.tmp-{os.getpid()}-{uuid.uuid4().hex[:8]}"
+    dst = init_forecast_db(tmp)
+    try:
+        dst.execute("BEGIN IMMEDIATE;")
         for task_id in task_ids:
             shard = forecast_path(base, task_id)
-            if os.path.isfile(shard):
-                os.remove(shard)
-    return out
+            if not os.path.isfile(shard):
+                skipped.append((task_id, "missing shard"))
+                continue
+            try:
+                rows = _shard_rows(shard, task_id)
+            except sqlite3.DatabaseError as e:
+                skipped.append((task_id, f"corrupt shard: {e}"))
+                _sys.stderr.write(f"# merge: skipping corrupt shard for task "
+                                  f"{task_id} ({e}); file kept for repair\n")
+                continue
+            for row in rows:
+                dst.execute(
+                    "INSERT OR REPLACE INTO forecasts("
+                    "model,thread,window,task_id,loss,params,preds,fl1,fl2,factors,states"
+                    ") VALUES(?,?,?,?,?,?,?,?,?,?,?)", row
+                )
+            merged.append(task_id)
+        dst.commit()
+    except BaseException:
+        dst.close()
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    dst.close()
+    with _DB_INIT_LOCK:
+        _DB_INIT_LOCKS.pop(tmp, None)
+
+    if not merged and not os.path.isfile(out):
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise RuntimeError(
+            f"merge_forecast_shards: no healthy shard among {len(task_ids)} "
+            f"tasks of {base} — skipped: {skipped}")
+    try:
+        os.link(tmp, out)  # at-most-once publish: first merger wins
+    except FileExistsError:
+        # a concurrent/previous merger already published a complete merged
+        # DB; ours (possibly partial — it may have read shards after the
+        # winner deleted them) is discarded
+        merged = []
+    except OSError:
+        os.replace(tmp, out)  # no-hardlink filesystem: atomic, last-wins
+    finally:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+    if delete_shards:
+        corrupt = {t for t, why in skipped if "corrupt" in why}
+        for task_id in task_ids:
+            shard = forecast_path(base, task_id)
+            if task_id not in corrupt and os.path.isfile(shard):
+                for side in ("", "-wal", "-shm"):  # WAL sidecars too
+                    try:
+                        os.remove(shard + side)
+                    except OSError:
+                        pass
+    if skipped and merged:
+        _sys.stderr.write(f"# merge: {len(merged)} shards merged into {out}, "
+                          f"{len(skipped)} skipped: {skipped}\n")
+    return MergeResult(out, merged, skipped)
 
 
 # ---------------------------------------------------------------------------

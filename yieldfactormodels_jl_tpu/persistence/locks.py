@@ -11,12 +11,18 @@ from __future__ import annotations
 
 import os
 import shutil
+import time
 from typing import Optional
+
+
+def task_lock_path(lockroot: str, window_type: str, task_id: int) -> str:
+    """The one place the lock-dir naming convention lives."""
+    return os.path.join(lockroot, window_type, f"task_{task_id}.lock")
 
 
 def acquire_task_lock(lockroot: str, window_type: str, task_id: int) -> Optional[str]:
     """Atomic mkdir lock; returns the lock dir if acquired, None if held."""
-    lockdir = os.path.join(lockroot, window_type, f"task_{task_id}.lock")
+    lockdir = task_lock_path(lockroot, window_type, task_id)
     os.makedirs(os.path.dirname(lockdir), exist_ok=True)
     try:
         os.mkdir(lockdir)
@@ -34,3 +40,26 @@ def release_task_lock(lockdir: Optional[str]) -> None:
             shutil.rmtree(lockdir, ignore_errors=True)
     except OSError:
         pass
+
+
+def break_stale_lock(lockdir: str, ttl_seconds: float) -> bool:
+    """Remove ``lockdir`` when its mtime is older than ``ttl_seconds``;
+    True if removed.
+
+    This is the crash-recovery primitive the reference lacks: a SIGKILLed
+    worker's lock dir otherwise starves its task forever (SURVEY §5.3).
+    Live holders defend a lock by touching its mtime (the orchestration
+    queue's degraded mode heartbeats via ``os.utime``); ``os.rmdir`` only
+    removes EMPTY dirs and is atomic, so two sweepers racing lose nothing,
+    and the follow-up ``mkdir`` re-acquire stays atomic.  Worst case of an
+    aggressive TTL is duplicated work on an idempotent shard — never
+    corruption.
+    """
+    try:
+        if os.path.isdir(lockdir) and \
+                time.time() - os.path.getmtime(lockdir) > ttl_seconds:
+            os.rmdir(lockdir)
+            return True
+    except OSError:
+        pass
+    return False

@@ -119,6 +119,8 @@ def run(
     scratch_dir: str = "",
     seed: int = 43,
     batched_windows: bool = False,
+    orchestrated: bool = False,
+    n_workers: int = 2,
 ):
     if simulation:  # :241-246
         window_type = "simulation"
@@ -180,11 +182,24 @@ def run(
 
     if run_rolling:
         print("Forecasting...")
-        run_rolling_forecasts(
-            spec, data, thread_id, in_sample_end, in_sample_start,
-            forecast_horizon, all_params,
-            window_type=window_type, param_groups=param_groups,
-            max_group_iters=max_group_iters, group_tol=group_tol,
-            reestimate=reestimate, batched=batched_windows)
+        if orchestrated:
+            # crash-tolerant path (docs/DESIGN.md §10): the same windows run
+            # as leased queue tasks with checkpoint resume — expanding /
+            # moving / both only (no_windowing has no task decomposition)
+            from .orchestration.supervisor import run_orchestrated
+
+            run_orchestrated(
+                spec, data, thread_id, in_sample_end, in_sample_start,
+                forecast_horizon, all_params, n_workers=n_workers,
+                window_type=window_type, param_groups=param_groups,
+                max_group_iters=max_group_iters, group_tol=group_tol,
+                reestimate=reestimate)
+        else:
+            run_rolling_forecasts(
+                spec, data, thread_id, in_sample_end, in_sample_start,
+                forecast_horizon, all_params,
+                window_type=window_type, param_groups=param_groups,
+                max_group_iters=max_group_iters, group_tol=group_tol,
+                reestimate=reestimate, batched=batched_windows)
 
     return spec, params
