@@ -369,6 +369,46 @@ def main():
         except Exception as e:  # never kill the bench line
             orch_ctx = f"; orch bench failed ({type(e).__name__}: {e})"
 
+    # ---- robustness microbenchmark (opt-in: BENCH_ROBUST=1) ----
+    # (a) healthy-path cost of the failure-taxonomy channel: the same jitted
+    # batch evaluated through get_loss vs get_loss_coded — the codes ride
+    # carries the kernels already thread, so the ratio must be ≈1 (and plain
+    # get_loss callers have the code DCE'd entirely); (b) p50/p99 of a
+    # chaos-injected serving rebuild (YFM_CHAOS numeric seam nan_curve →
+    # health watch → last-good restore), the recovery path priced.
+    robust_ctx = ""
+    if os.environ.get("BENCH_ROBUST", "0") not in ("0", ""):
+        try:
+            from yieldfactormodels_jl_tpu.ops import univariate_kf
+
+            t_plain, _ = timed(batch_fn(univariate_kf.get_loss))
+            t_coded, _ = timed(jax.jit(jax.vmap(
+                lambda p: univariate_kf.get_loss_coded(spec, p, dev_data))))
+
+            from yieldfactormodels_jl_tpu.orchestration import chaos as _chaos
+            from yieldfactormodels_jl_tpu.serving import (YieldCurveService,
+                                                          freeze_snapshot)
+
+            reps = int(os.environ.get("BENCH_ROBUST_REPS", "200"))
+            svc = YieldCurveService(
+                freeze_snapshot(spec, dev_batch[0], dev_data),
+                self_heal=True)
+            svc.warmup()
+            _chaos.configure("nan_curve:0.1", seed=0)
+            for i in range(reps):
+                svc.update(i, dev_data[:, i % T_MONTHS])
+            _chaos.reset()
+            s = svc.latency_summary()
+            rb = s.get("rebuild", {"p50": float("nan"), "p99": float("nan")})
+            robust_ctx = (
+                f"; robustness: coded-loss overhead {t_coded / t_plain:.3f}x "
+                f"({BATCH / t_coded:.2f} vs {BATCH / t_plain:.2f} evals/s); "
+                f"chaos-injected rebuilds {svc.rebuilds}/{reps} updates, "
+                f"rebuild ms p50 {rb['p50'] * 1e3:.3f} / "
+                f"p99 {rb['p99'] * 1e3:.3f}")
+        except Exception as e:  # never kill the bench line
+            robust_ctx = f"; robust bench failed ({type(e).__name__}: {e})"
+
     n_finite = int(np.isfinite(np.asarray(out)).sum())
     # the joint form runs its matmuls/Cholesky through bf16 MXU passes on TPU
     # f32, so cross-check with a loose tolerance on the finite intersection
@@ -416,7 +456,7 @@ def main():
           f"| pallas {pallas_rate} evals/s; kernels agree: joint={agree} "
           f"pallas={pallas_agree}; finite: {n_finite}/{BATCH}; "
           f"cpu ll sample {ll_cpu:.2f}{grad_ctx}{ssd_ctx}{serving_ctx}"
-          f"{orch_ctx}; "
+          f"{orch_ctx}{robust_ctx}; "
           f"roofline: {flops_per_eval/1e6:.3f} MFLOP/eval -> "
           f"univariate {gflops(dev_evals_per_sec):.1f} | "
           f"joint {gflops(BATCH / t_joint):.1f} | "

@@ -1,0 +1,93 @@
+"""Static-analysis guard for the sentinel convention (CLAUDE.md, DESIGN §4):
+no ``raise`` inside jit/scan/Pallas kernel bodies under ``ops/`` and
+``serving/online.py`` — failures there must be sentinels (−Inf loss, NaN
+moments) plus a taxonomy code (robustness/taxonomy.py), never exceptions.
+
+Mechanical rule (AST, not regex, so strings/comments can't fool it):
+
+- a ``raise`` inside a NESTED function (a closure — scan bodies, jitted
+  ``one``/``many`` builders, Pallas kernel bodies) is a violation: those run
+  traced, where ``raise`` either fires spuriously at trace time or silently
+  never fires at run time;
+- a ``raise`` at the top level of a module-level function is allowed only
+  for the trace-time validation classes (ValueError / TypeError /
+  NotImplementedError / AttributeError) — shape/config checks that fire
+  before tracing starts, the documented driver-layer exception.
+"""
+
+import ast
+import os
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(ROOT, "yieldfactormodels_jl_tpu")
+
+#: trace-time validation exception classes (allowed in top-level functions)
+WHITELIST = {"ValueError", "TypeError", "NotImplementedError",
+             "AttributeError"}
+
+
+def _kernel_files():
+    opsdir = os.path.join(PKG, "ops")
+    for name in sorted(os.listdir(opsdir)):
+        if name.endswith(".py"):
+            yield os.path.join(opsdir, name)
+    yield os.path.join(PKG, "serving", "online.py")
+
+
+def _func_depth(node, parents):
+    """Number of enclosing FunctionDef/AsyncFunctionDef/Lambda scopes."""
+    depth = 0
+    p = parents.get(node)
+    while p is not None:
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            depth += 1
+        p = parents.get(p)
+    return depth
+
+
+def _raised_name(node):
+    exc = node.exc
+    if isinstance(exc, ast.Call):
+        exc = exc.func
+    if isinstance(exc, ast.Name):
+        return exc.id
+    if isinstance(exc, ast.Attribute):
+        return exc.attr
+    return None  # bare `raise` / exotic expression
+
+
+def test_no_raise_inside_kernel_bodies():
+    violations = []
+    for path in _kernel_files():
+        with open(path) as fh:
+            tree = ast.parse(fh.read(), filename=path)
+        parents = {}
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                parents[child] = parent
+        rel = os.path.relpath(path, ROOT)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Raise):
+                continue
+            depth = _func_depth(node, parents)
+            name = _raised_name(node)
+            if depth >= 2:
+                violations.append(
+                    f"{rel}:{node.lineno} raise inside a nested function "
+                    f"(scan/kernel body) — use the −Inf/NaN sentinel + "
+                    f"taxonomy code instead")
+            elif name not in WHITELIST:
+                violations.append(
+                    f"{rel}:{node.lineno} raises {name or '<bare>'} — only "
+                    f"trace-time validation ({sorted(WHITELIST)}) is allowed "
+                    f"in kernel modules")
+    assert not violations, "sentinel-convention violations:\n" + \
+        "\n".join(violations)
+
+
+def test_guard_is_not_vacuous():
+    """The file walk must actually see the kernel modules it claims to guard
+    (a rotted path would green-light everything)."""
+    names = {os.path.basename(p) for p in _kernel_files()}
+    assert {"univariate_kf.py", "sqrt_kf.py", "particle.py", "smoother.py",
+            "online.py"} <= names

@@ -1,0 +1,572 @@
+"""Numerics sentry (robustness/, docs/DESIGN.md §11): failure taxonomy,
+engine escalation ladder, self-healing serving state.
+
+Acceptance coverage (ISSUE 5):
+
+- coded kernels return the SAME loss as the plain kernels bit-for-bit, plus
+  a decodable cause for every failure class the sentinels can hit;
+- with ``YFM_ESCALATE=1`` a seeded non-PSD start that fails the joint/scan
+  filter is recovered by the square-root rung and its ladder trace (codes +
+  rung) lands in the multi-start report; ``YFM_ESCALATE=0`` reproduces the
+  drop-the-start behavior exactly; both runs are deterministic;
+- with the ``nan_curve:@3`` chaos seam armed, ``YieldCurveService`` degrades
+  (stale flag + rebuild, no exception) and the next healthy update returns
+  it to ``ok`` — bit-for-bit deterministic under fixed seeds;
+- the long-horizon drift regression: 5k online updates stay PSD and agree
+  with one batch filter pass / the float64 NumPy oracle.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import yieldfactormodels_jl_tpu as yfm
+from tests import oracle
+from yieldfactormodels_jl_tpu import serving
+from yieldfactormodels_jl_tpu.estimation import optimize as opt
+from yieldfactormodels_jl_tpu.models import kalman as kalman_joint
+from yieldfactormodels_jl_tpu.models.params import unpack_kalman
+from yieldfactormodels_jl_tpu.ops import sqrt_kf, univariate_kf
+from yieldfactormodels_jl_tpu.orchestration import chaos
+from yieldfactormodels_jl_tpu.orchestration.retry import SentinelFailure
+from yieldfactormodels_jl_tpu.robustness import health as rh
+from yieldfactormodels_jl_tpu.robustness import ladder, taxonomy as tax
+
+MATS = tuple(np.array([3, 12, 24, 60, 120, 240, 360]) / 12.0)
+
+
+@pytest.fixture(scope="module")
+def dns_setup():
+    rng = np.random.default_rng(7)
+    spec, _ = yfm.create_model("1C", MATS, float_type="float64")
+    p = oracle.stable_1c_params(spec, np.float64)
+    data = oracle.simulate_dns_panel(rng, np.asarray(MATS), T=46)
+    return spec, p, data
+
+
+def _nonpsd_start(spec, p):
+    """Heavy off-diagonal Φ (spectral radius > 1): the kron-solve P₀ is
+    indefinite, so the univariate/joint filters die (f ≤ 0 / failed
+    innovation Cholesky) and the plain sqrt engine dies at chol(P₀)."""
+    bad = np.asarray(p, dtype=np.float64).copy()
+    a, b = spec.layout["phi"]
+    Phi = 0.9 * np.eye(3)
+    Phi[0, 1] = Phi[1, 0] = Phi[0, 2] = Phi[2, 0] = Phi[1, 2] = Phi[2, 1] = 0.8
+    bad[a:b] = Phi.reshape(-1)
+    return bad
+
+
+# ---------------------------------------------------------------------------
+# taxonomy
+# ---------------------------------------------------------------------------
+
+def test_decode_describe_roundtrip():
+    assert tax.decode(0) == ()
+    assert tax.describe(0) == "OK"
+    both = tax.NONPSD_INNOVATION | tax.CHOL_BREAKDOWN
+    assert tax.decode(both) == ("NONPSD_INNOVATION", "CHOL_BREAKDOWN")
+    assert tax.describe(both) == "NONPSD_INNOVATION|CHOL_BREAKDOWN"
+    # bits are distinct powers of two (OR-combinable)
+    flags = [f for f, _ in tax.NAMES]
+    assert len(set(flags)) == len(flags)
+    assert all(f & (f - 1) == 0 for f in flags)
+
+
+def test_combine_is_bitwise_or():
+    codes = jnp.asarray([0, tax.NONPSD_INNOVATION, tax.STATE_EXPLODED, 0],
+                        dtype=jnp.int32)
+    assert int(tax.combine(codes)) == \
+        tax.NONPSD_INNOVATION | tax.STATE_EXPLODED
+    assert int(tax.combine(jnp.zeros(5, dtype=jnp.int32))) == 0
+
+
+def test_coded_losses_match_plain_bitforbit(dns_setup):
+    """The taxonomy channel must not perturb the loss: get_loss_coded ==
+    get_loss exactly, healthy code 0, on all three coded Kalman engines."""
+    spec, p, data = dns_setup
+    pj, dj = jnp.asarray(p), jnp.asarray(data)
+    for plain, coded in ((univariate_kf.get_loss, univariate_kf.get_loss_coded),
+                         (sqrt_kf.get_loss, sqrt_kf.get_loss_coded),
+                         (kalman_joint.get_loss, kalman_joint.get_loss_coded)):
+        ll, code = coded(spec, pj, dj)
+        assert float(ll) == float(plain(spec, pj, dj))
+        assert int(code) == tax.OK
+
+
+def test_taxonomy_flags_each_failure_class(dns_setup):
+    spec, p, data = dns_setup
+    dj = jnp.asarray(data)
+    # non-PD innovation variance (σ² < 0 in constrained space)
+    bad = np.asarray(p).copy()
+    bad[spec.layout["obs_var"][0]] = -10.0
+    ll, code = univariate_kf.get_loss_coded(spec, jnp.asarray(bad), dj)
+    assert float(ll) == -np.inf
+    assert "NONPSD_INNOVATION" in tax.decode(code)
+    # joint engine: same point is a failed innovation Cholesky
+    ll, code = kalman_joint.get_loss_coded(spec, jnp.asarray(bad), dj)
+    assert "CHOL_BREAKDOWN" in tax.decode(code)
+    # sqrt engine: an indefinite P0 is a failed initial factorization
+    ll, code = sqrt_kf.get_loss_coded(spec, jnp.asarray(_nonpsd_start(spec, p)),
+                                      dj)
+    assert float(ll) == -np.inf and "CHOL_BREAKDOWN" in tax.decode(code)
+    # non-finite params → TRANSFORM_OVERFLOW
+    nanp = np.asarray(p).copy()
+    nanp[0] = np.nan
+    ll, code = univariate_kf.get_loss_coded(spec, jnp.asarray(nanp), dj)
+    assert "TRANSFORM_OVERFLOW" in tax.decode(code)
+    # empty window → MISSING_ALL_OBS (loss convention unchanged: 0.0)
+    ll, code = univariate_kf.get_loss_coded(spec, jnp.asarray(p), dj, 5, 6)
+    assert "MISSING_ALL_OBS" in tax.decode(code)
+
+
+def test_smoother_carries_code(dns_setup):
+    spec, p, data = dns_setup
+    from yieldfactormodels_jl_tpu.ops.smoother import smooth
+
+    out = smooth(spec, jnp.asarray(p), jnp.asarray(data))
+    assert int(out["code"]) == tax.OK
+    bad = np.asarray(p).copy()
+    bad[spec.layout["obs_var"][0]] = -10.0
+    out = smooth(spec, jnp.asarray(bad), jnp.asarray(data))
+    assert np.isnan(np.asarray(out["beta_smooth"])).all()
+    assert "NAN_STATE" in tax.decode(out["code"])
+    assert "NONPSD_INNOVATION" in tax.decode(out["code"])
+
+
+def test_diagnose_driver_entry(dns_setup):
+    spec, p, data = dns_setup
+    ll, code = tax.diagnose(spec, p, data)
+    assert np.isfinite(ll) and code == 0
+    ll, code = tax.diagnose(spec, _nonpsd_start(spec, p), data)
+    assert ll == -np.inf and code != 0
+
+
+# ---------------------------------------------------------------------------
+# escalation ladder (acceptance: sqrt-rung recovery, exact off-behavior)
+# ---------------------------------------------------------------------------
+
+def test_ladder_recovers_nonpsd_start_via_sqrt_rung(dns_setup, monkeypatch):
+    spec, p, data = dns_setup
+    bad = _nonpsd_start(spec, p)
+    starts = np.stack([p, bad], axis=1)  # (P, S): one good, one dead
+
+    monkeypatch.setenv("YFM_ESCALATE", "0")
+    r_off = opt.estimate(spec, data, starts, max_iters=5)
+    rep_off = opt.last_multistart_report()
+    assert rep_off["ladder"] == []  # drop-the-start: no escalation ran
+
+    monkeypatch.setenv("YFM_ESCALATE", "1")
+    r_on = opt.estimate(spec, data, starts, max_iters=5)
+    rep_on = opt.last_multistart_report()
+
+    # the good start still wins, and its result is IDENTICAL to the off run
+    assert r_on[1] == r_off[1]
+    np.testing.assert_array_equal(r_on[2], r_off[2])
+    assert bool(r_on[3].converged) == bool(r_off[3].converged)
+
+    # ... but the dead start was recovered by the sqrt rung, with its trace
+    # (initial diagnosis code + rungs climbed) in the multi-start report
+    (trace,) = rep_on["ladder"]
+    assert trace["start"] == 1 and trace["recovered"]
+    assert trace["rung"] == "sqrt" and trace["engine"] == "sqrt"
+    assert "NONPSD_INNOVATION" in trace["cause"]
+    assert [r["rung"] for r in trace["rungs"]] == ["scan", "sqrt"]
+    assert np.isfinite(trace["ll"])
+    assert np.isfinite(rep_on["lls"][1])
+
+    # determinism: the escalated run replays bit-for-bit
+    r_on2 = opt.estimate(spec, data, starts, max_iters=5)
+    assert r_on2[1] == r_on[1]
+    np.testing.assert_array_equal(r_on2[2], r_on[2])
+    assert opt.last_multistart_report() == rep_on
+
+
+def test_ladder_rescues_all_dead_batch(dns_setup, monkeypatch):
+    """When EVERY start is dead the ladder's value is the answer (flagged
+    not-converged: a rescued evaluation, not an optimizer optimum)."""
+    spec, p, data = dns_setup
+    bad = _nonpsd_start(spec, p)
+    monkeypatch.setenv("YFM_ESCALATE", "1")
+    _, ll, best, conv = opt.estimate(spec, data, bad[:, None], max_iters=5)
+    assert np.isfinite(ll) and not conv.converged
+    monkeypatch.setenv("YFM_ESCALATE", "0")
+    _, ll0, _, _ = opt.estimate(spec, data, bad[:, None], max_iters=5)
+    assert not np.isfinite(ll0) or ll0 <= -opt._PENALTY_THRESH  # dropped
+
+
+def test_ladder_shrink_rung_reference_parity(dns_setup):
+    """A start that no engine can evaluate but whose ×0.95-shrunk point can
+    be recovers through the shrink rung with a modified raw vector — the
+    reference's rescue (optimization.jl:173-184), now recorded."""
+    spec, p, data = dns_setup
+    # NaN params: scan/sqrt/jitter all dead (TRANSFORM_OVERFLOW);
+    # shrink of NaN stays NaN → unrecovered trace, exercised end-to-end
+    raw_nan = np.full(spec.n_params, np.nan)
+    tr = ladder.escalate(spec, data, raw_nan)
+    assert not tr.recovered and tr.rung is None and tr.ll == -np.inf
+    assert "TRANSFORM_OVERFLOW" in tax.describe(tr.code)
+
+
+def test_ladder_trace_asdict_shape(dns_setup):
+    spec, p, data = dns_setup
+    tr = ladder.escalate(spec, data,
+                         np.asarray(opt.untransform_params(
+                             spec, jnp.asarray(p)), dtype=np.float64))
+    d = tr.as_dict()
+    assert d["recovered"] and d["rung"] == "scan" and d["cause"] == "OK"
+    assert d["rungs"][0]["rung"] == "scan"
+
+
+# ---------------------------------------------------------------------------
+# SentinelFailure context (satellite: actionable quarantine rows)
+# ---------------------------------------------------------------------------
+
+def test_sentinel_failure_carries_seam_and_code():
+    e = SentinelFailure("boom", seam="estimate",
+                        code=tax.NONPSD_INNOVATION | tax.CHOL_BREAKDOWN)
+    assert e.seam == "estimate"
+    assert e.code == (tax.NONPSD_INNOVATION | tax.CHOL_BREAKDOWN)
+    assert "seam=estimate" in str(e)
+    assert "NONPSD_INNOVATION|CHOL_BREAKDOWN" in str(e)
+    legacy = SentinelFailure("plain")
+    assert legacy.seam is None and legacy.code == 0 and str(legacy) == "plain"
+
+
+def test_window_task_sentinel_carries_cause(tmp_path, monkeypatch):
+    """run_single_window_task's retry-policy sentinel now names the seam and
+    the decoded cause — what the queue's quarantine row will persist."""
+    from yieldfactormodels_jl_tpu import forecasting as fc
+
+    spec, _ = yfm.create_model(
+        "NS", tuple(np.array([3.0, 12.0, 24.0, 60.0, 120.0, 360.0]) / 12.0),
+        float_type="float64", results_location=str(tmp_path) + "/")
+    rng = np.random.default_rng(3)
+    data = oracle.simulate_dns_panel(
+        rng, np.array([3.0, 12.0, 24.0, 60.0, 120.0, 360.0]) / 12.0, T=36)
+    monkeypatch.setattr(
+        fc, "_estimate_for_window",
+        lambda *a, **k: (float("-inf"), np.full(spec.n_params, np.nan)))
+    with pytest.raises(SentinelFailure, match="non-finite loss sentinel") as ei:
+        fc.run_single_window_task(
+            spec, data, "1", 33, "expanding", 33, 1, 3,
+            np.zeros((spec.n_params, 1)), param_groups=["1"] * spec.n_params,
+            sentinel_policy="retry")
+    assert ei.value.seam == "estimate"
+    assert ei.value.code != 0
+    assert "cause=" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# self-healing serving (acceptance: chaos degrade → rebuild → recover)
+# ---------------------------------------------------------------------------
+
+T_ORIGIN = 34
+
+
+def _service(spec, p, data, **kw):
+    snap = serving.freeze_snapshot(spec, p, data, end=T_ORIGIN)
+    return serving.YieldCurveService(snap, **kw)
+
+
+def _run_updates(svc, data, n=5):
+    return [svc.update(T_ORIGIN + k, data[:, T_ORIGIN + k]) for k in range(n)]
+
+
+def test_chaos_nan_curve_degrades_and_recovers(dns_setup):
+    """YFM_CHAOS=nan_curve:@3 (programmatic arm): the 3rd update's state
+    poison is caught by the health watch — stale + rebuild, NO exception —
+    and the next healthy update returns the service to ok.  Deterministic:
+    two runs agree bit-for-bit."""
+    spec, p, data = dns_setup
+
+    def run():
+        svc = _service(spec, p, data, self_heal=True)
+        chaos.configure("nan_curve:@3")
+        try:
+            lls = _run_updates(svc, data, 5)
+        finally:
+            chaos.reset()
+        return svc, lls
+
+    svc, lls = run()
+    assert np.isnan(lls[2]) and all(np.isfinite(lls[k]) for k in (0, 1, 3, 4))
+    h = svc.health()
+    assert h["status"] == "ok" and h["rebuilds"] == 1
+    assert svc.version == 4  # the poisoned update was rolled back
+
+    svc2, lls2 = run()  # bit-for-bit determinism under the fixed trigger
+    np.testing.assert_array_equal(np.asarray(svc.snapshot.beta),
+                                  np.asarray(svc2.snapshot.beta))
+    np.testing.assert_array_equal(np.asarray(svc.snapshot.P),
+                                  np.asarray(svc2.snapshot.P))
+    assert [x for x in lls if np.isfinite(x)] == \
+        [x for x in lls2 if np.isfinite(x)]
+
+
+def test_chaos_env_route_arms_numeric_seam(dns_setup, monkeypatch):
+    """The acceptance knob spelling: YFM_CHAOS=nan_curve:@1 in the
+    environment (re-read after reset) arms the numeric seam."""
+    spec, p, data = dns_setup
+    monkeypatch.setenv("YFM_CHAOS", "nan_curve:@1")
+    chaos.reset()  # force the env re-read on the next hit
+    try:
+        svc = _service(spec, p, data, self_heal=True)
+        ll = svc.update(T_ORIGIN, data[:, T_ORIGIN])
+        assert np.isnan(ll) and svc.health()["status"] == "stale"
+    finally:
+        chaos.reset()
+
+
+def test_chaos_nan_curve_stale_while_degraded(dns_setup):
+    spec, p, data = dns_setup
+    svc = _service(spec, p, data, self_heal=True)
+    chaos.configure("nan_curve:@2")
+    try:
+        svc.update(T_ORIGIN, data[:, T_ORIGIN])
+        assert svc.health()["status"] == "ok"
+        svc.update(T_ORIGIN + 1, data[:, T_ORIGIN + 1])  # poisoned
+    finally:
+        chaos.reset()
+    h = svc.health()
+    assert h["status"] == "stale" and h["rebuilds"] == 1
+    assert "NAN_STATE" in h["last_code_names"]
+    # forecasts still answer from the last-good state while stale
+    fc = svc.forecast(4)
+    assert np.all(np.isfinite(fc["means"]))
+
+
+def test_chaos_nonpsd_cov_caught_by_min_eig_watch(dns_setup):
+    spec, p, data = dns_setup
+    svc = _service(spec, p, data, self_heal=True)
+    chaos.configure("nonpsd_cov:@2")
+    try:
+        lls = _run_updates(svc, data, 4)
+    finally:
+        chaos.reset()
+    assert np.isnan(lls[1]) and np.isfinite(lls[2])
+    h = svc.health()
+    assert h["status"] == "ok" and h["rebuilds"] == 1
+    assert h["cov_min_eig"] > 0
+
+
+def test_chaos_nonpsd_cov_sqrt_engine_forces_restore(dns_setup):
+    """With the sqrt engine a corrupted FACTOR is invisible to the min-eig
+    watch (S Sᵀ is PSD for any finite S) — the fired seam must force the
+    restore anyway, and the post-rebuild state must equal the pre-corruption
+    state exactly."""
+    spec, p, data = dns_setup
+    svc = _service(spec, p, data, self_heal=True, engine="sqrt")
+    ll0 = svc.update(T_ORIGIN, data[:, T_ORIGIN])
+    good_cov = np.asarray(svc._state.cov).copy()
+    chaos.configure("nonpsd_cov:@1")
+    try:
+        ll1 = svc.update(T_ORIGIN + 1, data[:, T_ORIGIN + 1])
+    finally:
+        chaos.reset()
+    assert np.isfinite(ll0) and np.isnan(ll1)
+    h = svc.health()
+    assert h["status"] == "stale" and h["rebuilds"] == 1
+    assert "NONPSD_COV" in h["last_code_names"]
+    np.testing.assert_array_equal(np.asarray(svc._state.cov), good_cov)
+    # healthy update → back to ok, continuing from the restored state
+    assert np.isfinite(svc.update(T_ORIGIN + 2, data[:, T_ORIGIN + 2]))
+    assert svc.health()["status"] == "ok"
+
+
+def test_unhealed_service_still_raises_and_rolls_back(dns_setup):
+    """Default (self_heal=False) keeps the historical contract: structured
+    ServingError, last good snapshot retained — now with the decoded cause
+    in the error context."""
+    spec, p, data = dns_setup
+    bad = np.asarray(p, dtype=np.float64).copy()
+    bad[spec.layout["obs_var"][0]] = -10.0
+    snap = serving.freeze_snapshot(spec, p, data, end=T_ORIGIN)
+    svc = serving.YieldCurveService(dataclasses.replace(
+        snap, params=jnp.asarray(bad)))
+    v0 = svc.version
+    with pytest.raises(serving.ServingError) as ei:
+        svc.update(0, data[:, T_ORIGIN])
+    assert svc.version == v0
+    assert "NONPSD_INNOVATION" in ei.value.context["code"]
+
+
+def test_request_path_rolls_back_poisoned_state(dns_setup):
+    """Satellite: the request-path finiteness guard must not leave a
+    poisoned in-memory OnlineState behind — the state is restored to the
+    last good snapshot BEFORE the structured error surfaces (and under
+    self_heal the request is retried from the healed state)."""
+    spec, p, data = dns_setup
+    svc = _service(spec, p, data)  # self_heal=False: raise, but heal first
+    svc.update(T_ORIGIN, data[:, T_ORIGIN])
+    good_beta = np.asarray(svc._state.beta).copy()
+    # poison the in-memory state behind the service's back (the class of bug
+    # the old _check_finite left unrecoverable)
+    svc._state = serving.OnlineState(
+        jnp.full_like(svc._state.beta, jnp.nan),
+        jnp.full_like(svc._state.cov, jnp.nan))
+    svc.snapshot = dataclasses.replace(
+        svc.snapshot, beta=svc._state.beta, P=svc._state.cov)
+    with pytest.raises(serving.ServingError):
+        svc.forecast(4)
+    np.testing.assert_array_equal(np.asarray(svc._state.beta), good_beta)
+    assert svc.rebuilds == 1 and svc.stale
+
+    # self_heal=True: same poisoning, but the caller gets a (stale) answer
+    svc2 = _service(spec, p, data, self_heal=True)
+    svc2.update(T_ORIGIN, data[:, T_ORIGIN])
+    svc2._state = serving.OnlineState(
+        jnp.full_like(svc2._state.beta, jnp.nan),
+        jnp.full_like(svc2._state.cov, jnp.nan))
+    svc2.snapshot = dataclasses.replace(
+        svc2.snapshot, beta=svc2._state.beta, P=svc2._state.cov)
+    out = svc2.forecast(4)
+    assert np.all(np.isfinite(out["means"]))
+    assert svc2.stale and svc2.rebuilds == 1
+
+
+def test_registry_is_rebuild_source_of_last_resort(dns_setup):
+    """When even the last-good state is poisoned, the rebuild falls back to
+    the frozen registry/boot snapshot."""
+    spec, p, data = dns_setup
+    reg = serving.SnapshotRegistry()
+    snap = serving.freeze_snapshot(
+        spec, p, data, end=T_ORIGIN,
+        meta=serving.SnapshotMeta(model_string=spec.model_string, task_id=7))
+    reg.put(snap)
+    svc = serving.YieldCurveService(snap, registry=reg, self_heal=True)
+    nan_state = serving.OnlineState(
+        jnp.full_like(svc._state.beta, jnp.nan),
+        jnp.full_like(svc._state.cov, jnp.nan))
+    svc._state = nan_state
+    svc._last_good = (svc.snapshot, nan_state)  # last-good poisoned too
+    ll = svc.update(T_ORIGIN, data[:, T_ORIGIN])
+    # the update itself ran against a NaN carry → rejected and rebuilt
+    assert np.isnan(ll) and svc.rebuilds == 1 and svc.stale
+    # next update runs from the registry-restored state and is healthy
+    assert np.isfinite(svc.update(T_ORIGIN + 1, data[:, T_ORIGIN + 1]))
+    assert svc.health()["status"] == "ok"
+
+
+def test_serve_refresh_keeps_oracle_parity(dns_setup, monkeypatch):
+    """YFM_SERVE_REFRESH scrubs must not move the state beyond rounding:
+    with a refresh every 3 updates the final state still matches the plain
+    run at 1e-9 (f64) and the refresh counter cycles."""
+    spec, p, data = dns_setup
+    monkeypatch.setenv("YFM_SERVE_REFRESH", "3")
+    svc_r = _service(spec, p, data)  # reads the env knob
+    monkeypatch.delenv("YFM_SERVE_REFRESH")
+    svc_p = _service(spec, p, data)
+    for k in range(10):
+        svc_r.update(T_ORIGIN + k, data[:, (T_ORIGIN + k) % data.shape[1]])
+        svc_p.update(T_ORIGIN + k, data[:, (T_ORIGIN + k) % data.shape[1]])
+    assert svc_r.health()["refresh_every"] == 3
+    assert svc_r.health()["updates_since_refresh"] == 1  # 10 % 3
+    np.testing.assert_allclose(np.asarray(svc_r.snapshot.beta),
+                               np.asarray(svc_p.snapshot.beta), atol=1e-12)
+    np.testing.assert_allclose(np.asarray(svc_r.snapshot.P),
+                               np.asarray(svc_p.snapshot.P), atol=1e-9)
+
+
+def test_update_many_advances_refresh_cadence(dns_setup):
+    """Catch-up batches count toward YFM_SERVE_REFRESH too — k accepted
+    steps credit the cadence, and the scrubbed state stays at oracle parity
+    with the plain run."""
+    spec, p, data = dns_setup
+    svc_r = _service(spec, p, data, refresh_every=4)
+    svc_p = _service(spec, p, data)
+    Y = data[:, T_ORIGIN:T_ORIGIN + 6]
+    svc_r.update_many(T_ORIGIN, Y)
+    svc_p.update_many(T_ORIGIN, Y)
+    assert svc_r.health()["updates_since_refresh"] == 0  # 6 ≥ 4 → scrubbed
+    np.testing.assert_allclose(np.asarray(svc_r.snapshot.P),
+                               np.asarray(svc_p.snapshot.P), atol=1e-9)
+
+
+def test_health_report_vocabulary(dns_setup):
+    spec, p, data = dns_setup
+    svc = _service(spec, p, data, engine="sqrt")
+    svc.update(T_ORIGIN, data[:, T_ORIGIN])
+    h = svc.health()
+    assert h["status"] == "ok" and h["engine"] == "sqrt"
+    assert h["cov_min_eig"] > 0 and np.isfinite(h["cov_cond"])
+    assert h["rebuilds"] == 0 and h["last_code"] == 0
+
+
+# ---------------------------------------------------------------------------
+# long-horizon drift regression (satellite: the health monitor's yardstick)
+# ---------------------------------------------------------------------------
+
+def test_long_horizon_online_drift_5k_updates(dns_setup):
+    """5,000 recursive online updates (f64, chunked through the bucketed
+    catch-up program) vs ONE batch filter pass and the independent NumPy
+    oracle: the covariance must stay PSD the whole way and the final state
+    must agree — the regression the per-update health watch is measured
+    against."""
+    spec, p, _ = dns_setup
+    T_LONG = 5000 + T_ORIGIN
+    rng = np.random.default_rng(11)
+    panel = oracle.simulate_dns_panel(rng, np.asarray(MATS), T=T_LONG)
+
+    snap = serving.freeze_snapshot(spec, p, panel[:, :T_ORIGIN])
+    params = jnp.asarray(p, dtype=jnp.float64)
+    st = serving.OnlineState(snap.beta, snap.P)
+    min_eigs = []
+    for lo in range(T_ORIGIN, T_LONG, 125):
+        hi = min(lo + 125, T_LONG)
+        st, _, oks = serving.update_k(spec, params, st,
+                                      jnp.asarray(panel[:, lo:hi]))
+        assert bool(np.asarray(oks).all())
+        w = np.linalg.eigvalsh(np.asarray(st.cov, dtype=np.float64))
+        min_eigs.append(float(w[0]))
+    assert min(min_eigs) > 0  # PSD at every checkpoint, not just the end
+
+    # one batch filter pass over the whole panel (library, univariate scan)
+    from yieldfactormodels_jl_tpu.ops.smoother import forward_moments
+
+    _, outs = forward_moments(spec, params, jnp.asarray(panel), 0, T_LONG,
+                              "univariate")
+    np.testing.assert_allclose(np.asarray(st.beta),
+                               np.asarray(outs["beta_upd"][-1]), atol=1e-8)
+    np.testing.assert_allclose(np.asarray(st.cov),
+                               np.asarray(outs["P_upd"][-1]), atol=1e-8)
+
+    # independent float64 NumPy oracle (tests/oracle.py), never another JAX
+    # path alone (CLAUDE.md parity rule)
+    kp = unpack_kalman(spec, params)
+    Z = np.asarray(oracle.dns_loadings(float(np.asarray(kp.gamma)[0]),
+                                       np.asarray(MATS)))
+    betas, Ps, _ = oracle.online_filter(
+        Z, np.zeros(spec.N), np.asarray(kp.Phi), np.asarray(kp.delta),
+        np.asarray(kp.Omega_state), float(kp.obs_var), panel)
+    np.testing.assert_allclose(np.asarray(st.beta), betas[-1], atol=1e-7)
+    np.testing.assert_allclose(np.asarray(st.cov), Ps[-1], atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# health module units
+# ---------------------------------------------------------------------------
+
+def test_state_health_flags():
+    P = np.diag([1.0, 2.0, 3.0])
+    h = rh.state_health(np.zeros(3), P)
+    assert h["code"] == tax.OK and h["min_eig"] == pytest.approx(1.0)
+    h = rh.state_health(np.zeros(3), P - 2.5 * np.eye(3))
+    assert h["code"] == tax.NONPSD_COV
+    h = rh.state_health(np.full(3, np.nan), P)
+    assert h["code"] == tax.NAN_STATE
+    # sqrt engine: the factor's product is watched, not the factor itself
+    S = np.linalg.cholesky(P)
+    h = rh.state_health(np.zeros(3), S, engine="sqrt")
+    assert h["code"] == tax.OK
+
+
+def test_refresh_state_projects_to_psd():
+    P = np.diag([1.0, -0.5, 2.0])  # indefinite
+    P2 = rh.refresh_state(np.zeros(3), P)
+    assert np.linalg.eigvalsh(P2)[0] >= 0
+    S = rh.refresh_state(np.zeros(3), np.linalg.cholesky(np.diag([1., 2., 3.])),
+                         engine="sqrt")
+    np.testing.assert_allclose(S @ S.T, np.diag([1.0, 2.0, 3.0]), atol=1e-12)

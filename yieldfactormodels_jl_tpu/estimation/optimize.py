@@ -24,6 +24,7 @@ NelderMead to the jittable implementation in ``neldermead.py``, Adam to
 from __future__ import annotations
 
 import os
+import threading
 
 from functools import lru_cache
 from typing import Dict, NamedTuple, Optional, Sequence, Tuple
@@ -38,8 +39,64 @@ from ..models.params import transform_params, untransform_params, get_new_initia
 from ..models.specs import ModelSpec
 from ..config import register_engine_cache
 from ..orchestration import chaos as _chaos
+from ..robustness import ladder as _ladder
 from .batched_lbfgs import batched_lbfgs
 from .neldermead import nelder_mead, nelder_mead_batched
+
+
+# ---------------------------------------------------------------------------
+# multi-start report (docs/DESIGN.md §11)
+# ---------------------------------------------------------------------------
+
+#: the last estimate()/estimate_steps() call's per-start outcome — PER
+#: THREAD: the orchestrated supervisor runs one estimation per worker thread
+#: (orchestration/supervisor.py), and a process-global here would let worker
+#: B's report overwrite worker A's between A's estimate and A's
+#: SentinelFailure, mislabeling quarantine rows.  Contents: final loglik per
+#: start, ladder traces (codes + rungs) for every escalated start
+#: (robustness/ladder.py; empty unless YFM_ESCALATE armed and starts died),
+#: and the winning index.
+_REPORT_TLS = threading.local()
+_EMPTY_REPORT: Dict = {"lls": [], "ladder": [], "best": -1}
+
+
+def last_multistart_report() -> Dict:
+    """The calling thread's most recent multi-start report."""
+    return getattr(_REPORT_TLS, "report", _EMPTY_REPORT)
+
+
+def _record_report(lls, ladder_traces, best: int) -> None:
+    _REPORT_TLS.report = {
+        "lls": [float(v) for v in np.asarray(lls).ravel()],
+        "ladder": [t.as_dict() for t in ladder_traces],
+        "best": int(best),
+    }
+
+
+def _apply_ladder(spec, data, rows_raw, fallback_raw, lls, start, end):
+    """Escalate every non-finite start through the ladder (YFM_ESCALATE).
+
+    ``rows_raw`` (S, P): each start's final unconstrained point (non-finite
+    rows fall back to ``fallback_raw``); ``lls`` (S,) loglik per start.
+    Returns ``(traces, lls', rows')`` with recovered starts' logliks and
+    possibly-modified points substituted.  A no-op (no traces) when the
+    ladder is disarmed or nothing failed — the historical drop-the-start
+    behavior, bit-for-bit.
+    """
+    lls = np.asarray(lls, dtype=np.float64)
+    if not _ladder.escalation_enabled():
+        return [], lls, rows_raw
+    failed = ~np.isfinite(lls)
+    if not failed.any():
+        return [], lls, rows_raw
+    rows = np.asarray(rows_raw, dtype=np.float64).copy()
+    bad_rows = ~np.isfinite(rows).all(axis=1)
+    rows[bad_rows] = np.asarray(fallback_raw, dtype=np.float64)[bad_rows]
+    traces, lad_lls, rows_new = _ladder.escalate_starts(
+        spec, data, rows, failed, start, end)
+    rec = np.isfinite(lad_lls)
+    return traces, np.where(rec, lad_lls, lls), \
+        np.where(rec[:, None], rows_new, rows)
 
 
 class Convergence(NamedTuple):
@@ -435,31 +492,51 @@ def estimate(spec: ModelSpec, data, all_params, start=0, end=None,
                                 jnp.asarray(start), jnp.asarray(end))
     fs = np.asarray(fs, dtype=np.float64)
     lls = -fs
+    xs_np = np.asarray(xs, dtype=np.float64)
+    traces = []
+    recovered = np.zeros(lls.shape[0], dtype=bool)
+    if _ladder.escalation_enabled():
+        # a start parked on the penalty plateau never saw a finite objective
+        # — hand it to the ladder as dead (−Inf) alongside the −Inf ones;
+        # with YFM_ESCALATE off this whole block is skipped and the
+        # historical drop-the-start flow below runs untouched
+        dead = np.where(np.isfinite(lls) & (fs < _PENALTY_THRESH),
+                        lls, -np.inf)
+        traces, dead, xs_np = _apply_ladder(spec, data, xs_np, raw, dead,
+                                            start, end)
+        for t in traces:
+            recovered[t.start] = t.recovered
+        lls = np.where(recovered, dead, lls)
+        fs = np.where(recovered, -dead, fs)
     j = int(np.nanargmax(np.where(np.isfinite(lls), lls, -np.inf)))
-    if kind == "fused":
+    if kind == "fused" and not recovered[j]:
         # trust-but-verify the kernel-reported optimum: ONE scan-engine eval
         # of the winner.  Motivated by the round-3 window-1 anomaly (device
         # config-2 optimum collapsed 16,100 → −30,278 with the restructured
         # adjoint unverified on hardware, BASELINE.md) — a silent kernel/
         # compiler fault must not corrupt results unnoticed.  Fallback by
         # default until the on-chip grad gates pass (_fused_check_mode).
+        # A ladder-recovered winner is skipped: its loglik already came from
+        # a scan-engine (or sqrt) re-evaluation, not the fused kernel.
         ll_scan = float(_jitted_loss(spec, T)(
-            transform_params(spec, jnp.asarray(np.asarray(xs)[j],
-                                               dtype=spec.dtype)),
+            transform_params(spec, jnp.asarray(xs_np[j], dtype=spec.dtype)),
             data, jnp.asarray(start), jnp.asarray(end)))
         if _fused_disagrees(lls[j], ll_scan):
             _warn_fused_disagreement("estimate()", lls[j], ll_scan)
             if _fused_check_mode() == "fallback":
                 return estimate(spec, data, all_params, start, end, max_iters,
                                 g_tol, f_abstol, printing, objective="vmap")
+    _record_report(lls, traces, j)
     if printing:
         print(f"✓ Best LL = {lls[j]} from starting point {j + 1}/{len(lls)}")
-    best = transform_params(spec, jnp.asarray(np.asarray(xs)[j], dtype=spec.dtype))
+    best = transform_params(spec, jnp.asarray(xs_np[j], dtype=spec.dtype))
     init = transform_params(spec, jnp.asarray(raw[j], dtype=spec.dtype))
     # a start parked on the penalty plateau has zero clamped gradients — that
     # is an invalid run, not a converged one (threshold below the f32-rounded
-    # penalty: float32(1e12) ≈ 0.99999999e12)
-    valid_j = np.isfinite(lls[j]) and fs[j] < _PENALTY_THRESH
+    # penalty: float32(1e12) ≈ 0.99999999e12).  A ladder-recovered start is a
+    # *rescued evaluation*, not an optimizer convergence.
+    valid_j = np.isfinite(lls[j]) and fs[j] < _PENALTY_THRESH \
+        and not recovered[j]
     conv = Convergence(bool(np.asarray(convs)[j]) and valid_j,
                        int(np.asarray(its)[j]))
     return np.asarray(init), float(lls[j]), np.asarray(best), conv
@@ -859,6 +936,25 @@ def estimate_steps(spec: ModelSpec, data, all_params, param_groups: Sequence[str
         for j in range(S):
             print(f"✓ LL = {prev_ll[j]} from start {j + 1}")
 
+    # escalation ladder (YFM_ESCALATE, robustness/ladder.py): starts whose
+    # cascade came back non-finite are retried through scan → sqrt → jitter
+    # → ×0.95 instead of being dropped; recovered starts re-enter the
+    # best-of comparison with their rescued loglik (and modified point, for
+    # the jitter/shrink rungs).  Off by default — the historical behavior.
+    ladder_traces = []
+    escal_recovered = np.zeros(S, dtype=bool)
+    if _ladder.escalation_enabled() and not np.isfinite(prev_ll).all():
+        traces, lad_ll, rows_new = _apply_ladder(
+            spec, data, np.asarray(X, dtype=np.float64), raw.T, prev_ll,
+            start, end)
+        ladder_traces = traces
+        for t in traces:
+            escal_recovered[t.start] = t.recovered
+        prev_ll = np.where(escal_recovered, lad_ll, prev_ll)
+        X = jnp.asarray(np.where(escal_recovered[:, None], rows_new,
+                                 np.asarray(X, dtype=np.float64)),
+                        dtype=spec.dtype)
+
     best_j = int(np.argmax(np.where(np.isfinite(prev_ll), prev_ll, -np.inf)))
     X_np = np.asarray(X, dtype=np.float64)
     best = np.asarray(transform_params(spec, jnp.asarray(X_np[best_j], dtype=spec.dtype)))
@@ -884,10 +980,12 @@ def estimate_steps(spec: ModelSpec, data, all_params, param_groups: Sequence[str
                                       max_group_iters, tol, optimizers,
                                       start, end, max_tries, printing,
                                       _force_scan=True, checkpoint=checkpoint)
+    _record_report(prev_ll, ladder_traces, best_j)
     if printing:
         print(f"✓ Best overall LL = {prev_ll[best_j]} from start {best_j + 1}")
     return init, float(prev_ll[best_j]), best, Convergence(
-        bool(converged[best_j]), int(iters_done[best_j]))
+        bool(converged[best_j]) and not escal_recovered[best_j],
+        int(iters_done[best_j]))
 
 
 # ---------------------------------------------------------------------------

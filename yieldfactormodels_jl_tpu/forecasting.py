@@ -131,10 +131,24 @@ def run_single_window_task(
                 group_tol, checkpoint=ckpt)
         if sentinel_policy == "retry" and not np.isfinite(loss):
             from .orchestration.retry import SentinelFailure
+            from .robustness import taxonomy
 
+            # decode WHY before surfacing: prefer the multi-start report's
+            # ladder diagnosis (estimate_steps ran it when YFM_ESCALATE is
+            # armed), else one coded scan-engine eval at the returned point
+            code = 0
+            for t in opt.last_multistart_report().get("ladder", ()):
+                code |= int(t.get("code", 0))
+            if code == 0:
+                try:
+                    _, code = taxonomy.diagnose(spec, params, data,
+                                                start=0, end=task_id)
+                except Exception:  # noqa: BLE001 — diagnosis must not mask
+                    code = 0       # the original failure
             raise SentinelFailure(
                 f"estimation for {window_type} window {task_id} returned a "
-                f"non-finite loss sentinel ({loss})")
+                f"non-finite loss sentinel ({loss})",
+                seam="estimate", code=code)
     else:
         params = db.read_params_from_db(spec, task_id, cur,
                                         window_type=window_type)[:, 0]

@@ -38,6 +38,7 @@ from jax import lax
 
 from .afns import afns_loadings, yield_adjustment
 from .loadings import LAMBDA_FLOOR, dns_lambda, dns_loadings, dns_slope_curvature
+from ..robustness import taxonomy as tax
 from .params import KalmanParams, unpack_kalman
 from .specs import ModelSpec
 
@@ -125,6 +126,11 @@ def _step(spec: ModelSpec, kp: KalmanParams, Z_const, d_const, state: KalmanStat
 
     logdet_F = 2.0 * jnp.sum(jnp.log(jnp.diagonal(cho_safe)))
     ll = -0.5 * (logdet_F + v @ Fi_v + N * _LOG_2PI)
+    # taxonomy bitmask beside the −Inf sentinel (robustness/taxonomy.py): a
+    # failed innovation Cholesky is the joint form's non-PD failure; a
+    # non-finite ll behind a *successful* factorization is a blown-up state
+    code = tax.bit(obs & ~cho_ok, tax.CHOL_BREAKDOWN) \
+        | tax.bit(obs & cho_ok & ~jnp.isfinite(ll), tax.STATE_EXPLODED)
     ll = jnp.where(obs & cho_ok, ll, jnp.where(obs, -jnp.inf, 0.0))
 
     outs = {
@@ -141,6 +147,7 @@ def _step(spec: ModelSpec, kp: KalmanParams, Z_const, d_const, state: KalmanStat
         "P_pred": P,
         "beta_upd": beta_upd,
         "P_upd": P_upd,
+        "code": code,
     }
     return KalmanState(beta_next, P_next), outs
 
@@ -205,6 +212,25 @@ def get_loss(spec: ModelSpec, params, data, start=0, end=None):
     contrib = loglik_contrib_mask(start, end, T)
     loglik = jnp.sum(jnp.where(contrib, outs["ll"], 0.0))
     return jnp.where(jnp.isfinite(loglik), loglik, -jnp.inf)
+
+
+def get_loss_coded(spec: ModelSpec, params, data, start=0, end=None):
+    """``(loss, code)``: :func:`get_loss` plus the taxonomy bitmask the scan
+    already carries (robustness/taxonomy.py) — same loss value; the code is
+    dead-code-eliminated from plain ``get_loss`` consumers."""
+    T = data.shape[1]
+    if end is None:
+        end = T
+    _, _, _, outs = _scan_filter(spec, params, data, start, end)
+    contrib = loglik_contrib_mask(start, end, T)
+    loglik = jnp.sum(jnp.where(contrib, outs["ll"], 0.0))
+    loss = jnp.where(jnp.isfinite(loglik), loglik, -jnp.inf)
+    code = tax.params_code(params) \
+        | tax.combine(jnp.where(contrib, outs["code"], jnp.int32(0))) \
+        | tax.bit(~jnp.any(contrib & outs["obs"]), tax.MISSING_ALL_OBS)
+    code = code | tax.bit(~jnp.isfinite(loss) & (code == 0),
+                          tax.STATE_EXPLODED)
+    return loss, code
 
 
 def get_loss_array(spec: ModelSpec, params, data, start=0, end=None, K: int = 1):

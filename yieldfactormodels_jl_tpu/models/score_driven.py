@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.linalg import ols_solve
+from ..robustness import taxonomy as tax
 from .common import partial_nan_poison, window_contributions
 from .loadings import dns_loadings, neural_loadings
 from .params import MSEDParams, unpack_msed
@@ -128,6 +129,11 @@ def _step(spec: ModelSpec, mp: MSEDParams, state: MSEDState, y, observed):
         # windows this is pure OLS, independent of (δ, Φ): the fact the
         # closed-form group-"2" solve in estimation/optimize.py exploits
         "beta_obs": beta_obs,
+        # taxonomy bitmask beside the −Inf sentinel (robustness/taxonomy.py):
+        # a non-finite trajectory on an observed step — overflowed γ update,
+        # or the reference-parity partial-NaN β poisoning — is STATE_EXPLODED
+        "code": tax.bit(obs & ~jnp.all(jnp.isfinite(pred)),
+                        tax.STATE_EXPLODED),
     }
     return MSEDState(gamma_next, beta_next, ewma, count), out
 
@@ -170,6 +176,29 @@ def get_loss(spec: ModelSpec, params, data, start=0, end=None, K: int = 1):
         total = total + jnp.sum(window_contributions(outs["pred"], data, start, end))
     loss = total / spec.N / nobs / K
     return jnp.where(jnp.isfinite(loss), loss, -jnp.inf)
+
+
+def get_loss_coded(spec: ModelSpec, params, data, start=0, end=None):
+    """``(loss, code)``: :func:`get_loss` (K=1) plus the taxonomy bitmask
+    riding the scan outputs (robustness/taxonomy.py)."""
+    T = data.shape[1]
+    if end is None:
+        end = T
+    nobs = end - start
+    mp = unpack_msed(spec, params)
+    _, _, outs = scan_filter(spec, params, data, start, end, init_state(spec, mp))
+    total = jnp.sum(window_contributions(outs["pred"], data, start, end))
+    loss = total / spec.N / nobs
+    loss = jnp.where(jnp.isfinite(loss), loss, -jnp.inf)
+    t_idx = jnp.arange(T)
+    in_win = (t_idx >= start) & (t_idx < end)
+    observed = in_win & jnp.isfinite(data[0, :])  # filter.jl:53 convention
+    code = tax.params_code(params) \
+        | tax.combine(jnp.where(in_win, outs["code"], jnp.int32(0))) \
+        | tax.bit(~jnp.any(observed), tax.MISSING_ALL_OBS)
+    code = code | tax.bit(~jnp.isfinite(loss) & (code == 0),
+                          tax.STATE_EXPLODED)
+    return loss, code
 
 
 def get_loss_array(spec: ModelSpec, params, data, start=0, end=None, K: int = 1):

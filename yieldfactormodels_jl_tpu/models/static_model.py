@@ -17,6 +17,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.linalg import ols_solve
+from ..robustness import taxonomy as tax
 from .common import partial_nan_poison, window_contributions
 from .loadings import dns_loadings, neural_loadings
 from .params import StaticParams, unpack_static
@@ -89,6 +90,31 @@ def get_loss(spec: ModelSpec, params, data, start=0, end=None, K: int = 1):
         total = total + jnp.sum(window_contributions(outs["pred"], data, start, end))
     loss = total / spec.N / nobs / K
     return jnp.where(jnp.isfinite(loss), loss, -jnp.inf)
+
+
+def get_loss_coded(spec: ModelSpec, params, data, start=0, end=None):
+    """``(loss, code)``: :func:`get_loss` (K=1) plus the taxonomy bitmask
+    (robustness/taxonomy.py) — STATE_EXPLODED for a non-finite trajectory on
+    an observed step (incl. the reference-parity partial-NaN β poisoning),
+    MISSING_ALL_OBS for a window with no observed columns."""
+    T = data.shape[1]
+    if end is None:
+        end = T
+    nobs = end - start
+    _, _, outs = _run(spec, params, data, start, end)
+    total = jnp.sum(window_contributions(outs["pred"], data, start, end))
+    loss = total / spec.N / nobs
+    loss = jnp.where(jnp.isfinite(loss), loss, -jnp.inf)
+    t_idx = jnp.arange(T)
+    in_win = (t_idx >= start) & (t_idx < end)
+    observed = in_win & jnp.isfinite(data[0, :])  # filter.jl:95 convention
+    bad_step = in_win & ~jnp.all(jnp.isfinite(outs["pred"]), axis=-1)
+    code = tax.params_code(params) \
+        | tax.bit(jnp.any(bad_step), tax.STATE_EXPLODED) \
+        | tax.bit(~jnp.any(observed), tax.MISSING_ALL_OBS)
+    code = code | tax.bit(~jnp.isfinite(loss) & (code == 0),
+                          tax.STATE_EXPLODED)
+    return loss, code
 
 
 def get_loss_array(spec: ModelSpec, params, data, start=0, end=None, K: int = 1):

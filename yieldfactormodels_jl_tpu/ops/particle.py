@@ -29,6 +29,7 @@ from ..models.afns import afns_loadings, yield_adjustment
 from ..models.loadings import dns_loadings
 from ..models.params import unpack_kalman
 from ..models.specs import ModelSpec
+from ..robustness import taxonomy as tax
 
 _LOG_2PI = math.log(2.0 * math.pi)
 
@@ -186,6 +187,7 @@ def particle_filter_loglik(
     sv_sigma: float = 0.2,
     ess_threshold: float = 0.5,
     noise=None,
+    with_code: bool = False,
 ):
     """Marginal log-likelihood estimate under SV measurement errors.
 
@@ -202,6 +204,11 @@ def particle_filter_loglik(
     this is the deterministic contract the Pallas kernel
     (``ops/pallas_pf.py``) is parity-tested against, and what common-random-
     number estimation drivers pass.
+
+    ``with_code=True`` additionally returns the taxonomy bitmask
+    (robustness/taxonomy.py) beside the loss — the loss value itself is
+    unchanged, and the default single-return signature is preserved for
+    every existing caller.
     """
     kp = unpack_kalman(spec, params)
     Pn = n_particles
@@ -248,7 +255,12 @@ def particle_filter_loglik(
         h_new = jnp.where(do_resample, h_new[idx], h_new)
         logw_out = jnp.where(do_resample,
                              jnp.full_like(logw_norm, log_uniform), logw_norm)
-        return PFState(beta, S, h_new, logw_out, key), step_ll
+        # taxonomy channel beside the −Inf sentinel: a contributing step whose
+        # mixture weight collapsed (every draw's Kalman step died — non-PD
+        # innovation under an invalid σ², or an overflowed e^h) — decoded
+        # only at the driver (robustness/taxonomy.py)
+        dead = contributes & ~jnp.isfinite(step_ll)
+        return PFState(beta, S, h_new, logw_out, key), (step_ll, dead)
 
     t_idx = jnp.arange(T - 1)
     logw0 = jnp.full((Pn,), log_uniform, dtype=params.dtype)
@@ -265,6 +277,14 @@ def particle_filter_loglik(
                 f"got {normals.shape} / {uniforms.shape}")
         key = jax.random.PRNGKey(0) if key is None else key  # unused carry
         xs = (data.T[:-1], t_idx, normals.astype(dtype), uniforms.astype(dtype))
-    _, lls = lax.scan(body, PFState(beta0, S0b, h0, logw0, key), xs)
+    _, (lls, dead) = lax.scan(body, PFState(beta0, S0b, h0, logw0, key), xs)
     total = jnp.sum(lls)
-    return jnp.where(fac_ok & jnp.isfinite(total), total, -jnp.inf)
+    loss = jnp.where(fac_ok & jnp.isfinite(total), total, -jnp.inf)
+    code = tax.params_code(params) \
+        | tax.bit(~fac_ok, tax.CHOL_BREAKDOWN) \
+        | tax.bit(jnp.any(dead), tax.NONPSD_INNOVATION)
+    code = code | tax.bit(~jnp.isfinite(loss) & (code == 0),
+                          tax.STATE_EXPLODED)
+    if with_code:
+        return loss, code
+    return loss

@@ -26,6 +26,7 @@ from jax import lax
 
 from ..models import kalman as K
 from ..models.specs import ModelSpec
+from ..robustness import taxonomy as tax
 
 
 def forward_moments(spec: ModelSpec, params, data, start, end, engine=None):
@@ -100,12 +101,17 @@ def smooth(spec: ModelSpec, params, data, start=0, end=None, engine=None):
     # sentinel convention: a failed forward Cholesky surfaces as ll = −Inf in
     # the filter (kalman._step); the moments it produced are meaningless, so
     # poison the whole output with NaN instead of returning finite garbage
-    # (mirrors get_loss's −Inf and the particle filter's draw-level −Inf)
+    # (mirrors get_loss's −Inf and the particle filter's draw-level −Inf).
+    # The taxonomy code rides along (robustness/taxonomy.py): the forward
+    # pass's per-step bits say WHY the moments went NaN, and NAN_STATE marks
+    # the poisoning itself — decoded only at the driver.
     ok = jnp.all(outs["ll"] > -jnp.inf)
+    code = tax.combine(outs["code"]) | tax.bit(~ok, tax.NAN_STATE)
     nan = jnp.asarray(jnp.nan, dtype=beta_smooth.dtype)
     return {
         "beta_smooth": jnp.where(ok, beta_smooth.T, nan),
         "P_smooth": jnp.where(ok, P_smooth, nan),
         "beta_filt": jnp.where(ok, b_upd.T, nan),
         "P_filt": jnp.where(ok, P_upd, nan),
+        "code": code,
     }

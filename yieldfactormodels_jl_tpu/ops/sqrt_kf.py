@@ -37,20 +37,26 @@ from ..models.kalman import (
 )
 from ..models.params import unpack_kalman
 from ..models.specs import ModelSpec
+from ..robustness import taxonomy as tax
 
 _LOG_2PI = math.log(2.0 * math.pi)
 
 
 def _potter_update(Z, y_eff, beta, S, obs_var):
-    """N sequential Potter square-root updates.  Returns (β⁺, S⁺, ll, ok)."""
+    """N sequential Potter square-root updates.  Returns (β⁺, S⁺, ll, ok,
+    code) — ``code`` is the taxonomy bitmask beside ``ok``
+    (robustness/taxonomy.py)."""
     N = Z.shape[0]
 
     def body(carry, zi_yi):
-        b, Sm, ll, ok = carry
+        b, Sm, ll, ok, code = carry
         z, y_i = zi_yi
         phi = Sm.T @ z                    # (Ms,)
         f = phi @ phi + obs_var
-        ok = ok & (f > 0) & jnp.isfinite(f)
+        f_fin = jnp.isfinite(f)
+        ok = ok & (f > 0) & f_fin
+        code = code | tax.bit(f_fin & (f <= 0), tax.NONPSD_INNOVATION) \
+            | tax.bit(~f_fin, tax.STATE_EXPLODED)
         fsafe = jnp.where(f > 0, f, 1.0)
         v = y_i - z @ b
         Sphi = Sm @ phi                   # = P z
@@ -58,19 +64,40 @@ def _potter_update(Z, y_eff, beta, S, obs_var):
         alpha = 1.0 / (fsafe + jnp.sqrt(jnp.maximum(obs_var, 0.0) * fsafe))
         Sm = Sm - alpha * jnp.outer(Sphi, phi)
         ll = ll - 0.5 * (jnp.log(fsafe) + v * v / fsafe + _LOG_2PI)
-        return (b, Sm, ll, ok), None
+        return (b, Sm, ll, ok, code), None
 
     zero = jnp.zeros((), dtype=S.dtype)
-    (beta_u, S_u, ll, ok), _ = lax.scan(
-        body, (beta, S, zero, jnp.bool_(True)), (Z, y_eff), length=N)
-    return beta_u, S_u, ll, ok
+    (beta_u, S_u, ll, ok, code), _ = lax.scan(
+        body, (beta, S, zero, jnp.bool_(True), tax.zero_code()),
+        (Z, y_eff), length=N)
+    return beta_u, S_u, ll, ok, code
 
 
-def get_loss(spec: ModelSpec, params, data, start=0, end=None):
-    """Gaussian loglik with square-root covariance propagation.
+def _psd_sqrt_factor(M, floor, dtype):
+    """A (possibly non-triangular) square root of the PSD *projection* of a
+    symmetric matrix: eigendecompose, clip eigenvalues at ``floor``, return
+    ``V·diag(√w̃)`` so the product is the nearest-PSD reconstruction.  The
+    Potter/QR recursions only need S Sᵀ = P, not triangularity.  This is the
+    escalation ladder's square-root rescue (robustness/ladder.py, after
+    Yaghoobi et al., arXiv:2207.00426): breakdown-prone covariances re-enter
+    the filter through a factorization that cannot go indefinite."""
+    w, V = jnp.linalg.eigh(0.5 * (M + M.T))
+    w = jnp.maximum(w, jnp.asarray(floor, dtype=dtype))
+    return V * jnp.sqrt(w)[None, :]
 
-    Same value as ``univariate_kf.get_loss`` in exact arithmetic; in f32 it
-    trades ~2 small QRs worth of work per step for a guaranteed-PSD P.
+
+def _loss_coded(spec: ModelSpec, params, data, start=0, end=None,
+                init_psd_floor=None):
+    """Shared square-root forward pass.  Returns ``(loss, code)``.
+
+    ``init_psd_floor=None`` is the production engine: a failed initial
+    factorization (indefinite P₀, invalid Ω) is the −Inf sentinel, bit-exact
+    with the historical ``get_loss``.  With a float floor, P₀ and Ω_state are
+    PSD-*projected* (eigenvalue clip at the floor) before factoring instead
+    of poisoning — the ladder's recovery mode, NOT the parity path: at a
+    degenerate parameter point the exact likelihood does not exist, and the
+    projected filter is the numerically-safe surrogate the escalation ladder
+    evaluates (its acceptance is decided at the driver, never silently).
     """
     kp = unpack_kalman(spec, params)
     dtype = kp.Phi.dtype
@@ -81,19 +108,31 @@ def get_loss(spec: ModelSpec, params, data, start=0, end=None):
         d_const = jnp.zeros((spec.N,), dtype=dtype)
 
     state0 = init_state(spec, kp)
-    # factor P0 (symmetrized + jitter: the kron solve is only approximately
-    # symmetric in f32) and Ω_state once
-    P0 = 0.5 * (state0.P + state0.P.T) + 1e-9 * jnp.eye(Ms, dtype=dtype)
-    S0 = jnp.linalg.cholesky(P0)
-    Om = 0.5 * (kp.Omega_state + kp.Omega_state.T) + 1e-12 * jnp.eye(Ms, dtype=dtype)
-    C = jnp.linalg.cholesky(Om).T          # upper factor: Ω = CᵀC
-    # a failed factorization (indefinite P0 from a non-stationary Φ draw, or
-    # invalid Ω) is the −Inf sentinel, like every other engine's failed
-    # Cholesky — substitute finite placeholders only to keep the scan
-    # arithmetic NaN-free, and poison the total at the end
-    fac_ok = jnp.all(jnp.isfinite(S0)) & jnp.all(jnp.isfinite(C))
-    S0 = jnp.where(jnp.isfinite(S0), S0, jnp.eye(Ms, dtype=dtype) * 1e-3)
-    C = jnp.where(jnp.isfinite(C), C, jnp.zeros_like(C))
+    if init_psd_floor is None:
+        # factor P0 (symmetrized + jitter: the kron solve is only
+        # approximately symmetric in f32) and Ω_state once
+        P0 = 0.5 * (state0.P + state0.P.T) + 1e-9 * jnp.eye(Ms, dtype=dtype)
+        S0 = jnp.linalg.cholesky(P0)
+        Om = 0.5 * (kp.Omega_state + kp.Omega_state.T) \
+            + 1e-12 * jnp.eye(Ms, dtype=dtype)
+        C = jnp.linalg.cholesky(Om).T      # upper factor: Ω = CᵀC
+        # a failed factorization (indefinite P0 from a non-stationary Φ draw,
+        # or invalid Ω) is the −Inf sentinel, like every other engine's
+        # failed Cholesky — substitute finite placeholders only to keep the
+        # scan arithmetic NaN-free, and poison the total at the end
+        fac_ok = jnp.all(jnp.isfinite(S0)) & jnp.all(jnp.isfinite(C))
+        S0 = jnp.where(jnp.isfinite(S0), S0, jnp.eye(Ms, dtype=dtype) * 1e-3)
+        C = jnp.where(jnp.isfinite(C), C, jnp.zeros_like(C))
+    else:
+        # ladder recovery mode: PSD-project instead of poisoning; only
+        # non-finite inputs (TRANSFORM_OVERFLOW class) still fail
+        S0 = _psd_sqrt_factor(jnp.where(jnp.isfinite(state0.P), state0.P, 0.0),
+                              init_psd_floor, dtype)
+        Cl = _psd_sqrt_factor(jnp.where(jnp.isfinite(kp.Omega_state),
+                                        kp.Omega_state, 0.0),
+                              init_psd_floor, dtype)
+        C = Cl.T                           # Ω̃ = CᵀC
+        fac_ok = jnp.all(jnp.isfinite(S0)) & jnp.all(jnp.isfinite(C))
 
     T = data.shape[1]
     if end is None:
@@ -114,7 +153,8 @@ def get_loss(spec: ModelSpec, params, data, start=0, end=None):
             ysafe = jnp.where(jnp.isfinite(y), y, Z @ beta + d_const)
             y_eff = ysafe - d_const
         obs = obs_t & jnp.all(jnp.isfinite(y))
-        beta_u, S_u, ll, ok = _potter_update(Z, y_eff, beta, S, kp.obs_var)
+        beta_u, S_u, ll, ok, code = _potter_update(Z, y_eff, beta, S,
+                                                   kp.obs_var)
         obs_f = obs.astype(dtype)
         beta_m = beta + (beta_u - beta) * obs_f
         S_m = S + (S_u - S) * obs_f
@@ -126,8 +166,35 @@ def get_loss(spec: ModelSpec, params, data, start=0, end=None):
         ll_t = jnp.where(obs & con_t,
                          jnp.where(ok, ll, -jnp.inf),
                          0.0)
-        return (beta_next, S_next), ll_t
+        code_t = jnp.where(obs & con_t, code, jnp.int32(0))
+        return (beta_next, S_next), (ll_t, code_t, obs & con_t)
 
-    _, lls = lax.scan(body, (state0.beta, S0), (data.T, observed, contrib))
+    _, (lls, codes, obs_c) = lax.scan(body, (state0.beta, S0),
+                                      (data.T, observed, contrib))
     total = jnp.sum(lls)
-    return jnp.where(fac_ok & jnp.isfinite(total), total, -jnp.inf)
+    loss = jnp.where(fac_ok & jnp.isfinite(total), total, -jnp.inf)
+    code = tax.params_code(params) | tax.combine(codes) \
+        | tax.bit(~fac_ok, tax.CHOL_BREAKDOWN) \
+        | tax.bit(~jnp.any(obs_c), tax.MISSING_ALL_OBS)
+    code = code | tax.bit(~jnp.isfinite(loss) & (code == 0),
+                          tax.STATE_EXPLODED)
+    return loss, code
+
+
+def get_loss(spec: ModelSpec, params, data, start=0, end=None,
+             init_psd_floor=None):
+    """Gaussian loglik with square-root covariance propagation.
+
+    Same value as ``univariate_kf.get_loss`` in exact arithmetic; in f32 it
+    trades ~2 small QRs worth of work per step for a guaranteed-PSD P.
+    ``init_psd_floor`` selects the ladder's PSD-projected recovery mode
+    (see :func:`_loss_coded`); leave it ``None`` for the parity engine.
+    """
+    loss, _ = _loss_coded(spec, params, data, start, end, init_psd_floor)
+    return loss
+
+
+def get_loss_coded(spec: ModelSpec, params, data, start=0, end=None,
+                   init_psd_floor=None):
+    """``(loss, code)`` — :func:`get_loss` plus its taxonomy bitmask."""
+    return _loss_coded(spec, params, data, start, end, init_psd_floor)

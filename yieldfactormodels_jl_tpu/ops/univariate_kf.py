@@ -45,35 +45,43 @@ from ..models.kalman import (
 )
 from ..models.params import unpack_kalman
 from ..models.specs import ModelSpec
+from ..robustness import taxonomy as tax
 
 _LOG_2PI = math.log(2.0 * math.pi)
 
 
 def _sequential_update(Z, y_eff, beta, P, obs_var):
-    """N scalar measurement updates.  Returns (β⁺, P⁺, loglik, ok)."""
+    """N scalar measurement updates.  Returns (β⁺, P⁺, loglik, ok, code) —
+    ``code`` is the taxonomy bitmask riding the same carry as ``ok``
+    (robustness/taxonomy.py): NONPSD_INNOVATION for a finite f ≤ 0,
+    STATE_EXPLODED for a non-finite innovation chain."""
     N = Z.shape[0]
 
     def body(carry, zi_yi):
-        b, Pm, ll, ok = carry
+        b, Pm, ll, ok, code = carry
         z, y_i = zi_yi
         zP = z @ Pm                     # (Ms,)
         f = zP @ z + obs_var
-        ok = ok & (f > 0) & jnp.isfinite(f)
+        f_fin = jnp.isfinite(f)
+        ok = ok & (f > 0) & f_fin
+        code = code | tax.bit(f_fin & (f <= 0), tax.NONPSD_INNOVATION) \
+            | tax.bit(~f_fin, tax.STATE_EXPLODED)
         fsafe = jnp.where(f > 0, f, 1.0)
         v = y_i - z @ b
         K = zP / fsafe
         b = b + K * v
         Pm = Pm - jnp.outer(K, zP)
         ll = ll - 0.5 * (jnp.log(fsafe) + v * v / fsafe + _LOG_2PI)
-        return (b, Pm, ll, ok), None
+        return (b, Pm, ll, ok, code), None
 
     zero = jnp.zeros((), dtype=P.dtype)
-    (beta_u, P_u, ll, ok), _ = lax.scan(
-        body, (beta, P, zero, jnp.bool_(True)), (Z, y_eff), length=N)
+    (beta_u, P_u, ll, ok, code), _ = lax.scan(
+        body, (beta, P, zero, jnp.bool_(True), tax.zero_code()),
+        (Z, y_eff), length=N)
     # symmetrize: the rank-1 downdates drift asymmetric in f32 over hundreds
     # of steps, which the joint form's (I−KZ)P also suffers — cheap insurance
     P_u = 0.5 * (P_u + P_u.T)
-    return beta_u, P_u, ll, ok
+    return beta_u, P_u, ll, ok, code
 
 
 def _filter_scan(spec: ModelSpec, params, data, start, end):
@@ -112,20 +120,23 @@ def _filter_scan(spec: ModelSpec, params, data, start, end):
             ysafe = jnp.where(jnp.isfinite(y), y, Z @ beta + d_const)
             y_eff = ysafe - d_const
         obs = obs_t & jnp.all(jnp.isfinite(y))
-        beta_u, P_u, ll, ok = _sequential_update(Z, y_eff, beta, P, kp.obs_var)
+        beta_u, P_u, ll, ok, code = _sequential_update(Z, y_eff, beta, P,
+                                                       kp.obs_var)
         obs_f = obs.astype(dtype)
         beta_m = beta + (beta_u - beta) * obs_f
         P_m = P + (P_u - P) * obs_f
         beta_next = kp.delta + kp.Phi @ beta_m
         P_next = kp.Phi @ P_m @ kp.Phi.T + kp.Omega_state
         ll_out = jnp.where(obs & ok, ll, jnp.where(obs, -jnp.inf, 0.0))
+        code_out = jnp.where(obs, code, jnp.int32(0))
         return (KalmanState(beta_next, P_next),
-                (beta, P, beta_m, P_m, ll_out))
+                (beta, P, beta_m, P_m, ll_out, obs, code_out))
 
-    _, (b_pred, P_pred, b_upd, P_upd, lls) = lax.scan(
+    _, (b_pred, P_pred, b_upd, P_upd, lls, obs_steps, codes) = lax.scan(
         body, state0, (data.T, observed))
     return kp, {"beta_pred": b_pred, "P_pred": P_pred,
-                "beta_upd": b_upd, "P_upd": P_upd, "ll": lls}
+                "beta_upd": b_upd, "P_upd": P_upd, "ll": lls,
+                "obs": obs_steps, "code": codes}
 
 
 def get_loss(spec: ModelSpec, params, data, start=0, end=None):
@@ -145,6 +156,28 @@ def get_loss(spec: ModelSpec, params, data, start=0, end=None):
     # already 0 on unobserved steps and −Inf on failed observed ones
     total = jnp.sum(jnp.where(contrib, outs["ll"], 0.0))
     return jnp.where(jnp.isfinite(total), total, -jnp.inf)
+
+
+def get_loss_coded(spec: ModelSpec, params, data, start=0, end=None):
+    """``(loss, code)``: :func:`get_loss` plus its taxonomy bitmask
+    (robustness/taxonomy.py).  Identical loss value — the code rides the scan
+    carry the kernel already threads, so callers that ignore it (every
+    ``get_loss`` consumer) have it dead-code-eliminated by XLA."""
+    T = data.shape[1]
+    if end is None:
+        end = T
+    _, outs = _filter_scan(spec, params, data, start, end)
+    contrib = loglik_contrib_mask(start, end, T)
+    total = jnp.sum(jnp.where(contrib, outs["ll"], 0.0))
+    loss = jnp.where(jnp.isfinite(total), total, -jnp.inf)
+    code = tax.params_code(params) \
+        | tax.combine(jnp.where(contrib, outs["code"], jnp.int32(0))) \
+        | tax.bit(~jnp.any(contrib & outs["obs"]), tax.MISSING_ALL_OBS)
+    # a −Inf loss must never decode as OK: non-finite total without a more
+    # specific cause (e.g. NaN data inside the window) is a blown-up state
+    code = code | tax.bit(~jnp.isfinite(loss) & (code == 0),
+                          tax.STATE_EXPLODED)
+    return loss, code
 
 
 def filter_moments(spec: ModelSpec, params, data, start=0, end=None):

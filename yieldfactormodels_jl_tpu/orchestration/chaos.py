@@ -20,6 +20,13 @@ Arming is env-gated and off by default:
 - ``YFM_CHAOS_SEED``: seed for probability triggers (default ``0``) so chaos
   runs replay bit-for-bit.
 
+Beyond the worker-death seams, NUMERIC seams share the same grammar but
+corrupt data instead of raising (:func:`should_inject` returns the trigger
+decision and the call site applies the fault): ``nan_curve`` and
+``nonpsd_cov`` poison the online serving state (serving/service.py) to
+exercise the health-watch → rebuild → stale-flag path end-to-end
+(docs/DESIGN.md §11).
+
 Tests and benchmarks arm programmatically via :func:`configure` /
 :func:`reset` (reset also re-reads the environment on the next hit).
 """
@@ -86,14 +93,10 @@ def hits(seam: str) -> int:
         return _hits.get(seam, 0)
 
 
-def maybe_fail(seam: str) -> None:
-    """Raise :class:`ChaosInjected` if ``seam`` is armed and triggers.
-
-    No-op (one dict lookup) when chaos is disarmed — safe on hot driver
-    paths.  Thread-safe: concurrent in-process workers share the counters,
-    so ``@N`` kills whichever worker reaches the seam N-th, like a real
-    preemption would.
-    """
+def _fires(seam: str) -> bool:
+    """Shared trigger machinery: count the hit and decide whether the armed
+    seam fires (holding the lock; deterministic for ``@N``, seeded-RNG for
+    probability triggers)."""
     global _config, _env_checked
     with _lock:
         if not _env_checked:
@@ -103,13 +106,33 @@ def maybe_fail(seam: str) -> None:
             _env_checked = True
         _hits[seam] = _hits.get(seam, 0) + 1
         if _config is None:
-            return
+            return False
         arm = _config.arms.get(seam)
         if arm is None:
-            return
+            return False
         kind, val = arm
-        fire = (_hits[seam] == val) if kind == "count" \
+        return (_hits[seam] == val) if kind == "count" \
             else (_config.rng.random() < val)
-    if fire:
+
+
+def maybe_fail(seam: str) -> None:
+    """Raise :class:`ChaosInjected` if ``seam`` is armed and triggers.
+
+    No-op (one dict lookup) when chaos is disarmed — safe on hot driver
+    paths.  Thread-safe: concurrent in-process workers share the counters,
+    so ``@N`` kills whichever worker reaches the seam N-th, like a real
+    preemption would.
+    """
+    if _fires(seam):
         raise ChaosInjected(f"chaos: injected fault at seam {seam!r} "
                             f"(hit {hits(seam)})")
+
+
+def should_inject(seam: str) -> bool:
+    """Non-raising trigger for NUMERIC seams: same arming/counters/specs as
+    :func:`maybe_fail`, but the caller applies the fault itself (e.g. the
+    serving layer's ``nan_curve``/``nonpsd_cov`` state corruptions,
+    docs/DESIGN.md §11) instead of simulating a worker death.  A numeric
+    seam must corrupt *data*, never raise — the whole point is exercising
+    the silent-poison recovery paths, not the exception paths."""
+    return _fires(seam)

@@ -18,7 +18,25 @@ from typing import NamedTuple, Optional
 class SentinelFailure(RuntimeError):
     """A sentinel value (−Inf loss / NaN moments) surfaced at the task
     boundary — retriable, since transient numeric blowups can depend on the
-    warm-start cascade's state at claim time."""
+    warm-start cascade's state at claim time.
+
+    Carries the ``seam`` it surfaced at and the taxonomy ``code``
+    (robustness/taxonomy.py) diagnosing WHY the sentinel fired, so the
+    queue's quarantine rows (which persist ``str(exception)``) are
+    actionable instead of a bare "non-finite loss"."""
+
+    def __init__(self, message: str, seam: Optional[str] = None,
+                 code: int = 0):
+        self.seam = seam
+        self.code = int(code)
+        detail = message
+        if seam:
+            detail += f" [seam={seam}]"
+        if self.code:
+            from ..robustness import taxonomy as _tax  # lazy: keep retry light
+
+            detail += f" [cause={_tax.describe(self.code)}]"
+        super().__init__(detail)
 
 
 class RetryPolicy(NamedTuple):

@@ -1,0 +1,41 @@
+"""Numerics sentry (docs/DESIGN.md §11): diagnosed, recoverable failures.
+
+Three layers over the repo-wide sentinel convention (CLAUDE.md / DESIGN §4):
+
+- ``taxonomy``: a jit-compatible int32 failure bitmask threaded alongside the
+  −Inf/NaN sentinels through every filter kernel — sentinels stay silent
+  inside jit, but now say *why* once a driver decodes them;
+- ``ladder``: a deterministic, env-gated (``YFM_ESCALATE``) escalation ladder
+  that retries non-finite multi-start results through progressively more
+  robust evaluations (scan re-eval → square-root filter → jittered covariance
+  regularization → the reference's ×0.95 shrink) instead of dropping them;
+- ``health``: online-serving state health — per-update min-eigenvalue watch,
+  periodic square-root refresh (``YFM_SERVE_REFRESH``), and the PSD scrub the
+  self-healing ``YieldCurveService`` rebuild path uses.
+
+Submodules and names are resolved lazily: the filter kernels import
+``taxonomy`` at module load, so this package must not import them back at
+import time (the ``ops/__init__`` idiom).
+"""
+
+from importlib import import_module
+
+_SUBMODULES = ("taxonomy", "ladder", "health")
+
+_EXPORTS = {
+    "decode": "taxonomy",
+    "describe": "taxonomy",
+    "LadderTrace": "ladder",
+    "escalation_enabled": "ladder",
+}
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        return import_module(f".{name}", __name__)
+    if name in _EXPORTS:
+        return getattr(import_module(f".{_EXPORTS[name]}", __name__), name)
+    raise AttributeError(name)
+
+
+__all__ = list(_SUBMODULES) + list(_EXPORTS)

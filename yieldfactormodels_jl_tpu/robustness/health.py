@@ -1,0 +1,97 @@
+"""Serving-state health: covariance watch, PSD scrub, refresh cadence.
+
+The online service (``serving/service.py``) advances one covariance through
+thousands of O(1) updates; nothing in that recursion re-validates the state,
+so drift (f32 rank-1 downdates), a poisoned update, or an operator mistake
+can leave the in-memory (β, P) silently broken until every later request
+fails.  This module is the driver-side watch (CLAUDE.md: loud checks belong
+at the driver, sentinels inside jit):
+
+- :func:`state_health` — min-eigenvalue / condition / finiteness of the
+  current :class:`~..serving.online.OnlineState`, as taxonomy bits
+  (robustness/taxonomy.py: ``NAN_STATE``, ``NONPSD_COV``);
+- :func:`refresh_state` — the periodic square-root scrub
+  (``YFM_SERVE_REFRESH``): symmetrize + eigenvalue-clip the covariance (or
+  re-triangularize the sqrt factor), the cheap cousin of re-freezing a
+  snapshot;
+- :func:`serve_refresh_every` — the env-gated cadence.
+
+Everything here is host-side NumPy on Ms ≤ 5 matrices (micro-seconds per
+update, no extra device programs); the jitted update kernels stay untouched.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from . import taxonomy as tax
+
+#: relative tolerance for "non-PSD": min eigenvalue below −EIG_TOL·max(1, λmax)
+EIG_TOL = 1e-8
+
+
+def serve_refresh_every(override=None) -> int:
+    """Updates between square-root refreshes of the online covariance:
+    the ``refresh_every`` constructor argument, else ``YFM_SERVE_REFRESH``
+    (int, seconds-free — it counts updates), else 0 = off."""
+    if override is not None:
+        return int(override)
+    env = os.environ.get("YFM_SERVE_REFRESH", "")
+    return int(env) if env else 0
+
+
+def _cov_matrix(cov, engine: str) -> np.ndarray:
+    """P itself for the univariate engine; S Sᵀ for the sqrt engine."""
+    c = np.asarray(cov, dtype=np.float64)
+    return c @ c.T if engine == "sqrt" else c
+
+
+def state_health(beta, cov, engine: str = "univariate") -> dict:
+    """Health report for one online state: taxonomy ``code`` (0 = healthy)
+    plus the numbers behind it (``min_eig``, ``cond``).  Never raises."""
+    b = np.asarray(beta, dtype=np.float64)
+    c = np.asarray(cov, dtype=np.float64)
+    if not (np.all(np.isfinite(b)) and np.all(np.isfinite(c))):
+        return dict(code=tax.NAN_STATE, min_eig=float("nan"),
+                    cond=float("nan"))
+    P = _cov_matrix(c, engine)
+    P = 0.5 * (P + P.T)
+    w = np.linalg.eigvalsh(P)
+    min_eig, max_eig = float(w[0]), float(w[-1])
+    cond = float(max_eig / min_eig) if min_eig > 0 else float("inf")
+    # NB the sqrt engine's S Sᵀ is PSD for ANY finite S, so this watch can
+    # only catch non-finite factors there — a finite-but-wrong factor is
+    # invisible by construction, which is why the serving driver forces a
+    # restore when it KNOWS the state was corrupted (chaos seams,
+    # service._heal_state(force=True))
+    nonpsd = min_eig < -EIG_TOL * max(1.0, abs(max_eig))
+    return dict(code=tax.NONPSD_COV if nonpsd else tax.OK,
+                min_eig=min_eig, cond=cond)
+
+
+def refresh_state(beta, cov, engine: str = "univariate", floor: float = 0.0):
+    """The periodic square-root refresh: return a scrubbed ``cov``.
+
+    - ``"univariate"``: P ← PSD projection of sym(P) (eigendecompose, clip
+      eigenvalues at ``floor``) — removes the asymmetry/indefiniteness the
+      rank-1 downdates accumulate, exactly the drift the long-horizon
+      regression test measures (tests/test_robustness.py);
+    - ``"sqrt"``: S ← chol of the projected S Sᵀ — re-triangularizes a factor
+      whose columns have rotated over many Potter updates.
+
+    Pure host-side float64 on an Ms×Ms matrix; β passes through untouched.
+    """
+    c = np.asarray(cov, dtype=np.float64)
+    P0 = _cov_matrix(c, engine)
+    P = 0.5 * (P0 + P0.T)
+    w, V = np.linalg.eigh(P)
+    w = np.maximum(w, floor)
+    P = (V * w) @ V.T
+    if engine == "sqrt":
+        # chol needs strictly PD; pad only if the clip left exact zeros
+        if not np.all(w > 0):
+            P = P + 1e-12 * np.trace(P) / P.shape[0] * np.eye(P.shape[0])
+        return np.linalg.cholesky(P)
+    return P

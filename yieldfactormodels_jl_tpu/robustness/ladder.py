@@ -1,0 +1,224 @@
+"""Engine escalation ladder: retry a dead start, don't drop it.
+
+The reference rescues an invalid start with a blind ×0.95 shrink and moves on
+(/root/reference/src/optimization.jl:173-184); the port kept that, so a start
+whose optimized point comes back non-finite is simply dropped from the
+multi-start comparison.  This module climbs a deterministic ladder of
+progressively more robust evaluations instead — the square-root rung is the
+numerically-safe fallback the literature prescribes for breakdown-prone
+covariance recursions (Yaghoobi et al., arXiv:2207.00426), and the repo
+already ships the engine (ops/sqrt_kf.py); it was just never reached
+automatically:
+
+1. ``scan``   one coded re-evaluation on the scan engine — recovers
+   fused-kernel artifacts (the trust-but-verify class, DESIGN §7) and
+   produces the taxonomy diagnosis every later rung reports;
+2. ``sqrt``   the square-root filter with PSD-*projected* initial moments
+   (``sqrt_kf.get_loss_coded(init_psd_floor=...)``): covariance breakdowns
+   (NONPSD_INNOVATION / CHOL_BREAKDOWN) re-enter through a factorization
+   that cannot go indefinite — parameters unchanged;
+3. ``jitter`` covariance regularization in constrained space: the Ω_state
+   Cholesky diagonal is inflated and the observation variance floored, then
+   re-evaluated on the scan engine — parameters (slightly) changed, and the
+   modified vector is carried back so downstream consumers see what was
+   actually evaluated;
+4. ``shrink`` the reference-parity ×0.95 raw shrink, up to 10 times.
+
+Everything is deterministic (no RNG anywhere — "jitter" is a fixed
+multiplicative inflation), so escalated runs replay bit-for-bit.  Arming is
+env-gated: ``YFM_ESCALATE=1`` enables the ladder in
+``estimation/optimize.estimate``/``estimate_steps``; the default ``0``
+reproduces the historical drop-the-start behavior exactly.  Per-start
+outcomes (codes + rungs climbed) land in the multi-start report
+(``optimize.last_multistart_report()``) and flow into the task boundary as
+``orchestration.retry.SentinelFailure``'s decoded cause.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import lru_cache
+from typing import NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from ..config import register_engine_cache
+from . import taxonomy as tax
+
+#: eigenvalue floor for the sqrt rung's PSD projection (see ops/sqrt_kf.py)
+SQRT_RESCUE_FLOOR = 1e-10
+#: multiplicative Ω-Cholesky-diagonal inflation + σ² floor for the jitter rung
+JITTER_SCALE = 1.05
+JITTER_ABS = 1e-6
+OBS_VAR_FLOOR = 1e-8
+#: reference parity: at most 10 ×0.95 shrinks (optimization.jl:173-184)
+SHRINK_TRIES = 10
+
+RUNGS = ("scan", "sqrt", "jitter", "shrink")
+
+
+def escalation_enabled() -> bool:
+    """``YFM_ESCALATE=1`` arms the ladder (default off — today's behavior)."""
+    return os.environ.get("YFM_ESCALATE", "0") not in ("0", "")
+
+
+class RungResult(NamedTuple):
+    rung: str     # which rung ran
+    ll: float     # the loglik it produced (−inf = still dead)
+    code: int     # taxonomy bitmask of that evaluation
+
+
+class LadderTrace(NamedTuple):
+    """One failed start's trip up the ladder — the multi-start report row."""
+
+    start: int                        # index in the multi-start batch
+    code: int                         # initial scan-engine diagnosis
+    rungs: Tuple[RungResult, ...]     # every rung evaluated, in order
+    recovered: bool
+    rung: Optional[str]               # the rung that recovered it (or None)
+    ll: float                         # recovered loglik (−inf if dead)
+    engine: str                       # engine whose value ``ll`` is
+    raw: Optional[np.ndarray]         # modified raw params (jitter/shrink
+    #                                   rungs change the point; None = as-is)
+
+    def as_dict(self) -> dict:
+        """JSON-able report row with decoded code names."""
+        return {
+            "start": self.start,
+            "code": self.code,
+            "cause": tax.describe(self.code),
+            "rungs": [{"rung": r.rung, "ll": r.ll, "code": r.code,
+                       "cause": tax.describe(r.code)} for r in self.rungs],
+            "recovered": self.recovered,
+            "rung": self.rung,
+            "ll": self.ll,
+            "engine": self.engine,
+        }
+
+
+@register_engine_cache
+@lru_cache(maxsize=64)
+def _jitted_sqrt_rescue(spec, T: int):
+    """The sqrt rung's jitted evaluator — standard trace-time builder idiom
+    (`@register_engine_cache` + `@lru_cache`, CLAUDE.md)."""
+    import jax
+
+    from ..ops import sqrt_kf
+
+    return jax.jit(lambda p, d, s, e: sqrt_kf.get_loss_coded(
+        spec, p, d, s, e, init_psd_floor=SQRT_RESCUE_FLOOR))
+
+
+def _sqrt_rescue(spec, cons, data, start, end):
+    import jax.numpy as jnp
+
+    runner = _jitted_sqrt_rescue(spec, int(data.shape[1]))
+    ll, code = runner(cons, data, jnp.asarray(start), jnp.asarray(end))
+    return float(ll), int(code)
+
+
+def _jittered_raw(spec, raw):
+    """The jitter rung's regularized point: constrained-space Ω-Cholesky
+    diagonal inflation + observation-variance floor, mapped back to raw."""
+    import jax.numpy as jnp
+
+    from ..models.params import transform_params, untransform_params
+
+    cons = np.asarray(transform_params(
+        spec, jnp.asarray(raw, dtype=jnp.float64)), dtype=np.float64).copy()
+    a, _ = spec.layout["chol"]
+    rows, cols = spec.chol_indices
+    for k, (r, c) in enumerate(zip(rows, cols)):
+        if r == c:
+            cons[a + k] = cons[a + k] * JITTER_SCALE + JITTER_ABS
+    ov = spec.layout["obs_var"][0]
+    cons[ov] = max(cons[ov], OBS_VAR_FLOOR)
+    return np.asarray(untransform_params(spec, jnp.asarray(cons)),
+                      dtype=np.float64)
+
+
+def escalate(spec, data, raw, start=0, end=None,
+             start_index: int = 0) -> LadderTrace:
+    """Climb the ladder for ONE dead start (unconstrained ``raw`` vector).
+
+    Returns a :class:`LadderTrace`; on recovery ``ll`` is the first finite
+    loglik found, ``engine`` names the engine that produced it (so a caller
+    comparing starts knows a ``"sqrt"`` value came from the projected
+    square-root surrogate), and ``raw`` carries the modified parameter point
+    when a rung changed it (jitter/shrink) — ``None`` when the original
+    point recovered as-is.
+    """
+    import jax.numpy as jnp
+
+    from ..models.params import transform_params
+
+    data = jnp.asarray(data, dtype=spec.dtype)
+    T = int(data.shape[1])
+    if end is None:
+        end = T
+    raw = np.asarray(raw, dtype=np.float64).reshape(-1)
+
+    def cons_of(r):
+        return jnp.asarray(np.asarray(
+            transform_params(spec, jnp.asarray(r, dtype=jnp.float64)),
+            dtype=np.float64), dtype=spec.dtype)
+
+    rungs = []
+
+    # rung 1 — scan re-eval + diagnosis (catches fused-kernel artifacts)
+    ll, code0 = tax.diagnose(spec, cons_of(raw), data, start, end)
+    rungs.append(RungResult("scan", ll, code0))
+    if np.isfinite(ll):
+        return LadderTrace(start_index, code0, tuple(rungs), True, "scan",
+                           ll, "scan", None)
+
+    # rung 2 — square-root filter from PSD-projected moments (Kalman only)
+    if spec.is_kalman:
+        ll, code = _sqrt_rescue(spec, cons_of(raw), data, start, end)
+        rungs.append(RungResult("sqrt", ll, code))
+        if np.isfinite(ll):
+            return LadderTrace(start_index, code0, tuple(rungs), True,
+                               "sqrt", ll, "sqrt", None)
+
+    # rung 3 — jittered covariance regularization (Kalman only: the knobs
+    # are the Ω Cholesky diagonal and σ²)
+    if spec.is_kalman and "chol" in spec.layout:
+        raw_j = _jittered_raw(spec, raw)
+        ll, code = tax.diagnose(spec, cons_of(raw_j), data, start, end)
+        rungs.append(RungResult("jitter", ll, code))
+        if np.isfinite(ll):
+            return LadderTrace(start_index, code0, tuple(rungs), True,
+                               "jitter", ll, "scan", raw_j)
+
+    # rung 4 — reference-parity ×0.95 shrink (optimization.jl:173-184)
+    r = raw.copy()
+    for _ in range(SHRINK_TRIES):
+        r = r * 0.95
+        ll, code = tax.diagnose(spec, cons_of(r), data, start, end)
+        if np.isfinite(ll):
+            rungs.append(RungResult("shrink", ll, code))
+            return LadderTrace(start_index, code0, tuple(rungs), True,
+                               "shrink", ll, "scan", r)
+    rungs.append(RungResult("shrink", ll, code))
+    return LadderTrace(start_index, code0, tuple(rungs), False, None,
+                       float("-inf"), "scan", None)
+
+
+def escalate_starts(spec, data, X, failed, start=0, end=None):
+    """Ladder every failed row of an (S, P) raw multi-start batch.
+
+    ``failed``: boolean (S,) mask.  Returns ``(traces, lls, X_new)`` —
+    recovered rows get their ladder loglik in ``lls`` (np.nan elsewhere) and
+    their possibly-modified raw vector written back into ``X_new``.
+    """
+    X = np.asarray(X, dtype=np.float64)
+    traces, lls = [], np.full(X.shape[0], np.nan)
+    X_new = X.copy()
+    for j in np.flatnonzero(np.asarray(failed)):
+        tr = escalate(spec, data, X[j], start, end, start_index=int(j))
+        traces.append(tr)
+        if tr.recovered:
+            lls[j] = tr.ll
+            if tr.raw is not None:
+                X_new[j] = tr.raw
+    return traces, lls, X_new

@@ -1,0 +1,159 @@
+"""Jit-compatible failure taxonomy: int32 bitmask beside the sentinels.
+
+The sentinel convention (CLAUDE.md, docs/DESIGN.md §4) keeps failures silent
+inside jitted code — loss → −Inf, moments → NaN, PF draws → −Inf.  That tells
+a driver *that* a start died but never *why*, and the only recovery is "drop
+it and hope another start lands".  This module adds a self-describing channel
+with the same discipline: kernels accumulate an ``int32`` bitmask through the
+scan carries they already thread (``ok`` flags, −Inf gates), nothing raises,
+and only driver-layer code decodes the mask into names
+(:func:`decode`/:func:`describe`).
+
+The bits are OR-combinable (one evaluation can hit several causes) and shared
+by every layer — filter kernels (``ops/``, ``models/``), the online serving
+update (``serving/online.py``), the escalation ladder
+(``robustness/ladder.py``) and the task-boundary failures
+(``orchestration/retry.SentinelFailure``).
+
+Healthy-path cost is zero by construction: the code rides carries that
+already exist, is pure int arithmetic, and XLA dead-code-eliminates it from
+callers that only consume the loss (the same mechanism that prunes the unused
+moment stacks from ``univariate_kf.get_loss`` — pinned by ``BENCH_ROBUST=1``
+in bench.py).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax.numpy as jnp
+
+from ..config import register_engine_cache
+
+#: dtype every in-jit code rides as (bitwise-or friendly, cheap on TPU)
+CODE_DTYPE = jnp.int32
+
+OK = 0
+#: a scalar innovation variance f = zᵀPz + σ² came out ≤ 0 (indefinite P or
+#: invalid σ²) — the univariate/joint engines' non-PD failure
+NONPSD_INNOVATION = 1
+#: a Cholesky/QR factorization produced non-finite entries (Ω_state, P₀, or
+#: the joint form's innovation factor)
+CHOL_BREAKDOWN = 2
+#: a state/innovation/likelihood quantity went non-finite mid-recursion
+#: (overflowed transition, NaN-poisoned carry)
+STATE_EXPLODED = 4
+#: non-finite entries in the (constrained) parameter vector itself — an
+#: overflowed bijection (exp of a huge raw value) before the filter ever ran
+TRANSFORM_OVERFLOW = 8
+#: the estimation window contributed zero observations (all-NaN columns or a
+#: degenerate [start, end) span) — the loss is vacuous, not just invalid
+MISSING_ALL_OBS = 16
+#: a covariance watched by the serving health monitor lost positive
+#: semi-definiteness (min eigenvalue below tolerance)
+NONPSD_COV = 32
+#: a serving state carried non-finite entries (the NaN-poisoned-update class)
+NAN_STATE = 64
+
+#: bit → name, in bit order (the decode vocabulary; keep sorted by value)
+NAMES = (
+    (NONPSD_INNOVATION, "NONPSD_INNOVATION"),
+    (CHOL_BREAKDOWN, "CHOL_BREAKDOWN"),
+    (STATE_EXPLODED, "STATE_EXPLODED"),
+    (TRANSFORM_OVERFLOW, "TRANSFORM_OVERFLOW"),
+    (MISSING_ALL_OBS, "MISSING_ALL_OBS"),
+    (NONPSD_COV, "NONPSD_COV"),
+    (NAN_STATE, "NAN_STATE"),
+)
+
+
+# ---------------------------------------------------------------------------
+# in-jit helpers (pure jnp; safe inside scan bodies)
+# ---------------------------------------------------------------------------
+
+def bit(cond, flag: int):
+    """``cond ? flag : 0`` as an int32 — the one idiom kernels use to raise a
+    taxonomy bit inside jit (branchless, like every other mask here)."""
+    return jnp.where(cond, jnp.int32(flag), jnp.int32(0))
+
+
+def zero_code():
+    return jnp.zeros((), dtype=CODE_DTYPE)
+
+
+def combine(codes):
+    """Bitwise-OR reduce an array of per-step codes to one scalar int32 —
+    jit-safe (a static unroll of one ``any`` per known flag, so it lowers to
+    a handful of reductions regardless of array length)."""
+    codes = jnp.asarray(codes, dtype=CODE_DTYPE)
+    out = jnp.zeros((), dtype=CODE_DTYPE)
+    for flag, _ in NAMES:
+        out = out | bit(jnp.any((codes & flag) != 0), flag)
+    return out
+
+
+def params_code(params):
+    """TRANSFORM_OVERFLOW if the constrained parameter vector is non-finite —
+    evaluated once at kernel entry, before any filter arithmetic."""
+    return bit(~jnp.all(jnp.isfinite(params)), TRANSFORM_OVERFLOW)
+
+
+# ---------------------------------------------------------------------------
+# driver-layer decoding (host-side; never called inside jit)
+# ---------------------------------------------------------------------------
+
+def decode(code) -> tuple:
+    """Bitmask → tuple of names, e.g. ``decode(3) ==
+    ('NONPSD_INNOVATION', 'CHOL_BREAKDOWN')``.  ``decode(0) == ()``."""
+    c = int(code)
+    return tuple(name for flag, name in NAMES if c & flag)
+
+
+def describe(code) -> str:
+    """Human/log form: ``'NONPSD_INNOVATION|CHOL_BREAKDOWN'`` or ``'OK'``."""
+    names = decode(code)
+    return "|".join(names) if names else "OK"
+
+
+def coded_loss_fn(spec):
+    """The family's ``get_loss_coded`` (scan engine): Kalman → the univariate
+    sequential-update kernel, score-driven/static → their coded losses."""
+    from ..models import score_driven, static_model
+    from ..ops import univariate_kf
+
+    if spec.is_kalman:
+        return univariate_kf.get_loss_coded
+    if spec.is_msed:
+        return score_driven.get_loss_coded
+    return static_model.get_loss_coded
+
+
+@register_engine_cache
+@lru_cache(maxsize=64)
+def _jitted_diagnose(spec, T: int):
+    """Jitted coded scan-engine loss — the repo-standard trace-time builder
+    idiom (`@register_engine_cache` + `@lru_cache`, CLAUDE.md) so the cache
+    participates in engine-switch invalidation like every other
+    (spec, T)-keyed program.  (The coded kernels are pinned to the scan
+    engine by construction; registration keeps the cache discipline uniform
+    rather than being load-bearing.)"""
+    import jax
+
+    fn = coded_loss_fn(spec)
+    return jax.jit(lambda p, d, s, e: fn(spec, p, d, s, e))
+
+
+def diagnose(spec, params, data, start=0, end=None):
+    """One coded scan-engine evaluation at CONSTRAINED ``params`` — the
+    driver-layer entry point for "why did this start die?".  Returns
+    ``(loglik, code)`` as Python scalars."""
+    import jax.numpy as jnp_  # local: keep module import light
+
+    data = jnp_.asarray(data, dtype=spec.dtype)
+    params = jnp_.asarray(params, dtype=spec.dtype)
+    T = int(data.shape[1])
+    if end is None:
+        end = T
+    runner = _jitted_diagnose(spec, T)
+    ll, code = runner(params, data, jnp_.asarray(start), jnp_.asarray(end))
+    return float(ll), int(code)

@@ -48,6 +48,7 @@ from ..config import register_engine_cache
 from ..models.kalman import _tvl_measurement, measurement_setup
 from ..models.params import unpack_kalman
 from ..models.specs import ModelSpec
+from ..robustness import taxonomy as tax
 
 _LOG_2PI = math.log(2.0 * math.pi)
 
@@ -93,27 +94,30 @@ def _masked_sequential_update(Z, y_eff, mask, beta, P, obs_var):
     on fully-observed curves — the mask factor is an exact 1.0 multiply)."""
 
     def body(carry, inp):
-        b, Pm, ll, ok = carry
+        b, Pm, ll, ok, code = carry
         z, y_i, m = inp
         mf = m.astype(P.dtype)
         zP = z @ Pm                     # (Ms,)
         f = zP @ z + obs_var
-        ok = ok & (~m | ((f > 0) & jnp.isfinite(f)))
+        f_fin = jnp.isfinite(f)
+        ok = ok & (~m | ((f > 0) & f_fin))
+        code = code | tax.bit(m & f_fin & (f <= 0), tax.NONPSD_INNOVATION) \
+            | tax.bit(m & ~f_fin, tax.STATE_EXPLODED)
         fsafe = jnp.where(f > 0, f, 1.0)
         v = y_i - z @ b
         K = zP / fsafe
         b = b + K * (v * mf)
         Pm = Pm - mf * jnp.outer(K, zP)
         ll = ll - 0.5 * mf * (jnp.log(fsafe) + v * v / fsafe + _LOG_2PI)
-        return (b, Pm, ll, ok), None
+        return (b, Pm, ll, ok, code), None
 
     zero = jnp.zeros((), dtype=P.dtype)
-    (beta_u, P_u, ll, ok), _ = lax.scan(
-        body, (beta, P, zero, jnp.bool_(True)), (Z, y_eff, mask),
-        length=Z.shape[0])
+    (beta_u, P_u, ll, ok, code), _ = lax.scan(
+        body, (beta, P, zero, jnp.bool_(True), tax.zero_code()),
+        (Z, y_eff, mask), length=Z.shape[0])
     # same drift insurance as the offline kernel
     P_u = 0.5 * (P_u + P_u.T)
-    return beta_u, P_u, ll, ok
+    return beta_u, P_u, ll, ok, code
 
 
 def _masked_potter_update(Z, y_eff, mask, beta, S, obs_var):
@@ -121,12 +125,15 @@ def _masked_potter_update(Z, y_eff, mask, beta, S, obs_var):
     ``_potter_update`` + the per-observation mask)."""
 
     def body(carry, inp):
-        b, Sm, ll, ok = carry
+        b, Sm, ll, ok, code = carry
         z, y_i, m = inp
         mf = m.astype(S.dtype)
         phi = Sm.T @ z                    # (Ms,)
         f = phi @ phi + obs_var
-        ok = ok & (~m | ((f > 0) & jnp.isfinite(f)))
+        f_fin = jnp.isfinite(f)
+        ok = ok & (~m | ((f > 0) & f_fin))
+        code = code | tax.bit(m & f_fin & (f <= 0), tax.NONPSD_INNOVATION) \
+            | tax.bit(m & ~f_fin, tax.STATE_EXPLODED)
         fsafe = jnp.where(f > 0, f, 1.0)
         v = y_i - z @ b
         Sphi = Sm @ phi                   # = P z
@@ -134,13 +141,13 @@ def _masked_potter_update(Z, y_eff, mask, beta, S, obs_var):
         alpha = 1.0 / (fsafe + jnp.sqrt(jnp.maximum(obs_var, 0.0) * fsafe))
         Sm = Sm - (alpha * mf) * jnp.outer(Sphi, phi)
         ll = ll - 0.5 * mf * (jnp.log(fsafe) + v * v / fsafe + _LOG_2PI)
-        return (b, Sm, ll, ok), None
+        return (b, Sm, ll, ok, code), None
 
     zero = jnp.zeros((), dtype=S.dtype)
-    (beta_u, S_u, ll, ok), _ = lax.scan(
-        body, (beta, S, zero, jnp.bool_(True)), (Z, y_eff, mask),
-        length=Z.shape[0])
-    return beta_u, S_u, ll, ok
+    (beta_u, S_u, ll, ok, code), _ = lax.scan(
+        body, (beta, S, zero, jnp.bool_(True), tax.zero_code()),
+        (Z, y_eff, mask), length=Z.shape[0])
+    return beta_u, S_u, ll, ok, code
 
 
 # ---------------------------------------------------------------------------
@@ -164,8 +171,10 @@ def filter_step(spec: ModelSpec, kp, state: OnlineState, y, engine: str):
     Predict-then-update: the snapshot holds β_{t|t}, so the transition runs
     FIRST, then the element-masked measurement update with ``y`` (N,) — the
     exact continuation of the offline filter's update-then-propagate scan.
-    Returns ``(OnlineState, ll, ok)``; on failure (``ok`` false) the state is
-    poisoned to NaN (sentinel), never raised here.
+    Returns ``(OnlineState, ll, ok, code)``; on failure (``ok`` false) the
+    state is poisoned to NaN (sentinel), never raised here — ``code`` is the
+    taxonomy bitmask saying why (robustness/taxonomy.py), decoded only by
+    the driver (serving/service.py).
     """
     dtype = kp.Phi.dtype
     Ms = spec.state_dim
@@ -194,17 +203,19 @@ def filter_step(spec: ModelSpec, kp, state: OnlineState, y, engine: str):
         y_eff = ysafe - d_const
 
     if engine == "sqrt":
-        beta_u, cov_u, ll, ok = _masked_potter_update(
+        beta_u, cov_u, ll, ok, code = _masked_potter_update(
             Z, y_eff, mask, beta_pred, cov_pred, kp.obs_var)
     else:
-        beta_u, cov_u, ll, ok = _masked_sequential_update(
+        beta_u, cov_u, ll, ok, code = _masked_sequential_update(
             Z, y_eff, mask, beta_pred, cov_pred, kp.obs_var)
     ok = ok & fac_ok
+    code = code | tax.bit(~fac_ok, tax.CHOL_BREAKDOWN)
 
     nan = jnp.asarray(jnp.nan, dtype=dtype)
     beta_u = jnp.where(ok, beta_u, nan)   # bad update → NaN state (sentinel)
     cov_u = jnp.where(ok, cov_u, nan)
-    return OnlineState(beta_u, cov_u), ll, ok
+    code = code | tax.bit(~ok, tax.NAN_STATE)
+    return OnlineState(beta_u, cov_u), ll, ok, code
 
 
 # ---------------------------------------------------------------------------
@@ -220,14 +231,16 @@ def _check_engine(engine: str) -> None:
 @register_engine_cache
 @lru_cache(maxsize=64)
 def _jitted_update(spec: ModelSpec, engine: str):
-    """One-step update program: (params, β, cov, y) → (β′, cov′, ll, ok)."""
+    """One-step update program: (params, β, cov, y) →
+    (β′, cov′, ll, ok, code)."""
     _check_engine(engine)
 
     def one(params, beta, cov, y):
         note_trace("update")
         kp = unpack_kalman(spec, params)
-        st, ll, ok = filter_step(spec, kp, OnlineState(beta, cov), y, engine)
-        return st.beta, st.cov, ll, ok
+        st, ll, ok, code = filter_step(spec, kp, OnlineState(beta, cov), y,
+                                       engine)
+        return st.beta, st.cov, ll, ok, code
 
     return jax.jit(one)
 
@@ -261,14 +274,16 @@ def _jitted_update_k(spec: ModelSpec, engine: str, kb: int):
         def body(carry, inp):
             y, v = inp
             b0, c0 = carry
-            st, ll, ok = filter_step(spec, kp, OnlineState(b0, c0), y, engine)
+            st, ll, ok, code = filter_step(spec, kp, OnlineState(b0, c0), y,
+                                           engine)
             b = jnp.where(v, st.beta, b0)
             c = jnp.where(v, st.cov, c0)
-            return (b, c), (jnp.where(v, ll, 0.0), ok | ~v)
+            return (b, c), (jnp.where(v, ll, 0.0), ok | ~v,
+                            jnp.where(v, code, jnp.int32(0)))
 
-        (b, c), (lls, oks) = lax.scan(body, (beta, cov), (Y.T, valid),
-                                      length=kb)
-        return b, c, lls, oks
+        (b, c), (lls, oks, codes) = lax.scan(body, (beta, cov), (Y.T, valid),
+                                             length=kb)
+        return b, c, lls, oks, codes
 
     return jax.jit(many)
 
@@ -295,20 +310,25 @@ def _jitted_scenarios(spec: ModelSpec, horizon: int, n: int):
 # ---------------------------------------------------------------------------
 
 def update(spec: ModelSpec, params, state: OnlineState, y,
-           engine: str = "univariate"):
+           engine: str = "univariate", with_code: bool = False):
     """One recursive update.  Returns ``(OnlineState, ll, ok)`` — all traced
-    outputs; the caller decides whether NaN state is an error."""
+    outputs; the caller decides whether NaN state is an error.
+    ``with_code=True`` appends the taxonomy bitmask (same program — the code
+    always rides the kernel outputs)."""
     runner = _jitted_update(spec, engine)
-    b, c, ll, ok = runner(params, state.beta, state.cov, jnp.asarray(y))
+    b, c, ll, ok, code = runner(params, state.beta, state.cov, jnp.asarray(y))
+    if with_code:
+        return OnlineState(b, c), ll, ok, code
     return OnlineState(b, c), ll, ok
 
 
 def update_k(spec: ModelSpec, params, state: OnlineState, Y,
-             engine: str = "univariate"):
+             engine: str = "univariate", with_code: bool = False):
     """k-step catch-up over the columns of ``Y`` (N, k).  Returns
-    ``(OnlineState, lls (k,), oks (k,))``.  ``k`` is rounded up onto
-    ``K_BUCKETS`` (padded steps are exact no-ops), so varying gap lengths
-    share a handful of compiled programs."""
+    ``(OnlineState, lls (k,), oks (k,))`` (+ per-step codes with
+    ``with_code=True``).  ``k`` is rounded up onto ``K_BUCKETS`` (padded
+    steps are exact no-ops), so varying gap lengths share a handful of
+    compiled programs."""
     Y = jnp.asarray(Y)
     k = int(Y.shape[1])
     kb = _k_bucket(k)
@@ -317,7 +337,9 @@ def update_k(spec: ModelSpec, params, state: OnlineState, Y,
         Y = jnp.concatenate([Y, pad], axis=1)
     valid = jnp.arange(kb) < k
     runner = _jitted_update_k(spec, engine, kb)
-    b, c, lls, oks = runner(params, state.beta, state.cov, Y, valid)
+    b, c, lls, oks, codes = runner(params, state.beta, state.cov, Y, valid)
+    if with_code:
+        return OnlineState(b, c), lls[:k], oks[:k], codes[:k]
     return OnlineState(b, c), lls[:k], oks[:k]
 
 

@@ -10,26 +10,42 @@ Wraps one :class:`~.snapshot.ServingSnapshot` with the three serving verbs:
   distribution (models/simulate.py seeded at the filtered state).
 
 Driver-layer responsibilities (CLAUDE.md conventions): the jitted kernels
-only emit sentinels (NaN state / −Inf ll); THIS layer turns them into
-structured :class:`~.snapshot.ServingError`s, keeps the last good snapshot on
-a failed update (no silent NaN propagation into later requests), stamps
-versions, and records per-stage latency through
-``utils/profiling.StageTimer`` so p50/p99 land in the BENCH ledger
-(``latency_summary()`` → ``StageTimer.summary()``).
+only emit sentinels (NaN state / −Inf ll) plus a taxonomy bitmask
+(robustness/taxonomy.py); THIS layer decodes them into structured
+:class:`~.snapshot.ServingError`s, keeps the last good snapshot on a failed
+update (no silent NaN propagation into later requests), stamps versions, and
+records per-stage latency through ``utils/profiling.StageTimer`` so p50/p99
+land in the BENCH ledger (``latency_summary()`` → ``StageTimer.summary()``).
+
+Self-healing (docs/DESIGN.md §11): every accepted update passes a host-side
+health watch (finiteness + min-eigenvalue of the covariance,
+robustness/health.py), and every ``YFM_SERVE_REFRESH`` updates the covariance
+is scrubbed through a square-root refresh.  A state that fails the watch —
+drift, a poisoned update, or a ``YFM_CHAOS`` ``nan_curve``/``nonpsd_cov``
+numeric fault — is rebuilt from the last-good snapshot (falling back to the
+boot snapshot / a :class:`~.snapshot.SnapshotRegistry` entry) and the service
+keeps answering from that state with a ``stale`` flag; with
+``self_heal=True`` a degraded update returns NaN instead of raising, and
+``health()`` reports the whole story (status, cov condition,
+updates-since-refresh, rebuild count, last decoded failure).
 """
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
 
+from ..orchestration import chaos
+from ..robustness import health as rh
+from ..robustness import taxonomy as tax
 from ..utils.profiling import StageTimer
 from .batcher import (BucketLattice, ForecastRequest, MicroBatcher,
                       ScenarioRequest)
 from .online import OnlineState, _check_engine, _jitted_update, update_k
-from .snapshot import ServingError, ServingSnapshot
+from .snapshot import ServingError, ServingSnapshot, SnapshotRegistry
 
 
 class YieldCurveService:
@@ -45,19 +61,38 @@ class YieldCurveService:
     services; ``forecast``/``scenarios`` here flush whatever is pending and
     collect their own ticket — other submitters' results stay banked on the
     batcher until they collect them (``MicroBatcher.result``).
+
+    Robustness knobs: ``self_heal=True`` turns failed/poisoned updates into
+    graceful degradation (state rebuilt, ``stale`` flag, NaN return) instead
+    of a raised :class:`ServingError`; ``registry`` provides the rebuild
+    source of last resort (the frozen snapshot this service booted from is
+    always available); ``refresh_every`` overrides ``YFM_SERVE_REFRESH``
+    (0 = no periodic refresh).
     """
 
     def __init__(self, snapshot: ServingSnapshot,
                  lattice: Optional[BucketLattice] = None,
                  engine: str = "univariate",
                  timer: Optional[StageTimer] = None,
-                 batcher: Optional[MicroBatcher] = None):
+                 batcher: Optional[MicroBatcher] = None,
+                 registry: Optional[SnapshotRegistry] = None,
+                 self_heal: bool = False,
+                 refresh_every: Optional[int] = None):
         _check_engine(engine)
         self.engine = engine
         self.timer = timer if timer is not None else StageTimer()
         # `is not None`, not `or`: an EMPTY shared batcher is falsy (__len__)
         self.batcher = batcher if batcher is not None else MicroBatcher(lattice)
+        self.registry = registry
+        self.self_heal = bool(self_heal)
+        self.stale = False
+        self.rebuilds = 0
+        self._refresh_every = rh.serve_refresh_every(refresh_every)
+        self._updates_since_refresh = 0
+        self._last_code = 0
+        self._boot_snapshot = snapshot
         self._set_snapshot(snapshot)
+        self._last_good = (self.snapshot, self._state)
         self.last_update = None  # date of the last accepted update
 
     # ---- state plumbing ---------------------------------------------------
@@ -81,6 +116,87 @@ class YieldCurveService:
     def version(self) -> int:
         return self.snapshot.meta.version
 
+    # ---- self-healing machinery (docs/DESIGN.md §11) ----------------------
+
+    def _rebuild_source(self) -> ServingSnapshot:
+        """Last-resort rebuild snapshot: the registry's frozen entry for this
+        model/task if one is registered, else the snapshot the service booted
+        from."""
+        if self.registry is not None:
+            try:
+                return self.registry.get(self._boot_snapshot.meta.model_string,
+                                         self._boot_snapshot.meta.task_id)
+            except ServingError:
+                pass
+        return self._boot_snapshot
+
+    def _heal_state(self, force: bool = False) -> bool:
+        """Ensure the in-memory state is healthy; returns True if it had to
+        be rebuilt (last-good snapshot first, frozen rebuild source if even
+        that is poisoned).  A healthy-LOOKING state is left untouched — a
+        *rejected* update is not a rebuild — unless ``force``: a corruption
+        the watch cannot see (e.g. a finite-but-wrong sqrt factor, whose
+        S Sᵀ is PSD for ANY finite S) must still be restored when the caller
+        KNOWS the state is bad (a fired chaos seam)."""
+        h = rh.state_health(self._state.beta, self._state.cov, self.engine)
+        if h["code"] == tax.OK and not force:
+            return False
+        snap, st = self._last_good
+        if rh.state_health(st.beta, st.cov, self.engine)["code"] == tax.OK:
+            self.snapshot, self._state = snap, st
+        else:
+            self._set_snapshot(self._rebuild_source())
+            self._last_good = (self.snapshot, self._state)
+        self.rebuilds += 1
+        return True
+
+    def _degrade(self, stage: str, code: int, detail: str,
+                 force_restore: bool = False, **context):
+        """Common failure tail: heal the state, flag stale, then either
+        return (self-heal mode) or raise the structured error."""
+        with self.timer.stage("rebuild"):
+            self._heal_state(force=force_restore)
+        self.stale = True
+        self._last_code = int(code)
+        if self.self_heal:
+            return
+        raise ServingError(stage, detail, code=tax.describe(code), **context)
+
+    def _maybe_refresh(self, n: int = 1) -> None:
+        """Periodic square-root scrub of the covariance (YFM_SERVE_REFRESH);
+        ``n`` = accepted updates to credit (k for a catch-up batch)."""
+        self._updates_since_refresh += n
+        if not self._refresh_every \
+                or self._updates_since_refresh < self._refresh_every:
+            return
+        with self.timer.stage("refresh"):
+            cov = rh.refresh_state(self._state.beta, self._state.cov,
+                                   self.engine)
+            cov = jnp.asarray(cov, dtype=self.snapshot.spec.dtype)
+            self._state = OnlineState(self._state.beta, cov)
+            P = cov @ cov.T if self.engine == "sqrt" else cov
+            self.snapshot = dataclasses.replace(self.snapshot, P=P)
+        self._updates_since_refresh = 0
+
+    def health(self) -> dict:
+        """The serving health report: ``status`` (``"ok"``/``"stale"``), the
+        covariance watch numbers, refresh cadence position, rebuild count and
+        the last decoded failure — everything an operator needs to decide
+        between "leave it" and "re-freeze a snapshot"."""
+        h = rh.state_health(self._state.beta, self._state.cov, self.engine)
+        return {
+            "status": "stale" if self.stale else "ok",
+            "version": self.version,
+            "engine": self.engine,
+            "cov_min_eig": h["min_eig"],
+            "cov_cond": h["cond"],
+            "updates_since_refresh": self._updates_since_refresh,
+            "refresh_every": self._refresh_every,
+            "rebuilds": self.rebuilds,
+            "last_code": self._last_code,
+            "last_code_names": tax.decode(self._last_code),
+        }
+
     # ---- the serving verbs ------------------------------------------------
 
     def update(self, date, yields) -> float:
@@ -88,45 +204,98 @@ class YieldCurveService:
         treated as unquoted maturities (masked per element; an all-NaN curve
         is a pure transition step).  Returns the update's loglik contribution.
 
-        Raises :class:`ServingError` on a failed innovation chain; the
-        service keeps the last good snapshot (version unchanged)."""
+        A failed innovation chain (or a state that fails the post-update
+        health watch) keeps the last good snapshot: raises
+        :class:`ServingError` by default, or — with ``self_heal=True`` —
+        degrades (``stale`` flag, rebuild, NaN return) and recovers to
+        ``ok`` on the next healthy update."""
         y = jnp.asarray(yields, dtype=self.snapshot.spec.dtype).reshape(-1)
         if y.shape[0] != self.snapshot.spec.N:
             raise ServingError("update", f"curve has {y.shape[0]} maturities, "
                                f"spec has {self.snapshot.spec.N}", date=date)
         with self.timer.stage("update"):
             runner = _jitted_update(self.snapshot.spec, self.engine)
-            b, c, ll, ok = runner(self.snapshot.params, self._state.beta,
-                                  self._state.cov, y)
+            b, c, ll, ok, code = runner(self.snapshot.params,
+                                        self._state.beta, self._state.cov, y)
             ok = bool(ok)  # device sync: the driver decides, not the kernel
-        if not ok:
-            raise ServingError(
-                "update", "non-PD innovation variance — state poisoned to "
-                "NaN by the kernel; snapshot left at the last good version",
+            code = int(code)
+        if ok:
+            # tentative accept; the health watch below owns the final word
+            self._state = OnlineState(b, c)
+            P = c @ c.T if self.engine == "sqrt" else c
+            self.snapshot = self.snapshot.advanced(b, P)
+        # numeric chaos seams (orchestration/chaos.py, docs/DESIGN.md §11):
+        # simulate a poison that made it INTO the accepted state — the class
+        # of fault the health watch + rebuild path exist for.  ``injected``
+        # forces the restore: a corrupted sqrt FACTOR is invisible to the
+        # min-eig watch (S Sᵀ is PSD for any finite S), but a fired seam
+        # knows the state is bad.
+        injected = False
+        if chaos.should_inject("nan_curve"):
+            nanst = jnp.full_like(self._state.beta, jnp.nan)
+            self._state = OnlineState(nanst,
+                                      jnp.full_like(self._state.cov, jnp.nan))
+            ok, injected = False, True
+            code |= tax.NAN_STATE
+        if chaos.should_inject("nonpsd_cov"):
+            eye = jnp.eye(self._state.cov.shape[0],
+                          dtype=self._state.cov.dtype)
+            self._state = OnlineState(self._state.beta,
+                                      self._state.cov - 2.0 * eye)
+            ok, injected = False, True
+            code |= tax.NONPSD_COV
+        h = rh.state_health(self._state.beta, self._state.cov, self.engine)
+        code |= h["code"]
+        if not ok or h["code"] != tax.OK:
+            self._degrade(
+                "update",
+                code,
+                f"update failed ({tax.describe(code)}) — state kept at the "
+                f"last good version",
+                force_restore=injected,
                 date=date, version=self.version)
-        self._state = OnlineState(b, c)
-        P = c @ c.T if self.engine == "sqrt" else c
-        self.snapshot = self.snapshot.advanced(b, P)
+            return float("nan")
+        self._last_good = (self.snapshot, self._state)
+        self.stale = False
+        self._last_code = code
         self.last_update = date
+        self._maybe_refresh()
         return float(ll)
 
     def update_many(self, date, curves) -> np.ndarray:
         """k-step catch-up over the columns of ``curves`` (N, k) — one scan
-        program.  All-or-nothing: a failed step anywhere rolls back."""
+        program.  All-or-nothing: a failed step anywhere rolls back (and
+        degrades instead of raising under ``self_heal``)."""
         Y = jnp.asarray(curves, dtype=self.snapshot.spec.dtype)
         with self.timer.stage("update"):
-            st, lls, oks = update_k(self.snapshot.spec, self.snapshot.params,
-                                    self._state, Y, engine=self.engine)
+            st, lls, oks, codes = update_k(self.snapshot.spec,
+                                           self.snapshot.params,
+                                           self._state, Y, engine=self.engine,
+                                           with_code=True)
             oks = np.asarray(oks)
         if not oks.all():
-            raise ServingError(
-                "update", f"step {int(np.argmin(oks))} of {Y.shape[1]} failed "
-                "(non-PD innovation variance)", date=date,
-                version=self.version)
+            j = int(np.argmin(oks))
+            code = int(np.asarray(codes)[j])
+            self._degrade(
+                "update",
+                code,
+                f"step {j} of {Y.shape[1]} failed ({tax.describe(code)})",
+                date=date, version=self.version)
+            return np.full(int(Y.shape[1]), np.nan)
+        h = rh.state_health(st.beta, st.cov, self.engine)
+        if h["code"] != tax.OK:
+            self._degrade("update", h["code"],
+                          f"catch-up state failed the health watch "
+                          f"({tax.describe(h['code'])})",
+                          date=date, version=self.version)
+            return np.full(int(Y.shape[1]), np.nan)
         self._state = st
         P = st.cov @ st.cov.T if self.engine == "sqrt" else st.cov
         self.snapshot = self.snapshot.advanced(st.beta, P, n=int(Y.shape[1]))
+        self._last_good = (self.snapshot, self._state)
+        self.stale = False
         self.last_update = date
+        self._maybe_refresh(int(Y.shape[1]))  # k accepted steps count too
         return np.asarray(lls)
 
     def forecast(self, h: int, quantiles: Optional[Tuple[float, ...]] = None
@@ -141,7 +310,10 @@ class YieldCurveService:
                                                if quantiles else None))
             self.batcher.flush()
             out = self.batcher.result(ticket)
-        self._check_finite("forecast", out["means"])
+        out = self._finite_or_heal(
+            "forecast", out, "means",
+            lambda: self._run_again(ForecastRequest(int(h), tuple(quantiles)
+                                                    if quantiles else None)))
         return out
 
     def scenarios(self, n: int, h: int, seed: int = 0) -> dict:
@@ -152,13 +324,39 @@ class YieldCurveService:
                 self.snapshot, ScenarioRequest(int(n), int(h), int(seed)))
             self.batcher.flush()
             out = self.batcher.result(ticket)
-        self._check_finite("scenarios", out["paths"])
+        out = self._finite_or_heal(
+            "scenarios", out, "paths",
+            lambda: self._run_again(ScenarioRequest(int(n), int(h),
+                                                    int(seed))))
         return out
 
-    def _check_finite(self, stage: str, arr) -> None:
-        if not np.all(np.isfinite(arr)):
-            raise ServingError(stage, "non-finite output (NaN sentinel from "
-                               "the kernels)", version=self.version)
+    def _run_again(self, request) -> dict:
+        """Re-run one request from the (healed) current snapshot."""
+        ticket = self.batcher.submit(self.snapshot, request)
+        self.batcher.flush()
+        return self.batcher.result(ticket)
+
+    def _finite_or_heal(self, stage: str, out: dict, key: str, retry) -> dict:
+        """The request-path guard, fixed to never leave a poisoned in-memory
+        state behind: on a non-finite result the state is healed (rolled back
+        to the last good snapshot / rebuilt) BEFORE the error surfaces; under
+        ``self_heal`` a successful heal gets one retry from the restored
+        state so the caller still receives a (stale) answer."""
+        if np.all(np.isfinite(out[key])):
+            return out
+        healed = self._heal_state()
+        self.stale = self.stale or healed
+        self._last_code = tax.NAN_STATE
+        if self.self_heal and healed:
+            out = retry()
+            if np.all(np.isfinite(out[key])):
+                return out
+        raise ServingError(stage, "non-finite output (NaN sentinel from "
+                           "the kernels)"
+                           + (", state rebuilt from the last good snapshot"
+                              if healed else ""),
+                           version=self.version,
+                           code=tax.describe(tax.NAN_STATE))
 
     # ---- warmup / observability ------------------------------------------
 
