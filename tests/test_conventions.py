@@ -1,18 +1,28 @@
-"""Static-analysis guard for the sentinel convention (CLAUDE.md, DESIGN §4):
-no ``raise`` inside jit/scan/Pallas kernel bodies under ``ops/`` and
-``serving/online.py`` — failures there must be sentinels (−Inf loss, NaN
-moments) plus a taxonomy code (robustness/taxonomy.py), never exceptions.
+"""Static-analysis guards for repo-wide mechanical conventions.
 
-Mechanical rule (AST, not regex, so strings/comments can't fool it):
+1. Sentinel convention (CLAUDE.md, DESIGN §4): no ``raise`` inside
+   jit/scan/Pallas kernel bodies under ``ops/`` and ``serving/online.py`` —
+   failures there must be sentinels (−Inf loss, NaN moments) plus a taxonomy
+   code (robustness/taxonomy.py), never exceptions.
 
-- a ``raise`` inside a NESTED function (a closure — scan bodies, jitted
-  ``one``/``many`` builders, Pallas kernel bodies) is a violation: those run
-  traced, where ``raise`` either fires spuriously at trace time or silently
-  never fires at run time;
-- a ``raise`` at the top level of a module-level function is allowed only
-  for the trace-time validation classes (ValueError / TypeError /
-  NotImplementedError / AttributeError) — shape/config checks that fire
-  before tracing starts, the documented driver-layer exception.
+   Mechanical rule (AST, not regex, so strings/comments can't fool it):
+
+   - a ``raise`` inside a NESTED function (a closure — scan bodies, jitted
+     ``one``/``many`` builders, Pallas kernel bodies) is a violation: those
+     run traced, where ``raise`` either fires spuriously at trace time or
+     silently never fires at run time;
+   - a ``raise`` at the top level of a module-level function is allowed only
+     for the trace-time validation classes (ValueError / TypeError /
+     NotImplementedError / AttributeError) — shape/config checks that fire
+     before tracing starts, the documented driver-layer exception.
+
+2. Request-path backpressure convention (DESIGN §12): the serving
+   request-path modules (everything under ``serving/``) may hold work only
+   in BOUNDED buffers and may never block on a bare ``time.sleep`` — an
+   unbounded ``queue.Queue()`` or an uninterruptible sleep is exactly how
+   backpressure regresses silently.  Chaos injection
+   (orchestration/chaos.py, where injected latency legitimately sleeps) and
+   test code live outside the scanned set by construction.
 """
 
 import ast
@@ -91,3 +101,65 @@ def test_guard_is_not_vacuous():
     names = {os.path.basename(p) for p in _kernel_files()}
     assert {"univariate_kf.py", "sqrt_kf.py", "particle.py", "smoother.py",
             "online.py"} <= names
+
+
+# ---------------------------------------------------------------------------
+# request-path guard: bounded queues, no bare sleeps (DESIGN §12)
+# ---------------------------------------------------------------------------
+
+def _request_path_files():
+    servdir = os.path.join(PKG, "serving")
+    for name in sorted(os.listdir(servdir)):
+        if name.endswith(".py"):
+            yield os.path.join(servdir, name)
+
+
+def _call_name(node):
+    """Dotted name of a Call's callee: 'time.sleep', 'queue.Queue', 'Queue'."""
+    fn = node.func
+    parts = []
+    while isinstance(fn, ast.Attribute):
+        parts.append(fn.attr)
+        fn = fn.value
+    if isinstance(fn, ast.Name):
+        parts.append(fn.id)
+    return ".".join(reversed(parts))
+
+
+def test_request_path_bounded_queues_and_no_bare_sleep():
+    """No unbounded ``queue.Queue()`` and no bare ``time.sleep`` anywhere in
+    the serving request path: depth bounds must be explicit (the gateway's
+    deque + admission control) and waits must be interruptible
+    (``Event.wait``/``Condition.wait``).  Chaos/test code is whitelisted by
+    living outside ``serving/``."""
+    violations = []
+    for path in _request_path_files():
+        with open(path) as fh:
+            tree = ast.parse(fh.read(), filename=path)
+        rel = os.path.relpath(path, ROOT)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node)
+            if name in ("time.sleep", "sleep"):
+                violations.append(
+                    f"{rel}:{node.lineno} bare {name}() on the request path "
+                    f"— use an interruptible Event/Condition wait")
+            if name in ("queue.Queue", "Queue", "queue.LifoQueue",
+                        "queue.PriorityQueue", "queue.SimpleQueue"):
+                # stdlib Queue() with no maxsize is unbounded by default;
+                # (the gateway's raw deque is fine: its bound is the
+                # admission check, pinned by tests/test_gateway.py)
+                bounded = bool(node.args) or any(
+                    kw.arg == "maxsize" for kw in node.keywords)
+                if not bounded:
+                    violations.append(
+                        f"{rel}:{node.lineno} unbounded {name}() on the "
+                        f"request path — give it a maxsize (backpressure)")
+    assert not violations, "request-path convention violations:\n" + \
+        "\n".join(violations)
+
+
+def test_request_path_guard_is_not_vacuous():
+    names = {os.path.basename(p) for p in _request_path_files()}
+    assert {"gateway.py", "batcher.py", "service.py", "online.py"} <= names

@@ -1,0 +1,303 @@
+"""Request-path resilience: the serving gateway under deterministic chaos.
+
+Acceptance coverage for docs/DESIGN.md §12 (ISSUE 6):
+
+- (a) with the ``queue_stall`` seam armed the gateway SHEDS offered load
+  above capacity — bounded queue depth/memory, structured retry-after
+  admission errors — instead of growing without bound, and the admitted
+  requests still complete once the stall clears (bounded p99 for admitted);
+- (b) a deadline-expired forecast is answered from the service's LAST-GOOD
+  snapshot, stale-flagged, bit-identical to ``ServingSnapshot``'s state;
+- (c) the gateway's shed/deadline/degraded counters reconcile exactly with
+  the closed-loop load generator's request ledger (robustness/loadgen.py).
+
+All chaos is armed with deterministic triggers (``@N`` counts or p=1.0) and
+the age/deadline machinery runs on an injected fake clock — no wall-clock
+sleeps decide any assertion.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import yieldfactormodels_jl_tpu as yfm
+from tests import oracle
+from yieldfactormodels_jl_tpu import serving
+from yieldfactormodels_jl_tpu.orchestration import chaos
+from yieldfactormodels_jl_tpu.robustness import loadgen
+
+MATS = tuple(np.array([3, 12, 24, 60, 120, 240, 360]) / 12.0)
+T_PANEL = 40
+T_ORIGIN = 34
+
+
+@pytest.fixture(scope="module")
+def dns_setup():
+    rng = np.random.default_rng(7)
+    spec, _ = yfm.create_model("1C", MATS, float_type="float64")
+    p = oracle.stable_1c_params(spec, np.float64)
+    data = oracle.simulate_dns_panel(rng, np.asarray(MATS), T=T_PANEL)
+    return spec, p, data
+
+
+@pytest.fixture(autouse=True)
+def _chaos_clean():
+    """Every test starts and ends disarmed (the module shares hit counters)."""
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+LATTICE = dict(horizons=(4, 8), batch_sizes=(1, 4), scenario_counts=(4, 8))
+
+
+def _service(dns_setup, **kw):
+    spec, p, data = dns_setup
+    return serving.YieldCurveService(
+        serving.freeze_snapshot(spec, p, data, end=T_ORIGIN),
+        lattice=serving.BucketLattice(**LATTICE), **kw)
+
+
+# ---------------------------------------------------------------------------
+# basic flow + counters + isolation
+# ---------------------------------------------------------------------------
+
+def test_gateway_answers_match_direct_service_calls(dns_setup):
+    spec, p, data = dns_setup
+    svc = _service(dns_setup)
+    gw = serving.ServingGateway(svc, queue_max=16, queue_age_ms=0.0)
+    t_u = gw.submit_update(T_ORIGIN, data[:, T_ORIGIN])
+    t_f = gw.submit_forecast(4, quantiles=(0.1, 0.9))
+    t_s = gw.submit_scenarios(4, 4, seed=3)
+    assert len(gw) == 3
+    assert gw.pump() == 3 and len(gw) == 0
+    r_u, r_f, r_s = gw.poll(t_u), gw.poll(t_f), gw.poll(t_s)
+    assert np.isfinite(r_u["ll"]) and not r_u["stale"]
+    assert r_f["means"].shape == (4, spec.N) and 0.1 in r_f["quantiles"]
+    assert r_s["paths"].shape == (spec.N, 4, 4)
+
+    # the same requests straight through the service agree exactly (the
+    # gateway adds policy, never arithmetic)
+    svc2 = _service(dns_setup)
+    ll2 = svc2.update(T_ORIGIN, data[:, T_ORIGIN])
+    np.testing.assert_allclose(r_u["ll"], ll2, rtol=1e-12)
+    np.testing.assert_array_equal(r_f["means"], svc2.forecast(4)["means"])
+    np.testing.assert_array_equal(
+        r_s["paths"], svc2.scenarios(n=4, h=4, seed=3)["paths"])
+
+    # one report: counters ride health() and latency_summary()
+    c = svc.counters.to_dict()
+    assert c["admitted"] == 3 and c["completed"] == 3
+    assert c["shed"] == c["degraded"] == c["errors"] == 0
+    assert svc.health()["requests"] == c
+    assert svc.latency_summary()["counters"] == c
+
+
+def test_poisoned_request_fails_alone(dns_setup):
+    """Worker isolation: a request that raises inside dispatch errors ITS
+    ticket only — the rest of the drained batch answers normally."""
+    spec, p, data = dns_setup
+    svc = _service(dns_setup)
+    gw = serving.ServingGateway(svc, queue_max=16, queue_age_ms=0.0)
+    t_bad = gw.submit_update(0, data[:3, T_ORIGIN])     # wrong length curve
+    t_f = gw.submit_forecast(4)
+    t_u = gw.submit_update(1, data[:, T_ORIGIN])
+    gw.pump()
+    with pytest.raises(serving.ServingError) as ei:
+        gw.poll(t_bad)
+    assert ei.value.stage == "update"
+    assert np.isfinite(gw.poll(t_u)["ll"])
+    assert np.all(np.isfinite(gw.poll(t_f)["means"]))
+    assert svc.counters.errors == 1 and svc.counters.completed == 2
+
+
+def test_unknown_ticket_is_structured_error(dns_setup):
+    gw = serving.ServingGateway(_service(dns_setup))
+    with pytest.raises(serving.ServingError) as ei:
+        gw.result(999)
+    assert ei.value.stage == "gateway"
+
+
+# ---------------------------------------------------------------------------
+# (a) queue_stall: shed, bounded, and admitted requests still finish
+# ---------------------------------------------------------------------------
+
+def test_queue_stall_sheds_instead_of_growing_unbounded(dns_setup):
+    svc = _service(dns_setup)
+    gw = serving.ServingGateway(svc, queue_max=8, queue_age_ms=0.0,
+                                queue_stall_s=0.0)
+    chaos.configure("queue_stall:1.0")      # every pump cycle stalls
+    sheds = []
+    for i in range(50):
+        try:
+            gw.submit_forecast(4)
+        except serving.ServingError as e:
+            sheds.append(e)
+        if i % 10 == 0:
+            assert gw.pump() == 0           # stalled: nothing drains
+    # bounded: depth pinned at queue_max, everything else shed loudly
+    assert len(gw) == 8 and len(sheds) == 42
+    assert svc.counters.admitted == 8 and svc.counters.shed == 42
+    for e in sheds:
+        assert e.stage == "admission"
+        assert e.context["retry_after_ms"] > 0  # backoff hint, not a timeout
+    # stall clears -> the admitted requests all complete (no loss, no decay)
+    chaos.configure(None)
+    assert gw.pump() == 8
+    assert svc.counters.completed == 8 and svc.counters.errors == 0
+
+
+def test_stalled_queue_age_sheds_new_arrivals(dns_setup):
+    """Head-of-queue age is the second admission limit: a stalled worker
+    makes the gateway refuse new work long before the depth bound."""
+    clk = {"t": 0.0}
+    gw = serving.ServingGateway(_service(dns_setup), queue_max=100,
+                                queue_age_ms=50.0, clock=lambda: clk["t"])
+    gw.submit_forecast(4)
+    clk["t"] += 0.2                          # head is now 200 ms old
+    with pytest.raises(serving.ServingError) as ei:
+        gw.submit_forecast(4)
+    assert ei.value.stage == "admission"
+    assert "stalled" in ei.value.detail
+    assert gw.counters.shed == 1 and len(gw) == 1
+
+
+# ---------------------------------------------------------------------------
+# (b) deadline -> degraded answer from the last-good snapshot, bit-identical
+# ---------------------------------------------------------------------------
+
+def test_deadline_expired_answer_is_last_good_snapshot(dns_setup):
+    spec, p, data = dns_setup
+    svc = _service(dns_setup)
+    clk = {"t": 0.0}
+    gw = serving.ServingGateway(svc, queue_max=16, queue_age_ms=0.0,
+                                clock=lambda: clk["t"])
+    # advance the state so last_good is NOT the boot snapshot — the degraded
+    # answer must be the last GOOD state, not wherever the service started
+    gw.submit_update(T_ORIGIN, data[:, T_ORIGIN])
+    gw.submit_update(T_ORIGIN + 1, data[:, T_ORIGIN + 1])
+    gw.pump()
+    assert svc.version == 2
+
+    t_dead = gw.submit_forecast(4, deadline_ms=10.0)
+    t_live = gw.submit_forecast(4)           # no deadline: same batch, fresh
+    clk["t"] += 0.5                          # 500 ms late
+    gw.pump()
+    out = gw.poll(t_dead)
+    assert out["degraded"] and out["stale"] and "deadline" in out["reason"]
+    snap = svc.last_good_snapshot
+    assert out["version"] == snap.meta.version == 2
+    np.testing.assert_array_equal(out["beta"], np.asarray(snap.beta))
+    np.testing.assert_array_equal(out["P"], np.asarray(snap.P))
+    # ... while the deadline-free request in the same batch got the real answer
+    live = gw.poll(t_live)
+    assert "degraded" not in live and live["means"].shape == (4, spec.N)
+    c = svc.counters
+    assert c.deadline == 1 and c.degraded == 1 and c.completed == 3
+
+
+def test_flush_cost_spike_recovers_instead_of_permanent_degrade(dns_setup):
+    """A one-off flush outlier (compile, GC pause) inflates the cost
+    estimate; with every request carrying a deadline below it, nothing would
+    ever flush to refresh the estimate — the gateway must DECAY it and find
+    its way back to fresh answers, not degrade forever."""
+    spec, p, data = dns_setup
+    svc = _service(dns_setup)
+    clk = {"t": 0.0}
+    gw = serving.ServingGateway(svc, queue_max=16, queue_age_ms=0.0,
+                                clock=lambda: clk["t"])
+    gw._flush_cost = 10.0     # the outlier: 10 s "measured" flush
+    outs = []
+    for _ in range(12):
+        t = gw.submit_forecast(4, deadline_ms=100.0)  # live, but under est
+        gw.pump()
+        outs.append(gw.poll(t))
+        if "degraded" not in outs[-1]:
+            break
+    assert outs[0]["degraded"]                  # spike: degrade, don't stall
+    assert "degraded" not in outs[-1]           # decayed: fresh answers again
+    assert outs[-1]["means"].shape == (4, spec.N)
+    assert gw._flush_cost < 0.1
+    assert svc.counters.deadline == len(outs) - 1
+
+
+def test_env_knob_defaults(dns_setup, monkeypatch):
+    monkeypatch.setenv("YFM_SERVE_QUEUE_MAX", "7")
+    monkeypatch.setenv("YFM_SERVE_QUEUE_AGE_MS", "123")
+    monkeypatch.setenv("YFM_SERVE_DEADLINE_MS", "456")
+    gw = serving.ServingGateway(_service(dns_setup))
+    assert gw.queue_max == 7
+    assert gw.queue_age_ms == 123.0 and gw.deadline_ms == 456.0
+    # constructor args win over the environment
+    gw2 = serving.ServingGateway(_service(dns_setup), queue_max=3,
+                                 queue_age_ms=0.0, deadline_ms=0.0)
+    assert gw2.queue_max == 3
+    assert gw2.queue_age_ms == 0.0 and gw2.deadline_ms == 0.0
+
+
+# ---------------------------------------------------------------------------
+# (c) closed-loop load: ledger == counters, zero unhandled exceptions
+# ---------------------------------------------------------------------------
+
+def test_load_ledger_reconciles_with_service_counters(dns_setup):
+    svc = _service(dns_setup)
+    # queue_max below the burst size forces deterministic shedding every
+    # burst; poison_ticket:@2 degrades exactly one batched ticket; the
+    # stall seam drops pump cycles without sleeping (queue_stall_s=0)
+    gw = serving.ServingGateway(svc, queue_max=2, queue_age_ms=0.0,
+                                queue_stall_s=0.0, slow_update_s=0.0)
+    chaos.configure("poison_ticket:@2,queue_stall:@3,slow_update:@2")
+    rep = loadgen.run_load(gw, dns_setup[2], duration_s=0.3,
+                           offered_qps=400.0, mix=(0.3, 0.5, 0.2),
+                           horizon=4, n_scenarios=4, burst=4, seed=0)
+    chaos.configure(None)
+    c = svc.counters
+    # every offered request is accounted exactly once, and the load
+    # generator's ledger IS the operator's counter report
+    assert rep.offered == rep.ok + rep.degraded + rep.shed + rep.errors \
+        + rep.abandoned
+    assert rep.abandoned == 0
+    assert rep.shed == c.shed > 0            # bursts over the depth bound
+    assert rep.degraded == c.degraded == 1   # the poisoned ticket, exactly
+    assert rep.ok == c.completed > 0
+    assert rep.errors == c.errors == 0
+    assert rep.offered == c.admitted + c.shed
+    assert rep.p999_ms >= rep.p99_ms >= rep.p50_ms > 0.0
+
+
+def test_slow_update_seam_injects_latency(dns_setup):
+    spec, p, data = dns_setup
+    svc = _service(dns_setup)
+    gw = serving.ServingGateway(svc, queue_max=4, queue_age_ms=0.0,
+                                slow_update_s=0.05)
+    gw.submit_update(0, data[:, T_ORIGIN])
+    gw.pump()                                # warm the update program
+    chaos.configure("slow_update:1.0")
+    gw.submit_update(1, data[:, T_ORIGIN + 1])
+    t0 = time.perf_counter()
+    gw.pump()
+    assert time.perf_counter() - t0 >= 0.05  # the injected tail
+    assert svc.counters.completed == 2
+
+
+# ---------------------------------------------------------------------------
+# background worker mode
+# ---------------------------------------------------------------------------
+
+def test_background_worker_serves_and_stops(dns_setup):
+    spec, p, data = dns_setup
+    svc = _service(dns_setup)
+    gw = serving.ServingGateway(svc, queue_max=16, queue_age_ms=0.0).start()
+    try:
+        tickets = [gw.submit_update(i, data[:, T_ORIGIN + i]) for i in range(3)]
+        tickets.append(gw.submit_forecast(4))
+        outs = [gw.result(t, timeout=60.0) for t in tickets]
+        assert all(np.isfinite(o["ll"]) for o in outs[:3])
+        assert outs[3]["means"].shape == (4, spec.N)
+    finally:
+        gw.stop()
+    assert not any(th.name == "yfm-serving-gateway" and th.is_alive()
+                   for th in threading.enumerate())
+    assert svc.counters.completed == 4

@@ -340,6 +340,72 @@ def test_failed_bucket_error_carries_request_stage(dns_setup):
     assert ei.value.stage == "forecast"
 
 
+def test_flush_nonfinite_ticket_degrades_alone(dns_setup):
+    """Partial-failure isolation (DESIGN §12): a NaN-state snapshot riding a
+    healthy bucket chunk yields a per-ticket DEGRADED result; the other
+    tickets in the same padded program return normally."""
+    spec, p, data = dns_setup
+    lattice = serving.BucketLattice(horizons=(4,), batch_sizes=(1, 4),
+                                    scenario_counts=(4,))
+    m = serving.MicroBatcher(lattice)
+    good = serving.freeze_snapshot(spec, p, data, end=T_ORIGIN)
+    bad = dataclasses.replace(good, beta=jnp.full_like(good.beta, jnp.nan))
+    t1 = m.submit(good, serving.ForecastRequest(4))
+    t2 = m.submit(bad, serving.ForecastRequest(4))
+    t3 = m.submit(good, serving.ForecastRequest(4))
+    ts = m.submit(bad, serving.ScenarioRequest(4, 4))
+    m.flush()
+    r1, r2, r3, rs = m.result(t1), m.result(t2), m.result(t3), m.result(ts)
+    for r in (r1, r3):  # same chunk as the poisoned ticket, unharmed
+        assert "degraded" not in r and np.all(np.isfinite(r["means"]))
+    assert r2["degraded"] and not np.all(np.isfinite(r2["means"]))
+    assert rs["degraded"] and rs["stage"] == "scenarios"
+    np.testing.assert_array_equal(r1["means"], r3["means"])
+
+
+def test_flush_chunk_exception_isolated_per_ticket(dns_setup):
+    """A request whose padded program RAISES (malformed params) is re-run
+    alone: only its ticket errors, chunk-mates still answer."""
+    spec, p, data = dns_setup
+    lattice = serving.BucketLattice(horizons=(4,), batch_sizes=(1, 4),
+                                    scenario_counts=(4,))
+    m = serving.MicroBatcher(lattice)
+    good = serving.freeze_snapshot(spec, p, data, end=T_ORIGIN)
+    bad = dataclasses.replace(good, params=good.params[:3])  # unpack blows up
+    t1 = m.submit(good, serving.ForecastRequest(4))
+    t2 = m.submit(bad, serving.ForecastRequest(4))
+    t3 = m.submit(good, serving.ForecastRequest(4))
+    m.flush()
+    assert np.all(np.isfinite(m.result(t1)["means"]))
+    with pytest.raises(serving.ServingError) as ei:
+        m.result(t2)
+    assert ei.value.stage == "forecast"
+    assert np.all(np.isfinite(m.result(t3)["means"]))
+
+
+def test_flush_chaos_seam_degrades_one_ticket(dns_setup):
+    """The ``poison_ticket`` chaos seam marks exactly the N-th flushed ticket
+    degraded — the drill for the isolation path without crafting NaNs."""
+    from yieldfactormodels_jl_tpu.orchestration import chaos
+
+    spec, p, data = dns_setup
+    lattice = serving.BucketLattice(horizons=(4,), batch_sizes=(1, 4),
+                                    scenario_counts=(4,))
+    m = serving.MicroBatcher(lattice)
+    snap = serving.freeze_snapshot(spec, p, data, end=T_ORIGIN)
+    tickets = [m.submit(snap, serving.ForecastRequest(4)) for _ in range(3)]
+    chaos.configure("poison_ticket:@2")
+    try:
+        m.flush()
+    finally:
+        chaos.reset()
+    outs = [m.result(t) for t in tickets]
+    assert [bool(o.get("degraded")) for o in outs] == [False, True, False]
+    # the degraded ticket still carries its (finite) result — policy is the
+    # driver's call (service heals, gateway answers from last-good)
+    assert np.all(np.isfinite(outs[1]["means"]))
+
+
 def test_scenarios_match_predictive_moments(dns_setup):
     """Scenario draws are distributed per the predictive density, pinned to
     an independent NumPy (δ, Φ, Ω) moment recursion — never to another JAX

@@ -27,6 +27,15 @@ decision and the call site applies the fault): ``nan_curve`` and
 exercise the health-watch → rebuild → stale-flag path end-to-end
 (docs/DESIGN.md §11).
 
+REQUEST-PATH seams (docs/DESIGN.md §12) drill the serving gateway's
+degradation machinery instead of the numerics: ``slow_update`` injects
+latency in front of the gateway's update dispatch (:func:`maybe_delay` —
+the tail the sustained-load harness must survive), ``queue_stall`` makes
+one gateway pump cycle process nothing (the queue ages, admission control
+sheds), and ``poison_ticket`` marks one micro-batcher ticket degraded so
+the partial-failure isolation path is exercised without crafting NaN
+snapshots (serving/batcher.py).
+
 Tests and benchmarks arm programmatically via :func:`configure` /
 :func:`reset` (reset also re-reads the environment on the next hit).
 """
@@ -36,6 +45,7 @@ from __future__ import annotations
 import os
 import random
 import threading
+import time
 from typing import Dict, Optional, Tuple
 
 
@@ -136,3 +146,17 @@ def should_inject(seam: str) -> bool:
     seam must corrupt *data*, never raise — the whole point is exercising
     the silent-poison recovery paths, not the exception paths."""
     return _fires(seam)
+
+
+def maybe_delay(seam: str, seconds: float) -> bool:
+    """Latency-injection trigger for request-path seams (``slow_update``,
+    ``queue_stall``): same arming/counters/specs as :func:`maybe_fail`, but a
+    fired seam SLEEPS for ``seconds`` instead of raising — the fault a real
+    service meets as a slow downstream call or a descheduled worker.  Returns
+    whether it fired so the call site can also apply a non-temporal effect
+    (e.g. the gateway skipping its pump cycle).  ``seconds <= 0`` keeps the
+    trigger decision but skips the sleep (deterministic tests)."""
+    fired = _fires(seam)
+    if fired and seconds > 0:
+        time.sleep(seconds)
+    return fired

@@ -11,7 +11,10 @@ Three layers over the repo-wide sentinel convention (CLAUDE.md / DESIGN §4):
   regularization → the reference's ×0.95 shrink) instead of dropping them;
 - ``health``: online-serving state health — per-update min-eigenvalue watch,
   periodic square-root refresh (``YFM_SERVE_REFRESH``), and the PSD scrub the
-  self-healing ``YieldCurveService`` rebuild path uses.
+  self-healing ``YieldCurveService`` rebuild path uses;
+- ``loadgen``: the closed-loop sustained-load harness for the serving
+  gateway (mixed traffic at controlled QPS, p50/p99/p999 + shed/degraded
+  ledger, ``BENCH_LOAD=1`` in bench.py; docs/DESIGN.md §12).
 
 Submodules and names are resolved lazily: the filter kernels import
 ``taxonomy`` at module load, so this package must not import them back at
@@ -20,13 +23,16 @@ import time (the ``ops/__init__`` idiom).
 
 from importlib import import_module
 
-_SUBMODULES = ("taxonomy", "ladder", "health")
+_SUBMODULES = ("taxonomy", "ladder", "health", "loadgen")
 
 _EXPORTS = {
     "decode": "taxonomy",
     "describe": "taxonomy",
     "LadderTrace": "ladder",
     "escalation_enabled": "ladder",
+    "LoadReport": "loadgen",
+    "run_load": "loadgen",
+    "measure_capacity": "loadgen",
 }
 
 

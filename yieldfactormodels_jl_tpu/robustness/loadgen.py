@@ -1,0 +1,203 @@
+"""Closed-loop sustained-load harness for the serving gateway (DESIGN §12).
+
+Drives a sustained MIX of traffic — O(1) updates, predictive-density
+forecasts, scenario fans — through a :class:`~..serving.gateway.ServingGateway`
+at a controlled offered QPS and measures the request path end to end:
+per-request latency from submit to collected answer (p50/p99/p999), achieved
+vs offered throughput, shed rate, degraded-answer rate, and (via
+:func:`measure_capacity`) the max sustained QPS the closed loop completes.
+
+Closed loop, single thread: the caller's thread IS the worker loop
+(submit a burst → ``pump()`` → collect), so chaos seams
+(``queue_stall``/``slow_update``, orchestration/chaos.py) fire reproducibly
+and every request's outcome is accounted — an unhandled exception anywhere
+in the request path fails the harness, which is the acceptance bar: under
+chaos every failure must surface as a shed, degraded, or structured-error
+response, never a crash.
+
+The request LEDGER (offered = ok + degraded + shed + errors + abandoned) is
+reconciled against the gateway's :class:`~..serving.service.RequestCounters`
+by tests/test_gateway.py — the load generator and the operator's ``health()``
+report must be two views of the same numbers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..serving.snapshot import ServingError
+from ..utils.profiling import _nearest_rank
+
+
+@dataclasses.dataclass
+class LoadReport:
+    """One sustained-load run, ledger + latency percentiles (ms)."""
+
+    offered: int
+    ok: int
+    degraded: int
+    shed: int
+    errors: int
+    abandoned: int          # still outstanding after the drain rounds
+    wall_s: float
+    offered_qps: float      # the controlled target rate
+    achieved_qps: float     # answered (ok + degraded) per wall second
+    p50_ms: float
+    p99_ms: float
+    p999_ms: float
+    max_sustained_qps: float = float("nan")  # from measure_capacity()
+
+    @property
+    def shed_rate(self) -> float:
+        return self.shed / self.offered if self.offered else 0.0
+
+    @property
+    def degraded_rate(self) -> float:
+        return self.degraded / self.offered if self.offered else 0.0
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["shed_rate"] = round(self.shed_rate, 6)
+        d["degraded_rate"] = round(self.degraded_rate, 6)
+        return d
+
+
+def _percentiles_ms(latencies) -> Tuple[float, float, float]:
+    if not latencies:
+        return 0.0, 0.0, 0.0
+    s = sorted(latencies)
+    return tuple(1e3 * _nearest_rank(s, q) for q in (0.50, 0.99, 0.999))
+
+
+class _MixedTraffic:
+    """Seeded request generator: kind by cumulative mix, curves by column."""
+
+    def __init__(self, gateway, curves, mix, horizon, n_scenarios,
+                 quantiles, seed):
+        self.gateway = gateway
+        self.curves = np.asarray(curves)
+        self.cum = np.cumsum(np.asarray(mix, dtype=np.float64))
+        if self.cum.shape != (3,) or abs(self.cum[-1] - 1.0) > 1e-9:
+            raise ValueError(f"mix must be 3 weights summing to 1, got {mix}")
+        self.horizon = int(horizon)
+        self.n_scenarios = int(n_scenarios)
+        self.quantiles = quantiles
+        self.rng = np.random.default_rng(seed)
+        self.i = 0
+
+    def submit_one(self) -> int:
+        """Submit the next mixed request; returns its ticket (a shed raises
+        the gateway's structured admission error through to the caller)."""
+        i, u = self.i, self.rng.random()
+        self.i += 1
+        gw, T = self.gateway, self.curves.shape[1]
+        if u < self.cum[0]:
+            return gw.submit_update(i, self.curves[:, i % T])
+        if u < self.cum[1]:
+            return gw.submit_forecast(self.horizon, self.quantiles)
+        return gw.submit_scenarios(self.n_scenarios, self.horizon, seed=i)
+
+
+def run_load(gateway, curves, *, duration_s: float = 2.0,
+             offered_qps: float = 100.0,
+             mix: Tuple[float, float, float] = (0.6, 0.3, 0.1),
+             horizon: int = 8, n_scenarios: int = 8,
+             quantiles: Optional[Tuple[float, ...]] = None,
+             burst: int = 4, seed: int = 0,
+             drain_rounds: int = 200) -> LoadReport:
+    """Drive ``duration_s`` of mixed traffic at ``offered_qps`` through the
+    gateway, closed-loop (each burst is submitted, pumped, then collected —
+    outstanding tickets are re-polled after later pumps, so a stalled cycle
+    shows up as tail latency, not lost requests).  After the run the queue is
+    drained for up to ``drain_rounds`` extra pumps; anything still
+    outstanding is reported ``abandoned`` (only a permanently-stalled worker
+    leaves any)."""
+    traffic = _MixedTraffic(gateway, curves, mix, horizon, n_scenarios,
+                            quantiles, seed)
+    latencies, outstanding = [], []
+    ok = degraded = shed = errors = 0
+    t_start = time.perf_counter()
+
+    def collect():
+        nonlocal ok, degraded, errors
+        still = []
+        for ticket, t0 in outstanding:
+            try:
+                out = gateway.poll(ticket)
+            except ServingError:
+                errors += 1
+                latencies.append(time.perf_counter() - t0)
+                continue
+            if out is None:
+                still.append((ticket, t0))
+                continue
+            latencies.append(time.perf_counter() - t0)
+            if out.get("degraded"):
+                degraded += 1
+            else:
+                ok += 1
+        outstanding[:] = still
+
+    while time.perf_counter() - t_start < duration_s:
+        # pace the next burst at the offered rate; a loop that has fallen
+        # behind schedule submits immediately (saturation, not sleep debt)
+        t_sched = t_start + traffic.i / offered_qps
+        wait = t_sched - time.perf_counter()
+        if wait > 0:
+            time.sleep(wait)
+        for _ in range(burst):
+            t0 = time.perf_counter()
+            try:
+                outstanding.append((traffic.submit_one(), t0))
+            except ServingError:
+                shed += 1
+        gateway.pump()
+        collect()
+    for _ in range(drain_rounds):
+        if not outstanding and len(gateway) == 0:
+            break
+        gateway.pump()
+        collect()
+    wall = time.perf_counter() - t_start
+    p50, p99, p999 = _percentiles_ms(latencies)
+    return LoadReport(
+        offered=traffic.i, ok=ok, degraded=degraded, shed=shed,
+        errors=errors, abandoned=len(outstanding), wall_s=round(wall, 4),
+        offered_qps=float(offered_qps),
+        achieved_qps=round((ok + degraded) / wall, 2) if wall else 0.0,
+        p50_ms=round(p50, 3), p99_ms=round(p99, 3), p999_ms=round(p999, 3))
+
+
+def measure_capacity(gateway, curves, *, n: int = 128,
+                     mix: Tuple[float, float, float] = (0.6, 0.3, 0.1),
+                     horizon: int = 8, n_scenarios: int = 8,
+                     burst: int = 8, seed: int = 1) -> float:
+    """Max sustained QPS: the UNPACED closed-loop completion rate — bursts
+    submitted back-to-back with the service always busy, queue depth bounded
+    by the burst, nothing shed.  This is the saturation throughput the paced
+    ``run_load`` offered rate is set against (chaos should be DISARMED here;
+    arm it for the measured run, not the yardstick)."""
+    traffic = _MixedTraffic(gateway, curves, mix, horizon, n_scenarios,
+                            None, seed)
+    answered = 0
+    t0 = time.perf_counter()
+    while traffic.i < n:
+        tickets = []
+        for _ in range(min(burst, n - traffic.i)):
+            try:
+                tickets.append(traffic.submit_one())
+            except ServingError:
+                pass  # unexpected at saturation depth ≤ burst, but bounded
+        gateway.pump()
+        for t in tickets:
+            try:
+                if gateway.poll(t) is not None:
+                    answered += 1
+            except ServingError:
+                pass
+    wall = time.perf_counter() - t0
+    return answered / wall if wall > 0 else float("inf")

@@ -1,17 +1,21 @@
-"""Online serving layer (docs/DESIGN.md §9; QUICKSTART "Serving").
+"""Online serving layer (docs/DESIGN.md §9, §12; QUICKSTART "Serving").
 
 Turns the batch reproduction into the serving stack the ROADMAP asks for:
 snapshot registry over the merged SQLite DBs (``snapshot``), O(1) jitted
 recursive filter updates (``online``), shape-bucketed micro-batching onto a
-small lattice of precompiled programs (``batcher``), and the
-``YieldCurveService`` driver with per-stage latency accounting (``service``).
+small lattice of precompiled programs (``batcher``), the
+``YieldCurveService`` driver with per-stage latency accounting (``service``),
+and the resilient request pipeline in front of it all — bounded queue,
+admission control/load shedding, per-request deadlines with degraded
+last-good answers (``gateway``).
 """
 
 from .batcher import (BucketLattice, DEFAULT_LATTICE, ForecastRequest,
                       MicroBatcher, ScenarioRequest)
+from .gateway import ServingGateway
 from .online import (ONLINE_ENGINES, OnlineState, reset_trace_counts,
                      scenario_paths, trace_counts, update, update_k)
-from .service import YieldCurveService
+from .service import RequestCounters, YieldCurveService
 from .snapshot import (ServingError, ServingSnapshot, SnapshotMeta,
                        SnapshotRegistry, freeze_snapshot, load_snapshot)
 
@@ -20,7 +24,9 @@ __all__ = [
     "DEFAULT_LATTICE",
     "ForecastRequest",
     "MicroBatcher",
+    "RequestCounters",
     "ScenarioRequest",
+    "ServingGateway",
     "ONLINE_ENGINES",
     "OnlineState",
     "reset_trace_counts",

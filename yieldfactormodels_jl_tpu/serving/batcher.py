@@ -36,6 +36,7 @@ import numpy as np
 from ..config import register_engine_cache
 from ..models.params import unpack_kalman
 from ..models.specs import ModelSpec
+from ..orchestration import chaos
 from .online import note_trace, scenario_paths
 from .snapshot import ServingError, ServingSnapshot
 
@@ -215,10 +216,14 @@ class MicroBatcher:
         {ticket: result} for the requests flushed by THIS call (all of them
         are also banked for ``result()``).
 
-        Exception-safe per bucket program: a request that makes its program
-        raise (e.g. a hand-built snapshot with malformed params) banks an
-        ``{"error": exc}`` entry for its chunk's tickets instead of
-        propagating and stranding every OTHER submitter's pending work."""
+        Failure isolation is PER TICKET, not per chunk (docs/DESIGN.md §12):
+        a request that makes its padded program raise (e.g. a hand-built
+        snapshot with malformed params) is re-run alone so only ITS ticket
+        banks an ``{"error": exc}`` entry — the other tickets in the same
+        bucket chunk still return normally; and a ticket whose per-element
+        result is non-finite (or whose ``poison_ticket`` chaos seam fired)
+        banks a per-ticket DEGRADED result (``"degraded": True``) instead of
+        failing anything."""
         pending, self._pending = self._pending, []
         results: Dict[int, dict] = {}
 
@@ -235,9 +240,16 @@ class MicroBatcher:
                 chunk = items[lo:lo + bmax]
                 try:
                     results.update(self._run_forecast_chunk(spec, hb, chunk))
-                except Exception as e:  # noqa: BLE001 — quarantine the chunk
-                    results.update({t: {"error": e, "stage": "forecast"}
-                                    for t, _, _ in chunk})
+                except Exception:  # noqa: BLE001 — isolate, then quarantine
+                    # one poisoned request must fail ALONE: re-run each ticket
+                    # as its own batch-1 program so only the offender errors
+                    for item in chunk:
+                        try:
+                            results.update(
+                                self._run_forecast_chunk(spec, hb, [item]))
+                        except Exception as e1:  # noqa: BLE001
+                            results[item[0]] = {"error": e1,
+                                                "stage": "forecast"}
 
         # ---- scenarios: bucket on (horizon, n), draws axis is the batch ---
         for ticket, snap, req in pending:
@@ -249,10 +261,12 @@ class MicroBatcher:
                 paths = scenario_paths(snap.spec, snap.params, snap.beta,
                                        snap.P, hb, nb,
                                        jax.random.PRNGKey(req.seed))
-                results[ticket] = {
+                res = {
                     "paths": np.asarray(paths)[:, :req.horizon, :req.n],
                     "version": snap.meta.version,
                 }
+                results[ticket] = self._maybe_degrade(res, "paths",
+                                                      "scenarios")
             except Exception as e:  # noqa: BLE001
                 results[ticket] = {"error": e, "stage": "scenarios"}
         self._done.update(results)  # bank for result() — shared-batcher safe
@@ -288,8 +302,22 @@ class MicroBatcher:
             if req.quantiles:
                 res["quantiles"] = _normal_quantiles(
                     res["means"], res["covs"], req.quantiles)
-            out[ticket] = res
+            out[ticket] = self._maybe_degrade(res, "means", "forecast")
         return out
+
+    @staticmethod
+    def _maybe_degrade(res: dict, key: str, stage: str) -> dict:
+        """Per-ticket degradation mark: a non-finite per-element result (a
+        NaN-sentinel snapshot riding an otherwise healthy chunk) or a fired
+        ``poison_ticket`` chaos seam flags THIS ticket ``degraded`` — it is
+        still returned (``result()`` raises only on ``"error"``), so the
+        other tickets in the chunk are untouched and the driver decides the
+        degradation policy (serving/service.py heals, the gateway answers
+        from the last-good snapshot)."""
+        if chaos.should_inject("poison_ticket") \
+                or not np.all(np.isfinite(res[key])):
+            return {**res, "degraded": True, "stage": stage}
+        return res
 
     # ---- warmup -----------------------------------------------------------
 

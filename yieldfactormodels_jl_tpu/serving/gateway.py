@@ -1,0 +1,399 @@
+"""Resilient request pipeline in front of the serving layer (DESIGN §12).
+
+`YieldCurveService` answers one request at a time and blocks its caller for
+exactly as long as the kernels take; under offered load above capacity that
+is a recipe for unbounded queues and collapsing tail latency.  The gateway
+puts the production request path in front of it:
+
+- **Backpressure.**  Requests land in a BOUNDED deque (``queue_max``,
+  ``YFM_SERVE_QUEUE_MAX``) — memory per gateway is O(queue_max), full stop.
+- **Admission control / load shedding.**  A submit against a full queue, or
+  against a queue whose HEAD has waited longer than ``queue_age_ms``
+  (``YFM_SERVE_QUEUE_AGE_MS`` — a stalled worker means admitting more work
+  is pure harm), is shed with a structured ``ServingError(stage="admission")``
+  carrying ``retry_after_ms`` — the client's backoff hint, not a timeout.
+- **Per-request deadlines.**  Every request can carry a deadline
+  (``deadline_ms=`` per call, ``YFM_SERVE_DEADLINE_MS`` as the default); the
+  remaining budget propagates into batch formation: a request that cannot
+  make its deadline given the measured flush cost is answered IMMEDIATELY
+  from the service's last-good snapshot (β, P, version, ``stale``/
+  ``degraded`` flags) instead of blocking the batch — degraded beats late,
+  and the square-root refresh machinery (DESIGN §11) keeps that snapshot a
+  principled answer, not a hack.
+- **Worker isolation.**  The pump collects every ticket under its own
+  try/except and the micro-batcher isolates chunk failures per ticket, so
+  one poisoned request fails alone — never its whole bucket chunk, never
+  the worker loop.
+
+Request-path chaos seams (orchestration/chaos.py): ``slow_update`` injects
+latency before the update dispatch, ``queue_stall`` makes a pump cycle
+process nothing (the queue ages → admission sheds).  The closed-loop
+sustained-load harness (robustness/loadgen.py, ``BENCH_LOAD=1``) drives
+mixed traffic through exactly this machinery with chaos armed and reports
+p50/p99/p999, max sustained QPS, shed rate and degraded rate.
+
+Threading: ``submit_*``/``result`` are safe from any thread; the pump runs
+either inline (call :meth:`pump` yourself — deterministic, what the tests
+and the load harness do) or on the background worker started by
+:meth:`start` (event-paced — the request-path convention bans bare
+``time.sleep``, enforced by tests/test_conventions.py).  Outcome counters
+live on ``service.counters`` (:class:`~.service.RequestCounters`) so
+``service.health()`` / ``latency_summary()`` stay the one operator report.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..orchestration import chaos
+from .batcher import ForecastRequest, ScenarioRequest
+from .service import YieldCurveService
+from .snapshot import ServingError
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "")
+    return float(raw) if raw else default
+
+
+@dataclasses.dataclass(frozen=True)
+class _Pending:
+    """One admitted request waiting in the bounded queue."""
+
+    ticket: int
+    kind: str                   # "update" | "forecast" | "scenarios"
+    payload: object             # (date, yields) | ForecastRequest | ScenarioRequest
+    enqueued: float             # gateway-clock time at admission
+    deadline: Optional[float]   # absolute gateway-clock deadline (None = none)
+
+
+class ServingGateway:
+    """Bounded, deadline-aware, load-shedding front end for one service.
+
+    ``queue_max`` / ``queue_age_ms`` / ``deadline_ms`` default from the
+    ``YFM_SERVE_QUEUE_MAX`` / ``YFM_SERVE_QUEUE_AGE_MS`` /
+    ``YFM_SERVE_DEADLINE_MS`` env knobs (CLAUDE.md); constructor arguments
+    win.  ``deadline_ms=0`` means no default deadline; ``queue_age_ms=0``
+    disables the head-age shed (depth shedding is never disabled — the
+    queue bound IS the memory bound).
+
+    ``clock`` is injectable (monotonic seconds) so the age/deadline machinery
+    is testable without wall-clock sleeps; ``slow_update_s``/``queue_stall_s``
+    size the chaos seams' injected latency (0 = trigger without sleeping).
+    """
+
+    def __init__(self, service: YieldCurveService,
+                 queue_max: Optional[int] = None,
+                 queue_age_ms: Optional[float] = None,
+                 deadline_ms: Optional[float] = None,
+                 max_banked: int = 4096,
+                 clock=time.monotonic,
+                 slow_update_s: float = 0.05,
+                 queue_stall_s: float = 0.05):
+        self.service = service
+        self.queue_max = int(queue_max if queue_max is not None
+                             else _env_float("YFM_SERVE_QUEUE_MAX", 256))
+        if self.queue_max < 1:
+            raise ValueError(f"queue_max must be >= 1, got {self.queue_max}")
+        self.queue_age_ms = float(
+            queue_age_ms if queue_age_ms is not None
+            else _env_float("YFM_SERVE_QUEUE_AGE_MS", 500.0))
+        self.deadline_ms = float(
+            deadline_ms if deadline_ms is not None
+            else _env_float("YFM_SERVE_DEADLINE_MS", 0.0))
+        self.max_banked = int(max_banked)
+        self.slow_update_s = float(slow_update_s)
+        self.queue_stall_s = float(queue_stall_s)
+        self._clock = clock
+        self._queue: Deque[_Pending] = deque()
+        self._inflight: set = set()
+        self._lock = threading.Lock()
+        self._pump_lock = threading.Lock()
+        self._cv = threading.Condition()
+        self._results: Dict[int, dict] = {}
+        self._next_ticket = 0
+        self._flush_cost = 0.0      # EWMA seconds of one pump's batched flush
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._worker: Optional[threading.Thread] = None
+
+    # ---- admission control ------------------------------------------------
+
+    def __len__(self) -> int:
+        """Current queue depth (admitted, not yet drained by a pump)."""
+        with self._lock:
+            return len(self._queue)
+
+    @property
+    def counters(self):
+        """The request-path outcome counters (live on the service so
+        ``health()``/``latency_summary()`` report them)."""
+        return self.service.counters
+
+    def _shed(self, kind: str, detail: str, depth: int):
+        self.counters.shed += 1
+        # backoff hint: roughly the time the worker needs to drain what is
+        # already queued (measured flush cost, floor 1 ms)
+        retry_ms = max(1.0, (depth + 1) * max(self._flush_cost, 1e-3) * 1e3)
+        raise ServingError(
+            "admission", f"load shed: {detail} — retry after "
+            f"~{retry_ms:.0f} ms", retry_after_ms=round(retry_ms, 3),
+            kind=kind, depth=depth)
+
+    def _admit(self, kind: str, payload,
+               deadline_ms: Optional[float]) -> int:
+        now = self._clock()
+        with self._lock:
+            depth = len(self._queue)
+            if depth >= self.queue_max:
+                self._shed(kind, f"queue full ({depth}/{self.queue_max})",
+                           depth)
+            if self.queue_age_ms and self._queue:
+                age_ms = (now - self._queue[0].enqueued) * 1e3
+                if age_ms > self.queue_age_ms:
+                    self._shed(
+                        kind, f"queue stalled (head age {age_ms:.0f} ms > "
+                        f"{self.queue_age_ms:.0f} ms)", depth)
+            dl = self.deadline_ms if deadline_ms is None else float(deadline_ms)
+            ticket = self._next_ticket
+            self._next_ticket += 1
+            self._queue.append(_Pending(ticket, kind, payload, now,
+                                        now + dl / 1e3 if dl else None))
+            self.counters.admitted += 1
+        self._wake.set()
+        return ticket
+
+    def submit_update(self, date, yields,
+                      deadline_ms: Optional[float] = None) -> int:
+        """Queue one observed-curve update; returns the result ticket."""
+        y = np.asarray(yields)
+        return self._admit("update", (date, y), deadline_ms)
+
+    def submit_forecast(self, h: int,
+                        quantiles: Optional[Tuple[float, ...]] = None,
+                        deadline_ms: Optional[float] = None) -> int:
+        """Queue an h-step predictive-density request."""
+        req = ForecastRequest(int(h), tuple(quantiles) if quantiles else None)
+        return self._admit("forecast", req, deadline_ms)
+
+    def submit_scenarios(self, n: int, h: int, seed: int = 0,
+                         deadline_ms: Optional[float] = None) -> int:
+        """Queue an n-path scenario-fan request."""
+        return self._admit("scenarios",
+                           ScenarioRequest(int(n), int(h), int(seed)),
+                           deadline_ms)
+
+    # ---- results ----------------------------------------------------------
+
+    def _finish(self, ticket: int, resp: dict) -> None:
+        with self._cv:
+            self._inflight.discard(ticket)
+            self._results[ticket] = resp
+            while len(self._results) > self.max_banked:
+                self._results.pop(min(self._results))  # oldest ticket first
+            self._cv.notify_all()
+
+    def poll(self, ticket: int) -> Optional[dict]:
+        """Non-blocking collect: the response dict if the ticket finished,
+        ``None`` if it is still queued/in flight.  An errored ticket raises
+        its structured failure (to THIS caller only)."""
+        with self._cv:
+            if ticket not in self._results:
+                return None
+            resp = self._results.pop(ticket)
+        if "error" in resp:
+            raise resp["error"]
+        return resp
+
+    def result(self, ticket: int, timeout: Optional[float] = None) -> dict:
+        """Blocking collect.  Without a background worker the wait cannot
+        make progress, so an un-pumped ticket raises immediately instead of
+        deadlocking; with one, waits up to ``timeout`` (None = forever)."""
+        t_end = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._cv:
+                if ticket in self._results:
+                    resp = self._results.pop(ticket)
+                    break
+                with self._lock:
+                    pending = ticket in self._inflight or any(
+                        r.ticket == ticket for r in self._queue)
+                if not pending:
+                    raise ServingError(
+                        "gateway", f"ticket {ticket} has no banked result — "
+                        "never admitted, or evicted uncollected")
+                if not (self._worker and self._worker.is_alive()):
+                    raise ServingError(
+                        "gateway", f"ticket {ticket} is still queued and no "
+                        "worker is running — call pump() or start()")
+                remaining = None if t_end is None \
+                    else t_end - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise ServingError(
+                        "gateway", f"ticket {ticket} not answered within "
+                        f"{timeout}s", ticket=ticket)
+                self._cv.wait(0.05 if remaining is None
+                              else min(0.05, remaining))
+        if "error" in resp:
+            raise resp["error"]
+        return resp
+
+    # ---- the worker loop --------------------------------------------------
+
+    def pump(self, max_requests: Optional[int] = None) -> int:
+        """One worker-loop cycle: drain up to ``max_requests`` admitted
+        requests, degrade the deadline-expired ones from the last-good
+        snapshot, dispatch updates in arrival order, then run every batched
+        read through ONE micro-batcher flush.  Returns requests answered.
+
+        Never raises for a request's failure — every outcome lands in that
+        ticket's banked response (worker isolation).  Concurrent pump callers
+        (a background worker plus an inline driver) serialize on a dedicated
+        lock: the micro-batcher underneath is deliberately lock-free, so two
+        interleaved flushes could strand each other's tickets."""
+        with self._pump_lock:
+            return self._pump_locked(max_requests)
+
+    def _pump_locked(self, max_requests: Optional[int] = None) -> int:
+        if chaos.maybe_delay("queue_stall", self.queue_stall_s):
+            return 0  # a stalled worker cycle: the queue ages, nothing drains
+        with self._lock:
+            k = len(self._queue) if max_requests is None \
+                else min(max_requests, len(self._queue))
+            batch = [self._queue.popleft() for _ in range(k)]
+            self._inflight.update(r.ticket for r in batch)
+        if not batch:
+            return 0
+        now = self._clock()
+        est = self._flush_cost
+        run_updates: List[_Pending] = []
+        run_batched: List[_Pending] = []
+        est_degraded = 0
+        for req in batch:
+            remaining = None if req.deadline is None else req.deadline - now
+            if remaining is not None and remaining <= est:
+                # can't make its deadline (already expired, or the measured
+                # flush cost says it will be) — degraded beats late, and
+                # beats stalling the whole batch
+                if remaining > 0:
+                    est_degraded += 1
+                self.counters.deadline += 1
+                self._finish(req.ticket, self._degraded_answer(
+                    req, "deadline expired before flush" if remaining <= 0
+                    else "deadline unmeetable at measured flush cost"))
+            elif req.kind == "update":
+                run_updates.append(req)
+            else:
+                run_batched.append(req)
+        for req in run_updates:
+            self._finish(req.ticket, self._dispatch_update(req))
+        if run_batched:
+            self._dispatch_batched(run_batched)
+        elif est_degraded:
+            # the ESTIMATE degraded live requests but no flush ran to refresh
+            # it: decay it, or one outlier flush (a compile, a GC pause)
+            # locks the gateway into permanent degradation — a closed loop
+            # must be able to find its way back to serving fresh answers
+            self._flush_cost = 0.5 * self._flush_cost
+        return len(batch)
+
+    def _degraded_answer(self, req: _Pending, reason: str) -> dict:
+        """The degraded answer: the service's last-good snapshot state —
+        version-stamped (β, P) the client can propagate itself, PSD by the
+        health watch's construction, stale-flagged per DESIGN §11."""
+        snap = self.service.last_good_snapshot
+        self.counters.degraded += 1
+        return {"kind": req.kind, "degraded": True, "stale": True,
+                "reason": reason, "version": snap.meta.version,
+                "beta": np.asarray(snap.beta), "P": np.asarray(snap.P)}
+
+    def _dispatch_update(self, req: _Pending) -> dict:
+        chaos.maybe_delay("slow_update", self.slow_update_s)
+        date, y = req.payload
+        svc = self.service
+        try:
+            ll = svc.update(date, y)
+        except ServingError as e:
+            self.counters.errors += 1
+            return {"error": e}
+        except Exception as e:  # noqa: BLE001 — isolation: fail alone
+            self.counters.errors += 1
+            return {"error": ServingError(
+                "update", f"unexpected failure: {e!r}", ticket=req.ticket)}
+        if np.isfinite(ll):
+            self.counters.completed += 1
+            return {"kind": "update", "ll": float(ll),
+                    "version": svc.version, "stale": svc.stale}
+        # self-heal degrade inside the service: state rebuilt, NaN returned
+        self.counters.degraded += 1
+        return {"kind": "update", "ll": float(ll), "degraded": True,
+                "stale": True, "version": svc.version}
+
+    def _dispatch_batched(self, reqs: List[_Pending]) -> None:
+        """Submit every still-live read to the micro-batcher, flush ONCE,
+        collect per ticket (isolation: a poisoned ticket fails alone — the
+        batcher already quarantines per ticket, DESIGN §12)."""
+        svc = self.service
+        t0 = self._clock()
+        tickets: Dict[int, int] = {}
+        for req in reqs:
+            try:
+                tickets[req.ticket] = svc.batcher.submit(svc.snapshot,
+                                                         req.payload)
+            except ServingError as e:   # lattice rejection: fails at submit
+                self.counters.errors += 1
+                self._finish(req.ticket, {"error": e})
+        with svc.timer.stage("flush"):
+            svc.batcher.flush()         # exception-safe per ticket
+        for req in reqs:
+            if req.ticket not in tickets:
+                continue
+            try:
+                out = svc.batcher.result(tickets[req.ticket])
+            except ServingError as e:
+                self.counters.errors += 1
+                self._finish(req.ticket, {"error": e})
+                continue
+            if out.get("degraded"):
+                # per-element poison (or chaos): relay the last-good answer
+                self._finish(req.ticket, self._degraded_answer(
+                    req, out.get("stage", req.kind) + " result degraded"))
+            else:
+                self.counters.completed += 1
+                self._finish(req.ticket, {"kind": req.kind, **out})
+        elapsed = self._clock() - t0
+        self._flush_cost = elapsed if self._flush_cost == 0.0 \
+            else 0.8 * self._flush_cost + 0.2 * elapsed
+
+    # ---- background worker -------------------------------------------------
+
+    def start(self, poll_s: float = 0.005) -> "ServingGateway":
+        """Run the pump on a daemon thread (event-paced, no bare sleeps)."""
+        if self._worker and self._worker.is_alive():
+            return self
+        self._stop.clear()
+
+        def _run():
+            while not self._stop.is_set():
+                if self.pump() == 0:
+                    self._wake.wait(poll_s)
+                    self._wake.clear()
+
+        self._worker = threading.Thread(target=_run, daemon=True,
+                                        name="yfm-serving-gateway")
+        self._worker.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._worker is not None:
+            self._worker.join(timeout)
+            self._worker = None

@@ -48,6 +48,28 @@ from .online import OnlineState, _check_engine, _jitted_update, update_k
 from .snapshot import ServingError, ServingSnapshot, SnapshotRegistry
 
 
+@dataclasses.dataclass
+class RequestCounters:
+    """Request-path outcome counters (docs/DESIGN.md §12).  Maintained by the
+    :class:`~.gateway.ServingGateway` in front of this service, reported here
+    (``health()`` / ``latency_summary()``) so the load harness and operators
+    read ONE report.  Invariant the reconciliation test pins
+    (tests/test_gateway.py): every offered request lands in exactly one of
+    ``shed`` (never admitted), ``completed`` (fresh answer), ``degraded``
+    (stale/last-good answer — ``deadline`` counts the deadline-expired
+    subset), or ``errors`` (structured per-request failure)."""
+
+    admitted: int = 0
+    completed: int = 0
+    shed: int = 0
+    deadline: int = 0
+    degraded: int = 0
+    errors: int = 0
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
 class YieldCurveService:
     """One curve family, served online.
 
@@ -87,6 +109,7 @@ class YieldCurveService:
         self.self_heal = bool(self_heal)
         self.stale = False
         self.rebuilds = 0
+        self.counters = RequestCounters()
         self._refresh_every = rh.serve_refresh_every(refresh_every)
         self._updates_since_refresh = 0
         self._last_code = 0
@@ -115,6 +138,12 @@ class YieldCurveService:
     @property
     def version(self) -> int:
         return self.snapshot.meta.version
+
+    @property
+    def last_good_snapshot(self) -> ServingSnapshot:
+        """The snapshot as of the last accepted-and-healthy update — the
+        state every degraded answer is served from (docs/DESIGN.md §12)."""
+        return self._last_good[0]
 
     # ---- self-healing machinery (docs/DESIGN.md §11) ----------------------
 
@@ -195,6 +224,7 @@ class YieldCurveService:
             "rebuilds": self.rebuilds,
             "last_code": self._last_code,
             "last_code_names": tax.decode(self._last_code),
+            "requests": self.counters.to_dict(),
         }
 
     # ---- the serving verbs ------------------------------------------------
@@ -378,5 +408,7 @@ class YieldCurveService:
         return n
 
     def latency_summary(self) -> dict:
-        """Per-stage latency percentiles (StageTimer.summary())."""
-        return self.timer.summary()
+        """Per-stage latency percentiles (StageTimer.summary()) plus the
+        request-path outcome counters — one report for the load harness and
+        operators, not three (``"counters"`` rides beside the stage dicts)."""
+        return {**self.timer.summary(), "counters": self.counters.to_dict()}
