@@ -45,8 +45,10 @@ MATURITIES = np.array([3, 6, 9, 12, 15, 18, 21, 24, 30, 36, 48, 60, 72, 84,
                        96, 108, 120, 180, 240, 360], dtype=np.float64) / 12.0
 
 
-def make_panel(seed=0):
-    """Synthetic Liu–Wu-shaped panel from a stationary 5-factor AFNS DGP."""
+def make_panel(seed=0, T=T_MONTHS):
+    """Synthetic Liu–Wu-shaped panel from a stationary 5-factor AFNS DGP.
+    ``T`` overrides the monthly default for the long-panel bench
+    (``BENCH_LONGT``: daily/intraday-scale histories, T up to 20k)."""
     rng = np.random.default_rng(seed)
     lam1, lam2 = 0.5, 0.15
     Z = np.ones((N_MATURITIES, 5))
@@ -57,8 +59,8 @@ def make_panel(seed=0):
     Phi = np.diag([0.98, 0.94, 0.9, 0.92, 0.88])
     delta = np.array([0.08, -0.06, 0.03, -0.02, 0.01])
     x = np.linalg.solve(np.eye(5) - Phi, delta)
-    data = np.zeros((N_MATURITIES, T_MONTHS))
-    for t in range(T_MONTHS):
+    data = np.zeros((N_MATURITIES, T))
+    for t in range(T):
         x = delta + Phi @ x + 0.05 * rng.standard_normal(5)
         data[:, t] = Z @ x + 0.02 * rng.standard_normal(N_MATURITIES)
     return data + 4.0
@@ -425,6 +427,39 @@ def main():
         except Exception as e:  # never kill the bench line
             load_ctx = f"; load bench failed ({type(e).__name__}: {e})"
 
+    # ---- long-panel engine split (opt-in: BENCH_LONGT=1) ----
+    # sequential univariate scan vs the O(log T) associative-scan engine at
+    # T in {360, 5k, 20k} (docs/DESIGN.md §13) — the engine-dispatch policy's
+    # evidence base: where the tree starts beating the scan.  On TPU it runs
+    # IN-PROCESS (ONE client at a time — a subprocess would race this
+    # process for the relay claim, CLAUDE.md TPU rules); on fallback rounds
+    # a CPU-pinned subprocess gets the 8-virtual-device mesh (XLA_FLAGS must
+    # precede jax init) so the time-sharded line is exercised like the
+    # MULTICHIP dry-runs.  The main JSON's device_fallback/fallback_reason
+    # stamp covers this section like every other.
+    longt_ctx = ""
+    if os.environ.get("BENCH_LONGT", "0") not in ("0", ""):
+        try:
+            if jax.devices()[0].platform == "tpu":
+                longt_ctx = "; " + _longt_line()
+            else:
+                lenv = {**os.environ, "JAX_PLATFORMS": "cpu"}
+                lenv.pop("PALLAS_AXON_POOL_IPS", None)
+                lenv.pop("JAX_COMPILATION_CACHE_DIR", None)
+                lenv["XLA_FLAGS"] = (lenv.get("XLA_FLAGS", "")
+                                     + " --xla_force_host_platform_device_"
+                                       "count=8").strip()
+                proc = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--longt-bench"],
+                    env=lenv, capture_output=True, text=True, timeout=900)
+                tail = (proc.stdout.strip().splitlines() or ["no output"])[-1]
+                longt_ctx = (f"; {tail}" if "longt-bench" in tail else
+                             f"; longt-bench subprocess failed rc="
+                             f"{proc.returncode} ({tail[:200]})")
+        except Exception as e:  # never kill the bench line
+            longt_ctx = f"; longt bench failed ({type(e).__name__}: {e})"
+
     # ---- robustness microbenchmark (opt-in: BENCH_ROBUST=1) ----
     # (a) healthy-path cost of the failure-taxonomy channel: the same jitted
     # batch evaluated through get_loss vs get_loss_coded — the codes ride
@@ -524,7 +559,7 @@ def main():
           f"| pallas {pallas_rate} evals/s; kernels agree: joint={agree} "
           f"pallas={pallas_agree}; finite: {n_finite}/{BATCH}; "
           f"cpu ll sample {ll_cpu:.2f}{grad_ctx}{ssd_ctx}{serving_ctx}"
-          f"{load_ctx}{orch_ctx}{robust_ctx}; "
+          f"{load_ctx}{orch_ctx}{longt_ctx}{robust_ctx}; "
           f"roofline: {flops_per_eval/1e6:.3f} MFLOP/eval -> "
           f"univariate {gflops(dev_evals_per_sec):.1f} | "
           f"joint {gflops(BATCH / t_joint):.1f} | "
@@ -569,6 +604,103 @@ def _grad_parity():
     print(f"grad-parity[interpret f64, B={gB} T={gT}]: "
           f"{'PASS' if ok else 'FAIL'} ({detail})")
     return 0 if ok else 1
+
+
+def _longt_line():
+    """Measure the BENCH_LONGT section and return its one context line:
+    sequential vs associative-scan loglik evals/s at T ∈ {360, 5k, 20k},
+    plus the time-sharded assoc variant (panel ``P(None, "time")`` over the
+    mesh — 8 virtual devices on the CPU fallback path, whatever the real
+    topology exposes on device).  Callable both in-process (TPU rounds) and
+    from the ``--longt-bench`` subprocess (CPU fallback rounds)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from yieldfactormodels_jl_tpu import create_model
+    from yieldfactormodels_jl_tpu.ops import assoc_scan, univariate_kf
+    from yieldfactormodels_jl_tpu.parallel.mesh import make_mesh
+
+    B = int(os.environ.get("BENCH_LONGT_BATCH", "8"))
+    Ts = tuple(int(t) for t in os.environ.get(
+        "BENCH_LONGT_TS", "360,5000,20000").split(","))
+    spec, _ = create_model("AFNS5", tuple(MATURITIES), float_type="float32")
+    batch = jnp.asarray(make_param_batch(spec, B), dtype=spec.dtype)
+    p1 = batch[0]
+    mesh = make_mesh(axis_name="time")
+    n_dev = int(mesh.devices.size)
+    repl = NamedSharding(mesh, P())
+    data_sh = NamedSharding(mesh, P(None, "time"))
+
+    def timed(fn, arg, reps=2):
+        out = jax.block_until_ready(fn(arg))  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(arg)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / reps, out
+
+    parts, ratio_at_max = [], float("nan")
+    for T in Ts:
+        try:
+            data = jnp.asarray(make_panel(seed=7, T=T), dtype=spec.dtype)
+            # batched VALUE throughput (the A/B-grid / NM-probe regime)
+            t_seq, out_seq = timed(jax.jit(jax.vmap(
+                lambda p: univariate_kf.get_loss(spec, p, data))), batch)
+            t_assoc, out_assoc = timed(jax.jit(jax.vmap(
+                lambda p: assoc_scan.get_loss(spec, p, data))), batch)
+            both = np.isfinite(np.asarray(out_seq)) \
+                & np.isfinite(np.asarray(out_assoc))
+            # loose: a 20k-term f32 sum carries real cancellation noise
+            agree = bool(both.any()) and np.allclose(
+                np.asarray(out_seq)[both], np.asarray(out_assoc)[both],
+                rtol=2e-2)
+            # single-chain VALUE+GRADIENT latency — the regime the engine
+            # exists for (ISSUE/DESIGN §13: long histories latency-bound on
+            # one sequential chain; reverse-mode through a T-step scan
+            # replays/stashes the whole trajectory, the tree reverses as
+            # vectorized passes)
+            t_svg, _ = timed(jax.jit(jax.value_and_grad(
+                lambda p: univariate_kf.get_loss(spec, p, data))), p1)
+            t_avg, _ = timed(jax.jit(jax.value_and_grad(
+                lambda p: assoc_scan.get_loss(spec, p, data))), p1)
+            if T % n_dev == 0 and os.environ.get(
+                    "BENCH_LONGT_SHARDED", "0") not in ("0", ""):
+                # opt-in time-sharded flavor: panel P(None, "time"), params
+                # replicated (the time_parallel.py layout).  Off by default:
+                # on the 1-core 8-virtual-device fallback mesh the blocked
+                # prefix's chunk reshape crosses shard boundaries, so the
+                # collective traffic prices in with no parallel silicon to
+                # pay for it — the MULTICHIP dry-runs own correctness there.
+                assoc_sh_fn = jax.jit(
+                    jax.vmap(lambda p, dat: assoc_scan.get_loss(spec, p, dat),
+                             in_axes=(0, None)),
+                    in_shardings=(repl, data_sh), out_shardings=repl)
+                sharded = jax.device_put(data, data_sh)
+                t_sh, _ = timed(lambda pb: assoc_sh_fn(pb, sharded), batch)
+                sh_txt = f" | assoc-sharded{n_dev} {B / t_sh:.2f}"
+            else:
+                sh_txt = ""
+            parts.append(
+                f"T={T} value[B={B}] seq {B / t_seq:.2f} | assoc "
+                f"{B / t_assoc:.2f}{sh_txt} evals/s (agree={agree}), "
+                f"grad[1-chain] seq {t_svg * 1e3:.0f} | assoc "
+                f"{t_avg * 1e3:.0f} ms")
+            if T == max(Ts):
+                ratio_at_max = t_svg / t_avg
+        except Exception as e:  # per-T isolation: one OOM ≠ no line
+            parts.append(f"T={T} failed ({type(e).__name__})")
+    plat = jax.devices()[0].platform
+    return (f"longt-bench[AFNS5, {plat} x{n_dev}]: " + "; ".join(parts)
+            + f"; assoc/seq 1-chain value+grad speedup @T={max(Ts)}: "
+              f"{ratio_at_max:.2f}x")
+
+
+def _longt_bench():
+    """Subprocess mode for the CPU-fallback path (the caller exports
+    JAX_PLATFORMS=cpu + the 8-virtual-device XLA flag before jax inits)."""
+    print(_longt_line())
+    return 0
 
 
 def _orch_bench():
@@ -778,6 +910,8 @@ if __name__ == "__main__":
         sys.exit(_grad_parity())
     elif "--orch-bench" in sys.argv:
         sys.exit(_orch_bench())
+    elif "--longt-bench" in sys.argv:
+        sys.exit(_longt_bench())
     elif "--inner" in sys.argv:
         main()
     else:

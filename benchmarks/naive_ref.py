@@ -276,6 +276,41 @@ def unit_afns5_pass():
     return (time.perf_counter() - t0) / reps, f"mean of {reps} full-panel passes"
 
 
+def unit_longt_pass(T=20000):
+    """Long-panel unit (the BENCH_LONGT dual-ratio wall): one naive per-step
+    NumPy AFNS5 filter pass over a T=20,000 daily/intraday-scale history —
+    what a user of the reference pays per likelihood evaluation on a long
+    panel (1-thread per-step loop, kalman/filter.jl:125-209 semantics via
+    tests/oracle.py).  Pairs with bench.py's ``BENCH_LONGT=1`` seq/assoc
+    line for the BASELINE.md "longt-20k" dual-ratio row."""
+    from yieldfactormodels_jl_tpu import create_model
+
+    spec, _ = create_model("AFNS5", tuple(common.MATURITIES),
+                           float_type="float32")
+    p = common.afns5_params(spec)
+    (tt,) = _afns5_tensors(spec, [p])
+    Z, d, Phi, delta, cholOm, beta0, S0, obs_var = tt
+    # long stationary AFNS panel from the same DGP family as the T=360
+    # configs (bench.py make_panel), generated inline at full length
+    rng = np.random.default_rng(7)
+    Ms = Phi.shape[0]
+    x = np.linalg.solve(np.eye(Ms) - Phi, delta)
+    Om = cholOm @ cholOm.T
+    data = np.zeros((Z.shape[0], T))
+    for t in range(T):
+        x = delta + Phi @ x + rng.multivariate_normal(np.zeros(Ms), Om)
+        data[:, t] = Z @ x + d + np.sqrt(obs_var) * rng.standard_normal(
+            Z.shape[0])
+    reps = 2
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        ll = oracle.kalman_filter_loglik(Z, Phi, delta, Om, float(obs_var),
+                                         data - d[:, None])
+    wall = (time.perf_counter() - t0) / reps
+    return wall, (f"mean of {reps} naive per-step passes at T={T}, "
+                  f"ll={ll:.1f}")
+
+
 def unit_ssd_nns_pass():
     """Measured seconds per naive score-driven-neural filter pass (config-6
     lower-bound unit): tests/oracle.msed_neural_filter — per-step loop with
@@ -306,6 +341,7 @@ RUNNERS = {
     "afns5-sv-pf": naive_afns5_sv_pf,
     "bootstrap-2000": naive_bootstrap,
     "unit-afns5-pass": unit_afns5_pass,
+    "unit-longt-pass": unit_longt_pass,
     "unit-ssd-pass": unit_ssd_nns_pass,
 }
 

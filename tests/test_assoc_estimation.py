@@ -1,0 +1,405 @@
+"""Assoc-scan estimation engine (docs/DESIGN.md §13) acceptance tests.
+
+Oracle-backed parity of EVERY Kalman engine (the canonical coverage the
+test_conventions.py engine-guard pins), long-T assoc + time-sharded parity at
+T=2048 with NaN gaps and window masks, differentiable-assoc grad parity
+against the scan engine, the ``YFM_LOGLIK_T_SWITCH`` dispatch policy, the
+multi-start cascade end-to-end on the assoc engine, the escalation ladder's
+assoc rescue rung, the structured per-step-contribution errors, and the
+serving ``refilter()`` drift regression against 5,000 accumulated O(1)
+updates.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import yieldfactormodels_jl_tpu as yfm
+from tests import oracle
+from yieldfactormodels_jl_tpu import config
+from yieldfactormodels_jl_tpu.models import api
+from yieldfactormodels_jl_tpu.models.params import untransform_params
+from yieldfactormodels_jl_tpu.ops import assoc_scan, univariate_kf
+from yieldfactormodels_jl_tpu.robustness import ladder, taxonomy as tax
+
+MATS = tuple(np.array([3, 12, 24, 60, 120, 240, 360]) / 12.0)
+
+#: literal twin of config.KALMAN_ENGINES — literal ON PURPOSE: the
+#: engine-coverage guard in test_conventions.py greps test ASTs for these
+#: names, and test_engine_list_is_in_sync below forces this list to track
+#: the registry, so a new engine cannot ship without oracle parity here
+ALL_ENGINES = ("univariate", "sqrt", "joint", "assoc")
+
+
+def _case(rng, T=120, dtype=np.float64):
+    spec, _ = yfm.create_model("1C", MATS, float_type="float64")
+    p = oracle.stable_1c_params(spec, dtype)
+    data = 0.4 * rng.standard_normal((len(MATS), T)) + 4.0
+    return spec, p, data
+
+
+def _oracle_pieces(spec, p):
+    Z = oracle.dns_loadings(float(p[spec.layout["gamma"][0]]),
+                            np.asarray(MATS))
+    Ms = spec.state_dim
+    C = np.zeros((Ms, Ms))
+    rows, cols = spec.chol_indices
+    a, _ = spec.layout["chol"]
+    for k, (r, c) in enumerate(zip(rows, cols)):
+        C[r, c] = p[a + k]
+    lo, hi = spec.layout["delta"]
+    delta = np.asarray(p[lo:hi], dtype=np.float64)
+    lo, hi = spec.layout["phi"]
+    Phi = np.asarray(p[lo:hi], dtype=np.float64).reshape(Ms, Ms)
+    return Z, Phi, delta, C @ C.T, float(p[spec.layout["obs_var"][0]])
+
+
+def test_engine_list_is_in_sync():
+    """The literal ALL_ENGINES list must track config.KALMAN_ENGINES — a new
+    engine breaks this first, forcing its oracle parity row below."""
+    assert ALL_ENGINES == tuple(yfm.KALMAN_ENGINES)
+
+
+@pytest.mark.parametrize("engine", ["univariate", "sqrt", "joint", "assoc"])
+def test_engine_oracle_parity_with_nan_gap(engine, rng):
+    """Every loglik engine vs the independent NumPy float64 loop
+    (tests/oracle.py), interior NaN gap included — oracle-backed, never
+    JAX-vs-JAX alone (CLAUDE.md)."""
+    spec, p, data = _case(rng)
+    data[:, 40:44] = np.nan
+    Z, Phi, delta, Om, ov = _oracle_pieces(spec, p)
+    want = oracle.kalman_filter_loglik(Z, Phi, delta, Om, ov, data)
+    got = float(api.get_loss(spec, jnp.asarray(p), jnp.asarray(data),
+                             engine=engine))
+    np.testing.assert_allclose(got, want, rtol=1e-8, err_msg=engine)
+
+
+@pytest.mark.slow
+def test_assoc_long_t_oracle_parity_sharded(rng):
+    """T=2048 on the 8 virtual devices: assoc + time-sharded loss vs the
+    sequential NumPy oracle, with a NaN-gap window and start/end masking
+    (masking == truncation, so the window maps onto the oracle's panel)."""
+    from yieldfactormodels_jl_tpu.parallel.mesh import make_mesh
+    from yieldfactormodels_jl_tpu.parallel.time_parallel import (
+        get_loss_time_sharded)
+
+    T, s, e = 2048, 4, 2040
+    spec, p, data = _case(rng, T=T)
+    data[:, 700:708] = np.nan          # interior NaN gap inside the window
+    Z, Phi, delta, Om, ov = _oracle_pieces(spec, p)
+    want = oracle.kalman_filter_loglik(Z, Phi, delta, Om, ov, data[:, s:e])
+    got_assoc = float(assoc_scan.get_loss(spec, jnp.asarray(p),
+                                          jnp.asarray(data), s, e))
+    np.testing.assert_allclose(got_assoc, want, rtol=1e-8)
+    mesh = make_mesh(axis_name="time")
+    assert mesh.devices.size == 8
+    got_sharded = float(get_loss_time_sharded(spec, p, data, start=s, end=e,
+                                              mesh=mesh))
+    np.testing.assert_allclose(got_sharded, want, rtol=1e-8)
+
+
+def test_time_sharded_loss_ragged_length(rng):
+    """T not divisible by the mesh: the panel is NaN-padded to a device
+    multiple with ``end`` at the true length — exact, not approximate
+    (real daily histories have arbitrary length)."""
+    from yieldfactormodels_jl_tpu.parallel.mesh import make_mesh
+    from yieldfactormodels_jl_tpu.parallel.time_parallel import (
+        get_loss_time_sharded)
+
+    spec, p, data = _case(rng, T=250)       # 250 % 8 != 0
+    seq = float(univariate_kf.get_loss(spec, jnp.asarray(p),
+                                       jnp.asarray(data)))
+    par = float(get_loss_time_sharded(spec, p, data,
+                                      mesh=make_mesh(axis_name="time")))
+    np.testing.assert_allclose(par, seq, rtol=1e-9)
+
+
+def test_assoc_grad_parity_vs_scan_engine(rng):
+    """The differentiable assoc loss: gradient vs the scan engine's at the
+    stable point, T=360 (the acceptance panel length)."""
+    spec, p, data = _case(rng, T=360)
+    data[:, 100:104] = np.nan
+    dj, pj = jnp.asarray(data), jnp.asarray(p)
+    g_assoc = np.asarray(jax.grad(
+        lambda q: assoc_scan.get_loss(spec, q, dj))(pj))
+    g_scan = np.asarray(jax.grad(
+        lambda q: univariate_kf.get_loss(spec, q, dj))(pj))
+    assert np.isfinite(g_assoc).all()
+    np.testing.assert_allclose(
+        np.linalg.norm(g_assoc - g_scan) / np.linalg.norm(g_scan), 0.0,
+        atol=1e-10)
+
+
+def test_assoc_taxonomy_codes(rng):
+    """Assoc-engine non-finite losses carry decoded causes like every other
+    engine (robustness/taxonomy.py channel)."""
+    spec, p, data = _case(rng)
+    dj = jnp.asarray(data)
+    ll, code = assoc_scan.get_loss_coded(spec, jnp.asarray(p), dj)
+    assert np.isfinite(float(ll)) and int(code) == tax.OK
+    bad = p.copy()
+    bad[spec.layout["obs_var"][0]] = -10.0
+    ll, code = assoc_scan.get_loss_coded(spec, jnp.asarray(bad), dj)
+    assert float(ll) == -np.inf and tax.decode(code)  # a named cause, not 0
+    nanp = p.copy()
+    nanp[0] = np.nan
+    _, code = assoc_scan.get_loss_coded(spec, jnp.asarray(nanp), dj)
+    assert "TRANSFORM_OVERFLOW" in tax.decode(code)
+    _, code = assoc_scan.get_loss_coded(spec, jnp.asarray(p), dj, 5, 6)
+    assert "MISSING_ALL_OBS" in tax.decode(code)
+
+
+def test_assoc_stabilized_mode_matches_at_stable_point(rng):
+    """psd_floor (the sqrt-stabilized recovery surface) is a no-op at a
+    healthy point — projection only clips what was already indefinite."""
+    spec, p, data = _case(rng)
+    a = float(assoc_scan.get_loss(spec, jnp.asarray(p), jnp.asarray(data)))
+    s = float(assoc_scan.get_loss(spec, jnp.asarray(p), jnp.asarray(data),
+                                  psd_floor=ladder.SQRT_RESCUE_FLOOR))
+    np.testing.assert_allclose(s, a, rtol=1e-9)
+
+
+# ---------------------------------------------------------------------------
+# engine-dispatch policy (YFM_LOGLIK_T_SWITCH)
+# ---------------------------------------------------------------------------
+
+def test_t_switch_dispatches_long_panels_to_assoc(rng, monkeypatch):
+    spec, p, data = _case(rng, T=100)
+    dj, pj = jnp.asarray(data), jnp.asarray(p)
+    calls = []
+    real = assoc_scan.get_loss
+    monkeypatch.setattr(assoc_scan, "get_loss",
+                        lambda *a, **k: calls.append(1) or real(*a, **k))
+    try:
+        config.set_loglik_t_switch(64)
+        api.get_loss(spec, pj, dj)                 # T=100 >= 64 → assoc
+        assert len(calls) == 1
+        api.get_loss(spec, pj, dj[:, :50])         # T=50 < 64 → sequential
+        assert len(calls) == 1
+        api.get_loss(spec, pj, dj, engine="univariate")  # explicit wins
+        assert len(calls) == 1
+        config.set_loglik_t_switch(0)
+        api.get_loss(spec, pj, dj)                 # policy off
+        assert len(calls) == 1
+    finally:
+        config.set_loglik_t_switch(0)
+
+
+def test_t_switch_env_resolution_and_validation(monkeypatch):
+    monkeypatch.setenv("YFM_LOGLIK_T_SWITCH", "4096")
+    monkeypatch.setattr(config, "_LOGLIK_T_SWITCH", None)  # force re-resolve
+    assert config.loglik_t_switch() == 4096
+    config.set_loglik_t_switch(0)
+    with pytest.raises(ValueError):
+        config.set_loglik_t_switch(-1)
+
+
+def test_t_switch_clears_jitted_estimation_caches(rng):
+    """set_loglik_t_switch must invalidate the registered engine caches —
+    the dispatch is read at trace time (same contract as
+    set_kalman_engine)."""
+    from yieldfactormodels_jl_tpu.estimation import optimize
+
+    spec, p, data = _case(rng, T=50)
+    optimize._jitted_loss(spec, 50)
+    assert optimize._jitted_loss.cache_info().currsize >= 1
+    try:
+        config.set_loglik_t_switch(16)
+        assert optimize._jitted_loss.cache_info().currsize == 0
+    finally:
+        config.set_loglik_t_switch(0)
+
+
+# ---------------------------------------------------------------------------
+# the multi-start cascade on the assoc engine
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_estimate_cascade_on_assoc_engine(rng):
+    """estimate() end-to-end with the assoc engine selected via the T-switch,
+    vs the scan-engine cascade — parameter estimates within optimizer
+    tolerance (the engines agree to float64 rounding, so the optimizer
+    trajectories stay together)."""
+    from yieldfactormodels_jl_tpu.estimation import optimize
+
+    spec, p, data = _case(rng, T=80)
+    starts = np.stack([p, p * 1.02], axis=1)
+    base = optimize.estimate(spec, data, starts, max_iters=40)
+    try:
+        config.set_loglik_t_switch(1)          # every panel rides the tree
+        ts = optimize.estimate(spec, data, starts, max_iters=40)
+    finally:
+        config.set_loglik_t_switch(0)
+    assert np.isfinite(base[1]) and np.isfinite(ts[1])
+    np.testing.assert_allclose(ts[1], base[1], rtol=1e-6)
+    np.testing.assert_allclose(ts[2], base[2], rtol=1e-3, atol=1e-4)
+
+
+@pytest.mark.slow
+def test_estimate_steps_on_assoc_engine(rng):
+    """The block-coordinate cascade with the process engine forced to assoc
+    — same contract as the scan run within ΔLL tolerance."""
+    from yieldfactormodels_jl_tpu.estimation import optimize
+
+    spec, p, data = _case(rng, T=60)
+    groups = spec.default_param_groups()
+    base = optimize.estimate_steps(spec, data, p[:, None], groups,
+                                   max_group_iters=2)
+    yfm.set_kalman_engine("assoc")
+    try:
+        got = optimize.estimate_steps(spec, data, p[:, None], groups,
+                                      max_group_iters=2)
+    finally:
+        yfm.set_kalman_engine("univariate")
+    np.testing.assert_allclose(got[1], base[1], rtol=1e-6)
+
+
+@pytest.mark.slow
+def test_estimate_time_sharded_objective(rng):
+    """estimate(objective="time_sharded"): the assoc loss over the sharded
+    time axis drives the same multi-start L-BFGS artifact."""
+    from yieldfactormodels_jl_tpu.estimation import optimize
+
+    spec, p, data = _case(rng, T=250)       # 250 % 8 != 0: ragged T works
+    starts = np.stack([p, p * 0.99], axis=1)
+    base = optimize.estimate(spec, data, starts, max_iters=15,
+                             objective="vmap")
+    ts = optimize.estimate(spec, data, starts, max_iters=15,
+                           objective="time_sharded")
+    np.testing.assert_allclose(ts[1], base[1], rtol=1e-6)
+    with pytest.raises(ValueError, match="time_sharded"):
+        sv_spec, _ = yfm.create_model("TVλ", MATS, float_type="float64")
+        optimize.estimate(sv_spec, data, np.zeros((sv_spec.n_params, 1)),
+                          objective="time_sharded")
+
+
+# ---------------------------------------------------------------------------
+# ladder: assoc as a long-panel rescue rung
+# ---------------------------------------------------------------------------
+
+def _nonpsd_start(spec, p):
+    bad = np.asarray(p, dtype=np.float64).copy()
+    a, b = spec.layout["phi"]
+    Phi = 0.9 * np.eye(3)
+    Phi[0, 1] = Phi[1, 0] = Phi[0, 2] = Phi[2, 0] = 0.8
+    Phi[1, 2] = Phi[2, 1] = 0.8
+    bad[a:b] = Phi.reshape(-1)
+    return bad
+
+
+@pytest.mark.slow
+def test_ladder_assoc_rung_rescues_long_panel(rng):
+    """A dead start on a long panel (T >= ASSOC_RESCUE_MIN_T) is recovered
+    by the assoc rung — O(log T) span instead of another sequential walk —
+    and the trace says so."""
+    spec, p, data = _case(rng, T=ladder.ASSOC_RESCUE_MIN_T + 76)
+    raw_bad = np.asarray(untransform_params(
+        spec, jnp.asarray(_nonpsd_start(spec, p))))
+    tr = ladder.escalate(spec, data, raw_bad)
+    assert [r.rung for r in tr.rungs] == ["scan", "assoc"]
+    assert tr.recovered and tr.rung == "assoc" and tr.engine == "assoc"
+    assert np.isfinite(tr.ll)
+
+
+def test_ladder_assoc_rung_skipped_on_short_panels(rng):
+    """Below the length gate the ladder keeps its historical scan → sqrt
+    climb (the existing sqrt-rung tests pin the exact rung lists)."""
+    spec, p, data = _case(rng, T=60)
+    raw_bad = np.asarray(untransform_params(
+        spec, jnp.asarray(_nonpsd_start(spec, p))))
+    tr = ladder.escalate(spec, data, raw_bad)
+    assert "assoc" not in [r.rung for r in tr.rungs]
+    assert tr.recovered and tr.rung == "sqrt"
+
+
+# ---------------------------------------------------------------------------
+# inference: structured per-step-contribution errors
+# ---------------------------------------------------------------------------
+
+def test_per_step_contributions_error_is_structured(rng):
+    from yieldfactormodels_jl_tpu.estimation.inference import (
+        PerStepContributionsUnavailable, _jitted_score_contributions,
+        mle_standard_errors)
+
+    spec, p, data = _case(rng, T=40)
+    for eng in ("sqrt", "assoc"):
+        with pytest.raises(PerStepContributionsUnavailable,
+                           match="'joint' and 'univariate'") as ei:
+            mle_standard_errors(spec, p, data, kind="sandwich", engine=eng)
+        assert ei.value.engine == eng
+        assert ei.value.supported == ("joint", "univariate")
+        # the guard lives at the builder too — every caller hits it
+        with pytest.raises(PerStepContributionsUnavailable):
+            _jitted_score_contributions(spec, 40, eng)
+    # and it is a ValueError, so generic validation handlers still catch it
+    assert issubclass(PerStepContributionsUnavailable, ValueError)
+
+
+# ---------------------------------------------------------------------------
+# serving: refilter() — exact rebuild vs 5k accumulated O(1) updates
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_refilter_agrees_with_accumulated_updates(rng):
+    """Drift regression (acceptance): a clean 5,000-update run, PSD at every
+    checkpoint, then one O(log T) refilter whose rebuilt state matches the
+    accumulated recursive state to float64 rounding."""
+    from yieldfactormodels_jl_tpu.serving import (YieldCurveService,
+                                                  freeze_snapshot)
+
+    spec, _ = yfm.create_model("1C", MATS, float_type="float64")
+    p = oracle.stable_1c_params(spec, np.float64)
+    T_cond, n_upd = 64, 5000
+    panel = oracle.simulate_dns_panel(rng, np.asarray(MATS),
+                                      T=T_cond + n_upd)
+    svc = YieldCurveService(freeze_snapshot(spec, p, panel[:, :T_cond]))
+    i = T_cond
+    while i < T_cond + n_upd:
+        j = min(i + 128, T_cond + n_upd)
+        lls = svc.update_many(j, panel[:, i:j])
+        assert np.isfinite(lls).all()
+        w = np.linalg.eigvalsh(np.asarray(svc.snapshot.P))
+        assert w.min() > 0, f"covariance left the PSD cone at update {i}"
+        i = j
+    assert svc.version == n_upd
+    beta_acc = np.asarray(svc.snapshot.beta).copy()
+    P_acc = np.asarray(svc.snapshot.P).copy()
+    ll = svc.refilter(panel, date="rebuild")
+    assert np.isfinite(ll)
+    assert svc.version == n_upd + 1 and not svc.stale
+    np.testing.assert_allclose(np.asarray(svc.snapshot.beta), beta_acc,
+                               atol=1e-10)
+    np.testing.assert_allclose(np.asarray(svc.snapshot.P), P_acc, atol=1e-10)
+    assert np.linalg.eigvalsh(np.asarray(svc.snapshot.P)).min() > 0
+    # the rebuild is the strongest refresh: cadence reset, state last-good
+    assert svc._updates_since_refresh == 0
+    np.testing.assert_array_equal(np.asarray(svc.last_good_snapshot.beta),
+                                  np.asarray(svc.snapshot.beta))
+
+
+def test_refilter_sqrt_engine_and_validation(rng):
+    from yieldfactormodels_jl_tpu.serving import (ServingError,
+                                                  YieldCurveService,
+                                                  freeze_snapshot)
+
+    spec, _ = yfm.create_model("1C", MATS, float_type="float64")
+    p = oracle.stable_1c_params(spec, np.float64)
+    panel = oracle.simulate_dns_panel(rng, np.asarray(MATS), T=96)
+    svc = YieldCurveService(freeze_snapshot(spec, p, panel[:, :64]),
+                            engine="sqrt")
+    for t in range(64, 96):
+        svc.update(t, panel[:, t])
+    ll = svc.refilter(panel)
+    assert np.isfinite(ll)
+    S = np.asarray(svc._state.cov)          # sqrt engine: factor, P = S Sᵀ
+    np.testing.assert_allclose(S @ S.T, np.asarray(svc.snapshot.P),
+                               atol=1e-10)
+    with pytest.raises(ServingError, match="refilter"):
+        svc.refilter(panel[:2])             # wrong shape
+    tvl_spec, _ = yfm.create_model("TVλ", MATS, float_type="float64")
+    tvl_p = oracle.stable_tvl_params(tvl_spec)
+    tvl_svc = YieldCurveService(
+        freeze_snapshot(tvl_spec, tvl_p, panel[:, :64]))
+    with pytest.raises(ServingError, match="constant-measurement"):
+        tvl_svc.refilter(panel)

@@ -163,3 +163,65 @@ def test_request_path_bounded_queues_and_no_bare_sleep():
 def test_request_path_guard_is_not_vacuous():
     names = {os.path.basename(p) for p in _request_path_files()}
     assert {"gateway.py", "batcher.py", "service.py", "online.py"} <= names
+
+
+# ---------------------------------------------------------------------------
+# engine-coverage guard: every Kalman loglik engine is oracle-backed
+# ---------------------------------------------------------------------------
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def _oracle_backed_test_files():
+    """(name, AST) of every test module that imports ``tests/oracle.py`` —
+    the independent NumPy float64 loops every numeric kernel must be pinned
+    against (CLAUDE.md: never against another JAX path alone)."""
+    for name in sorted(os.listdir(TESTS_DIR)):
+        if not (name.startswith("test_") and name.endswith(".py")):
+            continue
+        path = os.path.join(TESTS_DIR, name)
+        with open(path) as fh:
+            tree = ast.parse(fh.read(), filename=path)
+        uses_oracle = False
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and node.module \
+                    and node.module.split(".")[-1] == "oracle":
+                uses_oracle = True
+            if isinstance(node, ast.ImportFrom) and node.module \
+                    and any(a.name == "oracle" for a in node.names):
+                uses_oracle = True
+            if isinstance(node, ast.Import) \
+                    and any(a.name.split(".")[-1] == "oracle"
+                            for a in node.names):
+                uses_oracle = True
+        if uses_oracle:
+            yield name, tree
+
+
+def test_every_kalman_engine_has_oracle_parity_coverage():
+    """Mechanical guard (AST, matching the sentinel guards above): every
+    engine name in ``config.KALMAN_ENGINES`` must appear as a string
+    constant inside at least one oracle-importing test module — a new
+    engine cannot ship selectable without an oracle-backed parity test
+    naming it.  (tests/test_assoc_estimation.py carries the canonical
+    all-engines row and pins its literal list to the registry, so the
+    string-level proxy here is anchored to a real parity test.)"""
+    from yieldfactormodels_jl_tpu.config import KALMAN_ENGINES
+
+    files = dict(_oracle_backed_test_files())
+    strings = {
+        name: {n.value for n in ast.walk(tree)
+               if isinstance(n, ast.Constant) and isinstance(n.value, str)}
+        for name, tree in files.items()
+    }
+    missing = [e for e in KALMAN_ENGINES
+               if not any(e in ss for ss in strings.values())]
+    assert not missing, (
+        f"engines with no oracle-backed parity coverage: {missing} — add a "
+        f"parity test against tests/oracle.py that names the engine "
+        f"(see test_assoc_estimation.test_engine_oracle_parity_with_nan_gap)")
+    # non-vacuity: the walk must see the canonical coverage module and the
+    # registry must still be the four-engine set (or larger)
+    assert "test_assoc_estimation.py" in files, \
+        "engine-coverage guard rotted: canonical parity module not scanned"
+    assert len(KALMAN_ENGINES) >= 4

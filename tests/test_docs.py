@@ -14,6 +14,7 @@ DOC = os.path.join(os.path.dirname(__file__), os.pardir, "docs", "QUICKSTART.md"
 # doc alias -> importable module path
 ALIASES = {
     "yfm": "yieldfactormodels_jl_tpu",
+    "config": "yieldfactormodels_jl_tpu.config",
     "optimize": "yieldfactormodels_jl_tpu.estimation.optimize",
     "mesh": "yieldfactormodels_jl_tpu.parallel.mesh",
     "smoother": "yieldfactormodels_jl_tpu.ops.smoother",

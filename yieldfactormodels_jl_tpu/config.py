@@ -9,6 +9,8 @@ precision; f64 is available for CPU oracle runs via ``jax_enable_x64``).
 
 from __future__ import annotations
 
+import os
+
 import jax.numpy as jnp
 
 _DEFAULT_DTYPE = jnp.float32
@@ -47,6 +49,44 @@ def default_dtype():
 def set_default_dtype(dtype) -> None:
     global _DEFAULT_DTYPE
     _DEFAULT_DTYPE = jnp.dtype(dtype)
+
+
+#: T-switch for the likelihood engine-dispatch policy (``api.get_loss``):
+#: panels with T >= the threshold run the O(log T) associative-scan engine,
+#: shorter ones keep the sequential production default.  ``None`` = not yet
+#: resolved from the ``YFM_LOGLIK_T_SWITCH`` env knob; 0 = policy off.
+_LOGLIK_T_SWITCH: int | None = None
+
+
+def loglik_t_switch() -> int:
+    """Panel length at/above which ``api.get_loss`` auto-dispatches the
+    constant-measurement Kalman families to the ``"assoc"`` engine (0 = off).
+
+    Resolved lazily from ``YFM_LOGLIK_T_SWITCH`` so env-configured runs need
+    no code; :func:`set_loglik_t_switch` overrides it process-wide.  Read at
+    TRACE time inside the loglik kernels, so the setter must invalidate the
+    registered engine caches — same contract as :func:`set_kalman_engine`.
+    """
+    global _LOGLIK_T_SWITCH
+    if _LOGLIK_T_SWITCH is None:
+        _LOGLIK_T_SWITCH = int(os.environ.get("YFM_LOGLIK_T_SWITCH", "0")
+                               or 0)
+    return _LOGLIK_T_SWITCH
+
+
+def set_loglik_t_switch(T: int) -> None:
+    """Set the engine-dispatch T-switch (0 disables the policy).
+
+    Like :func:`set_kalman_engine`, the choice is read at trace time, so all
+    registered lru-cached jitted-loss builders are cleared here — a stale
+    trace would silently keep the engine the old threshold picked."""
+    global _LOGLIK_T_SWITCH
+    T = int(T)
+    if T < 0:
+        raise ValueError(f"loglik T-switch must be >= 0, got {T}")
+    _LOGLIK_T_SWITCH = T
+    for fn in _ENGINE_CACHES:  # drop stale traced executables
+        fn.cache_clear()
 
 
 def kalman_engine() -> str:

@@ -25,6 +25,31 @@ from ..models import api
 from ..models.params import transform_params, untransform_params
 from ..models.specs import ModelSpec
 
+#: engines whose forward pass emits per-step loglik contributions ∂ℓ_t
+#: (the joint form's per-step Cholesky decomposition and its Cholesky-free
+#: univariate twin).  "sqrt" accumulates the loglik inside the Potter carry
+#: and "assoc" computes it from composed moments — neither exposes the
+#: per-step decomposition the sandwich B-matrix needs.
+PER_STEP_LL_ENGINES = ("joint", "univariate")
+
+
+class PerStepContributionsUnavailable(ValueError):
+    """Per-step loglik contributions were requested from a loglik-only
+    engine.  Structured (``engine``/``supported`` attributes) so drivers can
+    branch on it instead of string-matching, and a ``ValueError`` so generic
+    config-validation handlers still catch it."""
+
+    def __init__(self, engine: str, what: str = "per-step loglik "
+                 "contributions"):
+        self.engine = engine
+        self.supported = PER_STEP_LL_ENGINES
+        super().__init__(
+            f"engine {engine!r} has no per-step loglik decomposition — "
+            f"{what} are available from the "
+            f"{' and '.join(repr(e) for e in PER_STEP_LL_ENGINES)} engines "
+            f"only; pass engine= explicitly or "
+            f"config.set_kalman_engine('univariate')")
+
 
 @register_engine_cache
 @lru_cache(maxsize=32)
@@ -50,15 +75,20 @@ def _jitted_score_contributions(spec: ModelSpec, T: int, engine: str):
 
     ``engine``: "joint" (per-step Cholesky) or "univariate" (Cholesky-free
     sequential updates — same per-step ll decomposition, Koopman–Durbin).
-    The "sqrt"/"assoc" loglik engines don't emit per-step contributions;
-    callers resolve those to an error (mle_standard_errors).  A failed f32
-    factorization surfaces as NaN scores, guarded by the caller; rerun in
-    float64 in that case.
+    Any other engine ("sqrt"/"assoc" don't emit per-step contributions)
+    raises :class:`PerStepContributionsUnavailable` HERE, at the builder —
+    the guard is enforced for every caller, not promised in a comment
+    (``mle_standard_errors`` re-checks earlier only to fail before paying
+    the Hessian).  A failed f32 factorization surfaces as NaN scores,
+    guarded by the caller; rerun in float64 in that case.
 
     jacfwd, not jacrev: the map is R^P → R^T with T ≫ P, so P forward JVPs
     beat T backward scan passes (and skip the O(T) residual stash)."""
     from ..models import kalman as K
     from ..ops import univariate_kf
+
+    if engine not in PER_STEP_LL_ENGINES:
+        raise PerStepContributionsUnavailable(engine)
 
     def scores(raw, data, start, end):
         def contribs(r):
@@ -114,12 +144,9 @@ def mle_standard_errors(spec: ModelSpec, params_hat, data, start=0, end=None,
         from .. import config
 
         eng = engine or config.kalman_engine()
-        if eng not in ("joint", "univariate"):
-            raise ValueError(
-                f"sandwich standard errors: engine {eng!r} has no per-step "
-                f"loglik decomposition — 'joint' and 'univariate' are "
-                f"supported; pass engine= explicitly or "
-                f"config.set_kalman_engine('univariate')")
+        if eng not in PER_STEP_LL_ENGINES:
+            raise PerStepContributionsUnavailable(
+                eng, what="sandwich (QMLE-robust) standard errors")
     data = jnp.asarray(data, dtype=spec.dtype)
     T = data.shape[1]
     if end is None:

@@ -421,15 +421,21 @@ def vmapped_value_and_grad(spec: ModelSpec, data, start, end, penalty=1e12):
 
 
 def _resolve_objective(spec: ModelSpec, objective: str) -> str:
-    if objective not in ("auto", "fused", "vmap"):
+    if objective not in ("auto", "fused", "vmap", "time_sharded"):
         raise ValueError(f"unknown objective {objective!r}; "
-                         f"pick from ('auto', 'fused', 'vmap')")
+                         f"pick from ('auto', 'fused', 'vmap', "
+                         f"'time_sharded')")
     if objective == "auto":
         on_tpu = jax.devices()[0].platform == "tpu"
         return "fused" if on_tpu and spec.family in _FUSED_FAMILIES else "vmap"
     if objective == "fused" and spec.family not in _FUSED_FAMILIES:
         raise ValueError(f"fused objective unavailable for family "
                          f"{spec.family!r}; use objective='vmap'")
+    if objective == "time_sharded" and not spec.has_constant_measurement:
+        raise ValueError(
+            f"time_sharded objective needs a constant-measurement Kalman "
+            f"family (the associative-scan engine, docs/DESIGN.md §13); "
+            f"{spec.family!r} is not one — use objective='vmap'")
     return objective
 
 
@@ -464,10 +470,16 @@ def estimate(spec: ModelSpec, data, all_params, start=0, end=None,
     """Multi-start LBFGS MLE.  ``all_params``: (P, S) constrained starts.
 
     All S starts run simultaneously — either as a vmapped per-start LBFGS
-    (``objective="vmap"``) or as ONE natively-batched LBFGS whose every
+    (``objective="vmap"``), as ONE natively-batched LBFGS whose every
     function/gradient eval is a single fused Pallas kernel launch
-    (``objective="fused"``, constant-measurement Kalman families on TPU).
-    ``"auto"`` picks fused whenever it is available.
+    (``objective="fused"``, constant-measurement Kalman families on TPU), or
+    as a vmapped LBFGS over the O(log T) associative-scan loglik with the
+    panel's TIME axis sharded across the device mesh
+    (``objective="time_sharded"``, constant-Z families — the long-panel path,
+    docs/DESIGN.md §13).  ``"auto"`` picks fused whenever it is available.
+    Independently of the objective, the loss ENGINE inside the vmap path
+    follows ``config.set_kalman_engine`` / the ``YFM_LOGLIK_T_SWITCH``
+    dispatch policy through ``api.get_loss``.
 
     Returns (init_params, ll, best_params, Convergence(converged, iterations))
     like the reference's estimate! — the last element carries the *actual*
@@ -484,12 +496,22 @@ def estimate(spec: ModelSpec, data, all_params, start=0, end=None,
         [_sanitize(np.asarray(untransform_params(spec, c))) for c in all_params.T], axis=0
     )  # (S, P)
     kind = _resolve_objective(spec, objective)
-    if kind == "fused":
-        runner = _jitted_fused_multistart(spec, T, max_iters, g_tol, f_abstol)
+    if kind == "time_sharded":
+        from ..parallel.time_parallel import multistart_time_sharded
+
+        xs, lls_ts, its, convs = multistart_time_sharded(
+            spec, data, raw, start, end, max_iters=max_iters, g_tol=g_tol,
+            f_abstol=f_abstol)
+        fs = -lls_ts
     else:
-        runner = _jitted_multistart_lbfgs(spec, T, max_iters, g_tol, f_abstol)
-    xs, fs, its, convs = runner(jnp.asarray(raw, dtype=spec.dtype), data,
-                                jnp.asarray(start), jnp.asarray(end))
+        if kind == "fused":
+            runner = _jitted_fused_multistart(spec, T, max_iters, g_tol,
+                                              f_abstol)
+        else:
+            runner = _jitted_multistart_lbfgs(spec, T, max_iters, g_tol,
+                                              f_abstol)
+        xs, fs, its, convs = runner(jnp.asarray(raw, dtype=spec.dtype), data,
+                                    jnp.asarray(start), jnp.asarray(end))
     fs = np.asarray(fs, dtype=np.float64)
     lls = -fs
     xs_np = np.asarray(xs, dtype=np.float64)

@@ -44,6 +44,19 @@ def get_loss(spec: ModelSpec, params, data, start=0, end=None, K: int = 1,
         if name not in config.KALMAN_ENGINES:
             raise ValueError(
                 f"unknown kalman engine {name!r}; pick from {config.KALMAN_ENGINES}")
+        if (engine is None and name == "univariate"
+                and spec.has_constant_measurement
+                and 0 < config.loglik_t_switch() <= data.shape[1]):
+            # engine-dispatch policy (YFM_LOGLIK_T_SWITCH, docs/DESIGN.md
+            # §13): long panels ride the O(log T) associative-scan tree, short
+            # ones keep the sequential default whose constant factor wins.
+            # Only the PRODUCTION DEFAULT is upgraded — an explicit per-call
+            # engine or a deliberate process-wide "sqrt"/"joint" choice is
+            # never overridden.  T is static at trace time, so the dispatch
+            # costs nothing at run time; the jitted-loss caches that bake the
+            # choice in are invalidated by config.set_loglik_t_switch (the
+            # @register_engine_cache contract).
+            name = "assoc"
         if name == "sqrt":
             from ..ops import sqrt_kf
 

@@ -116,6 +116,16 @@ class ModelSpec:
         return self.family in KALMAN_FAMILIES
 
     @property
+    def has_constant_measurement(self) -> bool:
+        """Constant-Z Kalman family — THE applicability gate for the
+        associative-scan engine and everything built on it (T-switch
+        dispatch, ``objective="time_sharded"``, the ladder's assoc rung,
+        serving ``refilter()`` — docs/DESIGN.md §13).  One property so the
+        four call sites can never drift; TVλ's state-dependent Jacobian rows
+        (and any future time-varying measurement) stay excluded here."""
+        return self.family in ("kalman_dns", "kalman_afns")
+
+    @property
     def is_msed(self) -> bool:
         return self.family in MSED_FAMILIES
 

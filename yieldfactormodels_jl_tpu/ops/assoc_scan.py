@@ -13,6 +13,26 @@ Each step is the 5-tuple element (A, b, C, J, η); composition is closed under
 the filtering semigroup.  Missing observations (NaN columns) become pure
 prediction elements, so multi-step forecasting composes the same way.
 Applies to the time-invariant-measurement families (DNS, AFNS).
+
+This module is the ESTIMATION engine behind ``api.get_loss(engine="assoc")``
+and the ``YFM_LOGLIK_T_SWITCH`` dispatch policy (docs/DESIGN.md §13):
+
+- differentiable end-to-end (every op here has a JAX adjoint — the combine
+  tree, the batched solves, the Cholesky factors), so the multi-start L-BFGS
+  cascade runs on it unchanged;
+- optional square-root stabilization (``psd_floor``): the composed filtered
+  covariances are PSD-*projected* through the same eigenvalue-clip square-root
+  machinery as the escalation ladder's sqrt rung (ops/sqrt_kf.py
+  ``_psd_sqrt_factor``, after Yaghoobi et al., arXiv:2207.00426) before the
+  predicted innovation factorizations — the combine tree's f32 cancellations
+  cannot poison the likelihood with a spuriously indefinite moment.  Like
+  ``sqrt_kf.get_loss(init_psd_floor=...)`` this is the RECOVERY surface, not
+  the parity path: leave it ``None`` for exact agreement with the sequential
+  engines;
+- failure taxonomy (``get_loss_coded``): the int32 bitmask channel every
+  other engine carries (robustness/taxonomy.py), so an assoc-engine −Inf
+  decodes into causes and the ``YFM_ESCALATE`` ladder can use this engine as
+  a rescue rung for long panels (robustness/ladder.py).
 """
 
 from __future__ import annotations
@@ -27,6 +47,7 @@ from jax import lax
 from ..models import kalman as K
 from ..models.params import unpack_kalman
 from ..models.specs import ModelSpec
+from ..robustness import taxonomy as tax
 
 _LOG_2PI = math.log(2.0 * math.pi)
 
@@ -43,20 +64,48 @@ def _mv(M, v):
     return jnp.einsum("...ij,...j->...i", M, v)
 
 
+def _solve_unrolled(D, B):
+    """Pivot-free Gauss–Jordan solve of D X = B, unrolled over the (static,
+    tiny) state dimension — pure broadcast arithmetic that vectorizes over
+    the T-sized combine batch.  ``jnp.linalg.solve`` here lowers to batched
+    LAPACK on CPU (per-matrix dispatch ate ~70% of the combine tree's wall)
+    and to a lane-hostile loop on TPU; at Ms ≤ 5 the unrolled elimination is
+    a handful of fused elementwise ops instead.  No pivoting by design: every
+    system solved in :func:`_combine` is D = I + (PSD·PSD) — its spectrum
+    sits at/above 1 and D ≈ I in the filter's operating regime, exactly the
+    class where unpivoted elimination is stable (a genuinely degenerate
+    point goes non-finite and lands in the −Inf sentinel + taxonomy channel
+    like every other engine's breakdown)."""
+    M = D.shape[-1]
+    A = jnp.concatenate([D, B], axis=-1)          # (..., M, M+K)
+    for i in range(M):
+        piv = A[..., i:i + 1, :] / A[..., i:i + 1, i:i + 1]
+        A = A - A[..., :, i:i + 1] * piv          # eliminate col i everywhere
+        A = A.at[..., i, :].set(piv[..., 0, :])   # …then restore row i
+    return A[..., :, M:]
+
+
 def _combine(ei: FilterElement, ej: FilterElement) -> FilterElement:
     """Associative composition (element i happens before j)."""
     I = jnp.eye(ei.A.shape[-1], dtype=ei.A.dtype)
     D = I + ei.C @ ej.J
-    Dinv_Ai = jnp.linalg.solve(D, ei.A)
-    Dinv_bCe = jnp.linalg.solve(D, (ei.b + _mv(ei.C, ej.eta))[..., None])[..., 0]
+    rhs = jnp.concatenate(
+        [ei.A, (ei.b + _mv(ei.C, ej.eta))[..., None], ei.C], axis=-1)
+    sol = _solve_unrolled(D, rhs)                 # one elimination, 3 uses
+    Ms = ei.A.shape[-1]
+    Dinv_Ai = sol[..., :, :Ms]
+    Dinv_bCe = sol[..., :, Ms]
+    Dinv_Ci = sol[..., :, Ms + 1:]
     A = ej.A @ Dinv_Ai
     b = _mv(ej.A, Dinv_bCe) + ej.b
-    C = ej.A @ jnp.linalg.solve(D, ei.C) @ ej.A.swapaxes(-1, -2) + ej.C
+    C = ej.A @ Dinv_Ci @ ej.A.swapaxes(-1, -2) + ej.C
     E = I + ej.J @ ei.C
-    Einv_Jj = jnp.linalg.solve(E, ej.J)
+    rhs_e = jnp.concatenate(
+        [ej.J, (ej.eta - _mv(ej.J, ei.b))[..., None]], axis=-1)
+    sol_e = _solve_unrolled(E, rhs_e)
+    Einv_Jj = sol_e[..., :, :Ms]
     Ait = ei.A.swapaxes(-1, -2)
-    eta = _mv(Ait, jnp.linalg.solve(
-        E, (ej.eta - _mv(ej.J, ei.b))[..., None])[..., 0]) + ei.eta
+    eta = _mv(Ait, sol_e[..., :, Ms]) + ei.eta
     J = Ait @ Einv_Jj @ ei.A + ei.J
     return FilterElement(A, b, C, J, eta)
 
@@ -109,11 +158,120 @@ def _elements(Z, d, Phi, delta, Q, R_diag, m0, P0, data, observed):
     return FilterElement(A, b, C, J, eta), obs
 
 
-def filter_means_covs(spec: ModelSpec, params, data, start=0, end=None):
-    """Filtered means/covariances for every t via `lax.associative_scan`.
+#: pass-1 scan length of the blocked prefix (:func:`_prefix_scan`): chunks of
+#: this many steps ride the batch axis, so the within-chunk compose runs as
+#: an L-step scan over (T/L)-wide element batches.  128 balances scan-step
+#: dispatch (fewer iterations) against per-iteration working-set size.
+_CHUNK = 128
 
-    Returns (m (T, Ms) = E[x_t | y_{1:t}], P (T, Ms, Ms)).
+
+def _identity_like(e: FilterElement) -> FilterElement:
+    """The semigroup identity, batched like ``e``'s leading axes: A = I,
+    everything else 0 (combine(id, x) = combine(x, id) = x — both directions
+    verified by the parity tests through the padded tail)."""
+    I = jnp.eye(e.A.shape[-1], dtype=e.A.dtype)
+    return FilterElement(jnp.broadcast_to(I, e.A.shape).astype(e.A.dtype),
+                         jnp.zeros_like(e.b), jnp.zeros_like(e.C),
+                         jnp.zeros_like(e.J), jnp.zeros_like(e.eta))
+
+
+def _prefix_scan(elems: FilterElement, T: int):
+    """All-prefix composition of the T per-step elements: returns the
+    filtered ``(b (T, Ms), C (T, Ms, Ms))`` trajectories — the same result
+    as ``lax.associative_scan(_combine, elems)`` (up to float association
+    order) restructured as the classic three-pass blocked prefix:
+
+      1. within-chunk prefixes: an L-step ``lax.scan`` whose every combine
+         is batched over all T/L chunks (wide fused elementwise work),
+      2. exclusive prefix of the T/L chunk totals (a tiny combine tree),
+      3. one T-batched *simplified* apply of each chunk's incoming prefix
+         to its local prefixes.
+
+    ``lax.associative_scan`` interleaves slice/update traffic at every one
+    of its ~2·log₂T levels, which on CPU cost more than the whole
+    sequential filter; the blocked form does the identical ~2T combines as
+    two long-vectorized passes plus a negligible tree.  Pass 3 exploits
+    that every chunk-incoming prefix from chunk 1 on CONTAINS step 1, whose
+    element has A₁ = 0 — so the full composition collapses to one solve and
+    two matmuls, and its J/η outputs (never consumed downstream) are not
+    formed at all.
     """
+    Ms = elems.A.shape[-1]
+    L = min(_CHUNK, T)
+    C = -(-T // L)
+    pad = C * L - T
+    if pad:
+        ident = _identity_like(jax.tree_util.tree_map(
+            lambda x: jnp.broadcast_to(x[:1], (pad,) + x.shape[1:]), elems))
+        elems = jax.tree_util.tree_map(
+            lambda x, p: jnp.concatenate([x, p], axis=0), elems, ident)
+    # (C·L, ...) → (L, C, ...): scan over position-in-chunk, batch over chunks
+    by_l = jax.tree_util.tree_map(
+        lambda x: x.reshape((C, L) + x.shape[1:]).swapaxes(0, 1), elems)
+
+    def body(carry, e_l):
+        new = _combine(carry, e_l)  # carry (earlier steps) before e_l
+        return new, new
+
+    init = _identity_like(jax.tree_util.tree_map(lambda x: x[0], by_l))
+    _, prefixes = lax.scan(body, init, by_l)      # (L, C, ...) local prefixes
+    totals = jax.tree_util.tree_map(lambda x: x[-1], prefixes)      # (C, ...)
+    incl = lax.associative_scan(_combine, totals)                    # tiny: C
+    ident1 = _identity_like(jax.tree_util.tree_map(lambda x: x[:1], totals))
+    prefix_in = jax.tree_util.tree_map(         # exclusive: identity for c=0
+        lambda x, i: jnp.concatenate([i, x[:-1]], axis=0), incl, ident1)
+    # pass 3 — one batched combine(prefix_in[c], prefixes[l, c]) reduced to
+    # its (b, C) outputs, which depend on ei only through (b_i, C_i): one
+    # solve + two matmuls per element, J/η (never consumed downstream) not
+    # formed at all.  Exact for every chunk — chunk 0's identity prefix has
+    # C_i = 0, so D = I and the apply collapses to the local prefix.
+    Ci = prefix_in.C[None]                                # (1, C, Ms, Ms)
+    bi = prefix_in.b[None]
+    D = jnp.eye(Ms, dtype=Ci.dtype) + Ci @ prefixes.J
+    rhs = jnp.concatenate(
+        [(bi + _mv(Ci, prefixes.eta))[..., None],
+         jnp.broadcast_to(Ci, prefixes.C.shape)], axis=-1)
+    sol = _solve_unrolled(D, rhs)
+    b_full = _mv(prefixes.A, sol[..., :, 0]) + prefixes.b
+    C_full = prefixes.A @ sol[..., :, 1:] @ prefixes.A.swapaxes(-1, -2) \
+        + prefixes.C
+    # (L, C, ...) → (T, ...)
+    b_out = b_full.swapaxes(0, 1).reshape((C * L, Ms))[:T]
+    C_out = C_full.swapaxes(0, 1).reshape((C * L, Ms, Ms))[:T]
+    return b_out, C_out
+
+
+def _psd_project(P, floor):
+    """Batched PSD projection of (…, Ms, Ms) symmetric matrices: eigenvalue
+    clip at ``floor`` and reconstruct — the matrix form of ops/sqrt_kf.py's
+    ``_psd_sqrt_factor`` (the escalation ladder's square-root rescue
+    machinery), applied to the semigroup's composed moments instead of the
+    initial ones.  Differentiable (eigh has a JAX adjoint; the stable points
+    the optimizer visits have separated eigenvalues)."""
+    sym = 0.5 * (P + P.swapaxes(-1, -2))
+    w, V = jnp.linalg.eigh(sym)
+    w = jnp.maximum(w, jnp.asarray(floor, dtype=P.dtype))
+    return jnp.einsum("...ik,...k,...jk->...ij", V, w, V)
+
+
+def filter_means_covs(spec: ModelSpec, params, data, start=0, end=None,
+                      psd_floor=None, prefix: str = "blocked"):
+    """Filtered means/covariances for every t via the parallel prefix.
+
+    Returns (m (T, Ms) = E[x_t | y_{1:t}], P (T, Ms, Ms)).  ``psd_floor``
+    (a float) PSD-projects the composed covariances through
+    :func:`_psd_project` — the square-root-stabilized recovery mode; leave
+    ``None`` for the parity path.  ``prefix`` picks the combine schedule:
+    ``"blocked"`` (default — :func:`_prefix_scan`, the single-device fast
+    path) or ``"interleaved"`` (``lax.associative_scan`` — the TIME-SHARDED
+    path: its tree keeps block locality under SPMD where the blocked form's
+    chunk reshape would cross shard boundaries; also sidesteps an XLA SPMD
+    verifier fault in sharded scan-under-jvp).  Same math, float-level
+    association-order differences only.
+    """
+    if prefix not in ("blocked", "interleaved"):
+        raise ValueError(f"unknown prefix schedule {prefix!r}; pick from "
+                         f"('blocked', 'interleaved')")
     kp = unpack_kalman(spec, params)
     Z, d = K.measurement_setup(spec, kp, params.dtype)
     if Z is None:
@@ -127,37 +285,116 @@ def filter_means_covs(spec: ModelSpec, params, data, start=0, end=None):
     t_idx = jnp.arange(T)
     observed = (t_idx >= start) & (t_idx < end)
     R_diag = kp.obs_var * jnp.ones((spec.N,), dtype=Z.dtype)
+    P0 = state0.P if psd_floor is None else _psd_project(
+        jnp.where(jnp.isfinite(state0.P), state0.P, 0.0), psd_floor)
     elems, obs = _elements(Z, d, kp.Phi, kp.delta, kp.Omega_state, R_diag,
-                           state0.beta, state0.P, data, observed)
-    out = lax.associative_scan(_combine, elems)
-    return out.b, out.C, (Z, d, kp, state0, obs)
+                           state0.beta, P0, data, observed)
+    if prefix == "interleaved":
+        out = lax.associative_scan(_combine, elems)
+        m, covs = out.b, out.C
+    else:
+        m, covs = _prefix_scan(elems, T)
+    if psd_floor is not None:
+        covs = _psd_project(covs, psd_floor)
+    return m, covs, (Z, d, kp, state0, obs)
 
 
-def get_loss(spec: ModelSpec, params, data, start=0, end=None):
-    """Gaussian loglik computed from the parallel filter — numerically matches
-    the sequential kalman.get_loss (same skip-first convention)."""
-    m, P, (Z, d, kp, state0, obs) = filter_means_covs(spec, params, data, start, end)
+def _loss_coded(spec: ModelSpec, params, data, start=0, end=None,
+                psd_floor=None, prefix: str = "blocked"):
+    """Shared parallel-filter loss pass.  Returns ``(loss, code, moments)``
+    with ``moments = (m, P)`` the filtered trajectories — computed once so
+    the serving re-filter (:func:`filter_and_loss`) and the loss consumers
+    (:func:`get_loss`/:func:`get_loss_coded`) share one combine tree; XLA
+    dead-code-eliminates the stacks from loss-only callers."""
+    m, P, (Z, d, kp, state0, obs) = filter_means_covs(spec, params, data,
+                                                      start, end, psd_floor,
+                                                      prefix)
     T = data.shape[1]
     if end is None:
         end = T
     N = spec.N
-    R = kp.obs_var * jnp.eye(N, dtype=Z.dtype)
     # predicted moments at t from filtered at t−1
     m_prev = jnp.concatenate([state0.beta[None], m[:-1]], axis=0)
-    P_prev = jnp.concatenate([state0.P[None], P[:-1]], axis=0)
+    P0 = state0.P if psd_floor is None else _psd_project(
+        jnp.where(jnp.isfinite(state0.P), state0.P, 0.0), psd_floor)
+    P_prev = jnp.concatenate([P0[None], P[:-1]], axis=0)
     mpred = m_prev @ kp.Phi.T + kp.delta[None]
     Ppred = jnp.einsum("ij,tjk,lk->til", kp.Phi, P_prev, kp.Phi) + kp.Omega_state[None]
     ysafe = jnp.where(jnp.isfinite(data.T), data.T, 0.0)
-    v = ysafe - (mpred @ Z.T + d[None])
-    F = jnp.einsum("ij,tjk,lk->til", Z, Ppred, Z) + R[None]
-    cho = jnp.linalg.cholesky(F)
-    ok = jnp.all(jnp.isfinite(cho), axis=(1, 2))
-    cho_safe = jnp.where(ok[:, None, None], jnp.nan_to_num(cho),
-                         jnp.eye(N, dtype=Z.dtype)[None])
-    Fi_v = jax.scipy.linalg.cho_solve((cho_safe, True), v[..., None])[..., 0]
-    logdet = 2.0 * jnp.sum(jnp.log(jnp.diagonal(cho_safe, axis1=1, axis2=2)), axis=1)
-    ll_t = -0.5 * (logdet + jnp.sum(v * Fi_v, axis=1) + N * _LOG_2PI)
+    y_eff = ysafe - d[None]
+    # per-step loglik by the univariate (sequential-observation) identity
+    # (ops/univariate_kf.py): log|F| + vᵀF⁻¹v = Σ_i log f_i + v_i²/f_i, so a
+    # scan over the N observations — each step a few ops VECTORIZED over all
+    # T — replaces the (T, N, N) batched innovation Cholesky, which on CPU
+    # cost more than the whole combine tree and on TPU is the classic
+    # unmappable tiny-factorization case.  Same failure semantics as the
+    # univariate engine: finite f ≤ 0 → NONPSD_INNOVATION, non-finite chain
+    # → STATE_EXPLODED, either → −Inf through the ok gate.
+    def obs_body(carry, zi_yi):
+        b, Pm, ll, ok, code = carry                  # (T,Ms) (T,Ms,Ms) (T,)…
+        z, y_i = zi_yi                               # (Ms,), (T,)
+        zP = jnp.einsum("tij,j->ti", Pm, z)
+        f = zP @ z + kp.obs_var
+        f_fin = jnp.isfinite(f)
+        ok = ok & (f > 0) & f_fin
+        code = code | tax.bit(f_fin & (f <= 0), tax.NONPSD_INNOVATION) \
+            | tax.bit(~f_fin, tax.STATE_EXPLODED)
+        fsafe = jnp.where(f > 0, f, 1.0)
+        v = y_i - b @ z
+        Kg = zP / fsafe[:, None]
+        b = b + Kg * v[:, None]
+        Pm = Pm - Kg[:, :, None] * zP[:, None, :]
+        ll = ll - 0.5 * (jnp.log(fsafe) + v * v / fsafe + _LOG_2PI)
+        return (b, Pm, ll, ok, code), None
+
+    zeros_t = jnp.zeros((T,), dtype=Z.dtype)
+    (_, _, ll_t, ok, codes), _ = lax.scan(
+        obs_body,
+        (mpred, Ppred, zeros_t, jnp.ones((T,), dtype=bool),
+         jnp.zeros((T,), dtype=tax.CODE_DTYPE)),
+        (Z, y_eff.T), length=N)
     t_idx = jnp.arange(T)
     contrib = (t_idx >= start + 1) & (t_idx <= end - 2) & obs
     total = jnp.sum(jnp.where(contrib, jnp.where(ok, ll_t, -jnp.inf), 0.0))
-    return jnp.where(jnp.isfinite(total), total, -jnp.inf)
+    loss = jnp.where(jnp.isfinite(total), total, -jnp.inf)
+    # taxonomy bitmask beside the sentinel (robustness/taxonomy.py), same
+    # decode vocabulary as the sequential engines
+    code = tax.params_code(params) \
+        | tax.combine(jnp.where(contrib, codes, jnp.int32(0))) \
+        | tax.bit(~jnp.any(contrib), tax.MISSING_ALL_OBS)
+    code = code | tax.bit(~jnp.isfinite(loss) & (code == 0),
+                          tax.STATE_EXPLODED)
+    return loss, code, (m, P)
+
+
+def get_loss(spec: ModelSpec, params, data, start=0, end=None,
+             psd_floor=None, prefix: str = "blocked"):
+    """Gaussian loglik computed from the parallel filter — numerically matches
+    the sequential kalman.get_loss (same skip-first convention) at O(log T)
+    span, and differentiable end-to-end (the MLE cascade's assoc engine).
+    ``psd_floor`` selects the square-root-stabilized recovery mode
+    (:func:`_psd_project`); leave it ``None`` for the parity engine.
+    ``prefix`` follows :func:`filter_means_covs` (time-sharded callers pass
+    ``"interleaved"``)."""
+    loss, _, _ = _loss_coded(spec, params, data, start, end, psd_floor,
+                             prefix)
+    return loss
+
+
+def get_loss_coded(spec: ModelSpec, params, data, start=0, end=None,
+                   psd_floor=None, prefix: str = "blocked"):
+    """``(loss, code)`` — :func:`get_loss` plus its taxonomy bitmask, the
+    same self-describing failure channel every sequential engine carries."""
+    loss, code, _ = _loss_coded(spec, params, data, start, end, psd_floor,
+                                prefix)
+    return loss, code
+
+
+def filter_and_loss(spec: ModelSpec, params, data, start=0, end=None):
+    """One combine tree, all three consumers: ``(m, P, loss, code)`` with
+    ``(m[t], P[t])`` the filtered moments E[x_t | y_{1:t}] — the serving
+    re-filter-from-scratch primitive (serving/online.py ``_jitted_refilter``):
+    an exact O(log T)-span rebuild of the online state from raw history,
+    replacing trust in thousands of accumulated O(1) recursive updates."""
+    loss, code, (m, P) = _loss_coded(spec, params, data, start, end)
+    return m, P, loss, code

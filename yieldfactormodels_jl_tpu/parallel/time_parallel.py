@@ -24,12 +24,30 @@ from functools import lru_cache
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..config import register_engine_cache
 from ..models.specs import ModelSpec
 from .mesh import make_mesh
 
 
+def _pad_time(data, n_dev: int):
+    """Pad the TIME axis with NaN columns up to a device-count multiple —
+    ``NamedSharding`` placement needs the sharded dimension divisible by the
+    mesh, and real daily histories have arbitrary length.  Exact by
+    construction: with ``end`` kept at the ORIGINAL T the padded columns sit
+    outside the window, so the assoc elements there are pure prediction
+    steps past every contributing prefix — the loss is bit-identical."""
+    T = data.shape[1]
+    rem = (-T) % n_dev
+    if rem:
+        pad = jnp.full(data.shape[:1] + (rem,), jnp.nan, dtype=data.dtype)
+        data = jnp.concatenate([data, pad], axis=1)
+    return data
+
+
+@register_engine_cache
 @lru_cache(maxsize=32)
 def _jitted_time_sharded_loss(spec: ModelSpec, T: int, mesh: Mesh, axis: str):
     from ..ops import assoc_scan
@@ -38,8 +56,11 @@ def _jitted_time_sharded_loss(spec: ModelSpec, T: int, mesh: Mesh, axis: str):
     repl = NamedSharding(mesh, P())
 
     fn = jax.jit(
+        # interleaved combine tree: block-local under SPMD (the blocked
+        # prefix's chunk reshape would cross shard boundaries — see
+        # assoc_scan.filter_means_covs)
         lambda params, data, start, end: assoc_scan.get_loss(
-            spec, params, data, start, end),
+            spec, params, data, start, end, prefix="interleaved"),
         in_shardings=(repl, data_sh, repl, repl),
         out_shardings=repl,
     )
@@ -61,8 +82,79 @@ def get_loss_time_sharded(spec: ModelSpec, params, data, start=0, end=None,
     T = data.shape[1]
     if end is None:
         end = T
-    fn = _jitted_time_sharded_loss(spec, T, mesh, axis_name)
-    data = jax.device_put(jnp.asarray(data, dtype=spec.dtype),
-                          NamedSharding(mesh, P(None, axis_name)))
+    data = _pad_time(jnp.asarray(data, dtype=spec.dtype),
+                     int(mesh.devices.size))
+    fn = _jitted_time_sharded_loss(spec, data.shape[1], mesh, axis_name)
+    data = jax.device_put(data, NamedSharding(mesh, P(None, axis_name)))
     return fn(jnp.asarray(params, dtype=spec.dtype), data,
               jnp.asarray(start), jnp.asarray(end))
+
+
+# ---------------------------------------------------------------------------
+# time-sharded estimation: the long-panel MLE hot path (docs/DESIGN.md §13)
+# ---------------------------------------------------------------------------
+
+@register_engine_cache
+@lru_cache(maxsize=32)
+def _jitted_time_sharded_multistart(spec: ModelSpec, T: int, mesh: Mesh,
+                                    axis: str, max_iters: int, g_tol: float,
+                                    f_abstol: float):
+    """Multi-start L-BFGS whose every objective/gradient eval is the
+    associative-scan loglik over TIME-SHARDED data: starts replicated, the
+    panel laid out ``P(None, time)``, so a T=20k daily history optimizes at
+    O(log T) span per eval instead of 20k sequential steps per device.
+    (Lazy optimizer import: estimation ← parallel would otherwise cycle.)"""
+    from ..estimation import optimize as opt
+    from ..models.params import transform_params
+    from ..ops import assoc_scan
+
+    data_sh = NamedSharding(mesh, P(None, axis))
+    repl = NamedSharding(mesh, P())
+
+    def single(x0, data, start, end):
+        def fun(p):
+            # interleaved tree: block-local under SPMD (see the loss builder)
+            v = -assoc_scan.get_loss(spec, transform_params(spec, p), data,
+                                     start, end, prefix="interleaved")
+            return jnp.where(jnp.isfinite(v), v, 1e12)
+
+        return opt._run_lbfgs(fun, x0, max_iters, g_tol, f_abstol)
+
+    fn = jax.vmap(single, in_axes=(0, None, None, None))
+    return jax.jit(fn, in_shardings=(repl, data_sh, repl, repl),
+                   out_shardings=(repl, repl, repl, repl))
+
+
+def multistart_time_sharded(spec: ModelSpec, data, raw_starts, start=0,
+                            end=None, mesh: Mesh | None = None,
+                            max_iters: int = 1000, g_tol: float = 1e-6,
+                            f_abstol: float = 1e-6, axis_name: str = "time"):
+    """Multi-start MLE on the assoc engine with the TIME axis sharded.
+
+    The dual of :func:`~.mesh.multistart_sharded` (which shards the START
+    axis): here every device owns a contiguous block of timesteps and the
+    whole start batch rides each device — the right split when T is the big
+    axis (daily/intraday panels) and S is a handful.  Constant-measurement
+    Kalman families only (the associative form needs a constant Z).
+    Arbitrary T: the panel is NaN-padded to a device-count multiple with
+    ``end`` kept at the true length (exact — see :func:`_pad_time`).
+
+    Returns ``(raw_params (S, P), lls (S,), iters (S,), converged (S,))`` —
+    the ``estimate``-compatible artifact
+    (``estimation.optimize.estimate(objective="time_sharded")`` wraps this
+    with the standard best-of/reporting tail).
+    """
+    if mesh is None:
+        mesh = make_mesh(axis_name=axis_name)
+    data = jnp.asarray(data, dtype=spec.dtype)
+    T = data.shape[1]
+    if end is None:
+        end = T
+    data = _pad_time(data, int(mesh.devices.size))
+    fn = _jitted_time_sharded_multistart(spec, data.shape[1], mesh, axis_name,
+                                         max_iters, g_tol, f_abstol)
+    data = jax.device_put(data, NamedSharding(mesh, P(None, axis_name)))
+    xs, fs, its, convs = fn(jnp.asarray(np.asarray(raw_starts),
+                                        dtype=spec.dtype), data,
+                            jnp.asarray(start), jnp.asarray(end))
+    return xs, -fs, its, convs

@@ -13,16 +13,22 @@ automatically:
 1. ``scan``   one coded re-evaluation on the scan engine — recovers
    fused-kernel artifacts (the trust-but-verify class, DESIGN §7) and
    produces the taxonomy diagnosis every later rung reports;
-2. ``sqrt``   the square-root filter with PSD-*projected* initial moments
+2. ``assoc``  LONG panels only (T >= ``ASSOC_RESCUE_MIN_T``, constant-Z
+   Kalman families): the associative-scan engine with PSD-*projected*
+   composed moments (``assoc_scan.get_loss_coded(psd_floor=...)``,
+   docs/DESIGN.md §13) — the same stabilized surrogate as the sqrt rung but
+   at O(log T) span, so a dead 20k-step daily panel is re-evaluated in tree
+   depth instead of another 20k sequential steps; parameters unchanged;
+3. ``sqrt``   the square-root filter with PSD-*projected* initial moments
    (``sqrt_kf.get_loss_coded(init_psd_floor=...)``): covariance breakdowns
    (NONPSD_INNOVATION / CHOL_BREAKDOWN) re-enter through a factorization
    that cannot go indefinite — parameters unchanged;
-3. ``jitter`` covariance regularization in constrained space: the Ω_state
+4. ``jitter`` covariance regularization in constrained space: the Ω_state
    Cholesky diagonal is inflated and the observation variance floored, then
    re-evaluated on the scan engine — parameters (slightly) changed, and the
    modified vector is carried back so downstream consumers see what was
    actually evaluated;
-4. ``shrink`` the reference-parity ×0.95 raw shrink, up to 10 times.
+5. ``shrink`` the reference-parity ×0.95 raw shrink, up to 10 times.
 
 Everything is deterministic (no RNG anywhere — "jitter" is a fixed
 multiplicative inflation), so escalated runs replay bit-for-bit.  Arming is
@@ -47,6 +53,11 @@ from . import taxonomy as tax
 
 #: eigenvalue floor for the sqrt rung's PSD projection (see ops/sqrt_kf.py)
 SQRT_RESCUE_FLOOR = 1e-10
+#: panel length at/above which the assoc rung runs: below it the sequential
+#: sqrt rung is cheap and strictly more robust (per-step factorization);
+#: above it the O(log T) stabilized tree is the rescue that answers while a
+#: 10k-step sequential re-evaluation is still walking
+ASSOC_RESCUE_MIN_T = 1024
 #: multiplicative Ω-Cholesky-diagonal inflation + σ² floor for the jitter rung
 JITTER_SCALE = 1.05
 JITTER_ABS = 1e-6
@@ -54,7 +65,7 @@ OBS_VAR_FLOOR = 1e-8
 #: reference parity: at most 10 ×0.95 shrinks (optimization.jl:173-184)
 SHRINK_TRIES = 10
 
-RUNGS = ("scan", "sqrt", "jitter", "shrink")
+RUNGS = ("scan", "assoc", "sqrt", "jitter", "shrink")
 
 
 def escalation_enabled() -> bool:
@@ -117,6 +128,36 @@ def _sqrt_rescue(spec, cons, data, start, end):
     return float(ll), int(code)
 
 
+@register_engine_cache
+@lru_cache(maxsize=64)
+def _jitted_assoc_rescue(spec):
+    """The assoc rung's jitted evaluator: the O(log T) associative-scan
+    engine with PSD-projected composed moments (ops/assoc_scan, the same
+    ``SQRT_RESCUE_FLOOR`` stabilization surface as the sqrt rung).  Keyed on
+    spec alone — jit retraces per data shape, so a T key would only
+    fragment the cache."""
+    import jax
+
+    from ..ops import assoc_scan
+
+    return jax.jit(lambda p, d, s, e: assoc_scan.get_loss_coded(
+        spec, p, d, s, e, psd_floor=SQRT_RESCUE_FLOOR))
+
+
+def _assoc_rescue_applies(spec, T: int) -> bool:
+    """Gate for the assoc rung: constant-measurement Kalman family (the
+    associative form needs a constant Z) on a long panel."""
+    return spec.has_constant_measurement and T >= ASSOC_RESCUE_MIN_T
+
+
+def _assoc_rescue(spec, cons, data, start, end):
+    import jax.numpy as jnp
+
+    runner = _jitted_assoc_rescue(spec)
+    ll, code = runner(cons, data, jnp.asarray(start), jnp.asarray(end))
+    return float(ll), int(code)
+
+
 def _jittered_raw(spec, raw):
     """The jitter rung's regularized point: constrained-space Ω-Cholesky
     diagonal inflation + observation-variance floor, mapped back to raw."""
@@ -172,7 +213,17 @@ def escalate(spec, data, raw, start=0, end=None,
         return LadderTrace(start_index, code0, tuple(rungs), True, "scan",
                            ll, "scan", None)
 
-    # rung 2 — square-root filter from PSD-projected moments (Kalman only)
+    # rung 2 — associative-scan engine with PSD-projected composed moments:
+    # long constant-Z panels only, where re-walking the panel sequentially
+    # is exactly the latency the O(log T) tree exists to avoid
+    if _assoc_rescue_applies(spec, T):
+        ll, code = _assoc_rescue(spec, cons_of(raw), data, start, end)
+        rungs.append(RungResult("assoc", ll, code))
+        if np.isfinite(ll):
+            return LadderTrace(start_index, code0, tuple(rungs), True,
+                               "assoc", ll, "assoc", None)
+
+    # rung 3 — square-root filter from PSD-projected moments (Kalman only)
     if spec.is_kalman:
         ll, code = _sqrt_rescue(spec, cons_of(raw), data, start, end)
         rungs.append(RungResult("sqrt", ll, code))
@@ -180,7 +231,7 @@ def escalate(spec, data, raw, start=0, end=None,
             return LadderTrace(start_index, code0, tuple(rungs), True,
                                "sqrt", ll, "sqrt", None)
 
-    # rung 3 — jittered covariance regularization (Kalman only: the knobs
+    # rung 4 — jittered covariance regularization (Kalman only: the knobs
     # are the Ω Cholesky diagonal and σ²)
     if spec.is_kalman and "chol" in spec.layout:
         raw_j = _jittered_raw(spec, raw)
@@ -190,7 +241,7 @@ def escalate(spec, data, raw, start=0, end=None,
             return LadderTrace(start_index, code0, tuple(rungs), True,
                                "jitter", ll, "scan", raw_j)
 
-    # rung 4 — reference-parity ×0.95 shrink (optimization.jl:173-184)
+    # rung 5 — reference-parity ×0.95 shrink (optimization.jl:173-184)
     r = raw.copy()
     for _ in range(SHRINK_TRIES):
         r = r * 0.95

@@ -289,6 +289,37 @@ def _jitted_update_k(spec: ModelSpec, engine: str, kb: int):
 
 
 @register_engine_cache
+@lru_cache(maxsize=32)
+def _jitted_refilter(spec: ModelSpec, T: int):
+    """Re-filter-from-scratch program (docs/DESIGN.md §13): the O(log T)-span
+    associative-scan filter (ops/assoc_scan.filter_and_loss) over a full
+    (N, T) history → the final filtered (β, P), the total loglik, and the
+    ok/taxonomy pair.  This is the exact rebuild that replaces "trust k
+    accumulated O(1) updates": one program, constant-measurement Kalman
+    families only (the associative form needs a constant Z — validated at
+    the driver, serving/service.py).  Sentinel discipline as everywhere:
+    a failed pass NaN-poisons the returned state and lowers ``ok``; the
+    driver decodes ``code`` into the structured error."""
+
+    def refit(params, data):
+        note_trace("refilter")
+        from ..ops import assoc_scan
+
+        m, P, ll, code = assoc_scan.filter_and_loss(spec, params, data, 0, T)
+        beta = m[-1]
+        cov = 0.5 * (P[-1] + P[-1].T)
+        ok = jnp.all(jnp.isfinite(beta)) & jnp.all(jnp.isfinite(cov)) \
+            & (code == 0)
+        nan = jnp.asarray(jnp.nan, dtype=beta.dtype)
+        beta = jnp.where(ok, beta, nan)
+        cov = jnp.where(ok, cov, nan)
+        code = code | tax.bit(~ok, tax.NAN_STATE)
+        return beta, cov, ll, ok, code
+
+    return jax.jit(refit)
+
+
+@register_engine_cache
 @lru_cache(maxsize=64)
 def _jitted_scenarios(spec: ModelSpec, horizon: int, n: int):
     """n sampled h-step yield paths from the filtered state: (params, β, P,

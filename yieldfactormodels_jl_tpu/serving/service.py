@@ -7,7 +7,10 @@ Wraps one :class:`~.snapshot.ServingSnapshot` with the three serving verbs:
 - ``forecast(h, quantiles)`` h-step predictive densities through the
   shape-bucketed micro-batcher (ops/forecast.py's density recursion),
 - ``scenarios(n, h, seed)``  n sampled paths from the predictive
-  distribution (models/simulate.py seeded at the filtered state).
+  distribution (models/simulate.py seeded at the filtered state),
+- ``refilter(history)``      EXACT rebuild of the state from raw history via
+  the O(log T) associative-scan filter (ops/assoc_scan; docs/DESIGN.md §13)
+  — the freshness escape hatch after thousands of accumulated O(1) updates.
 
 Driver-layer responsibilities (CLAUDE.md conventions): the jitted kernels
 only emit sentinels (NaN state / −Inf ll) plus a taxonomy bitmask
@@ -44,7 +47,8 @@ from ..robustness import taxonomy as tax
 from ..utils.profiling import StageTimer
 from .batcher import (BucketLattice, ForecastRequest, MicroBatcher,
                       ScenarioRequest)
-from .online import OnlineState, _check_engine, _jitted_update, update_k
+from .online import (OnlineState, _check_engine, _jitted_refilter,
+                     _jitted_update, update_k)
 from .snapshot import ServingError, ServingSnapshot, SnapshotRegistry
 
 
@@ -327,6 +331,83 @@ class YieldCurveService:
         self.last_update = date
         self._maybe_refresh(int(Y.shape[1]))  # k accepted steps count too
         return np.asarray(lls)
+
+    def refilter(self, history, date=None) -> float:
+        """Rebuild the serving state EXACTLY from raw history — the O(log T)
+        associative-scan re-filter (docs/DESIGN.md §13; ops/assoc_scan).
+
+        ``history`` is the full (N, T) conditioning panel: the columns the
+        snapshot was frozen on followed by every curve fed through
+        ``update``/``update_many`` since.  One parallel-in-time program
+        replaces "trust k accumulated O(1) recursive updates" with the exact
+        filtered posterior — the freshness escape hatch for long-lived
+        services (drift from thousands of f32 rank-1 downdates) and the
+        strongest form of the §11 self-healing ladder's rebuild.
+
+        Semantics notes: whole columns with any NaN are treated as unobserved
+        (pure prediction steps — the OFFLINE filter convention), unlike the
+        per-element masking of the online ``update`` path; feed fully-quoted
+        history for bit-tight agreement.  Constant-measurement Kalman
+        families only (DNS/AFNS — the associative form needs a constant Z).
+
+        On success the rebuilt state becomes the new last-good snapshot
+        (version bumped, refresh cadence reset — an exact rebuild is the
+        strongest refresh) and the total history loglik is returned.  On a
+        failed pass or a rebuilt state that fails the §11 health watch, the
+        current state is KEPT and the standard degrade path runs (structured
+        :class:`ServingError`, or stale-flag + NaN under ``self_heal``).
+        """
+        spec = self.snapshot.spec
+        if not spec.has_constant_measurement:
+            raise ServingError(
+                "refilter", f"re-filter needs a constant-measurement Kalman "
+                f"family (the associative-scan engine); "
+                f"{spec.family!r} is not one", model=spec.model_string)
+        Y = jnp.asarray(history, dtype=spec.dtype)
+        if Y.ndim != 2 or Y.shape[0] != spec.N:
+            raise ServingError(
+                "refilter", f"history has shape {tuple(Y.shape)}, expected "
+                f"({spec.N}, T)", date=date)
+        with self.timer.stage("refilter"):
+            runner = _jitted_refilter(spec, int(Y.shape[1]))
+            b, c, ll, ok, code = runner(self.snapshot.params, Y)
+            ok = bool(ok)  # device sync: the driver decides, not the kernel
+            code = int(code)
+        if not ok:
+            self._degrade(
+                "refilter", code,
+                f"re-filter pass failed ({tax.describe(code)}) — state kept "
+                f"at the last good version",
+                date=date, version=self.version)
+            return float("nan")
+        h = rh.state_health(b, c, "univariate")  # (β, P) moments form
+        if h["code"] != tax.OK:
+            self._degrade(
+                "refilter", h["code"],
+                f"rebuilt state failed the health watch "
+                f"({tax.describe(h['code'])}) — state kept",
+                date=date, version=self.version)
+            return float("nan")
+        snap = self.snapshot.advanced(b, c)
+        prev = (self.snapshot, self._state)
+        try:
+            self._set_snapshot(snap)  # sqrt engine re-factors P here
+        except ServingError:
+            # _set_snapshot assigns self.snapshot before factoring — restore
+            # the consistent (snapshot, state) pair before degrading
+            self.snapshot, self._state = prev
+            self._degrade("refilter", tax.NONPSD_COV,
+                          "rebuilt covariance is not PSD under the serving "
+                          "engine's factorization — state kept",
+                          date=date, version=self.version)
+            return float("nan")
+        self._last_good = (self.snapshot, self._state)
+        self.stale = False
+        self._last_code = code
+        if date is not None:
+            self.last_update = date
+        self._updates_since_refresh = 0
+        return float(ll)
 
     def forecast(self, h: int, quantiles: Optional[Tuple[float, ...]] = None
                  ) -> dict:
