@@ -460,6 +460,112 @@ def main():
         except Exception as e:  # never kill the bench line
             longt_ctx = f"; longt bench failed ({type(e).__name__}: {e})"
 
+    # ---- fused scenario lattice (opt-in: BENCH_SCEN=1) ----
+    # ROADMAP item 4 / docs/DESIGN.md §14: the (resample × λ) bootstrap
+    # plane, the SV particle-filter draw sweep, and the six-shock stress fan
+    # — BASELINE configs 5 and 3 plus the serving fan — as ONE donated,
+    # compile-once program, head-to-head against the SUM of the separate
+    # drivers' walls on the same backend (all warm; the drivers pay their
+    # own index generation / transfer / stat dispatch rounds and one launch
+    # per shock, which is exactly what fusion deletes — on the TPU relay
+    # every extra launch also pays the network round-trip).  p50 of
+    # BENCH_SCEN_REPS walls; a second figure isolates the fan ratio.
+    scen_ctx = ""
+    if os.environ.get("BENCH_SCEN", "0") not in ("0", ""):
+        try:
+            from tests.oracle import stable_ns_params
+            from yieldfactormodels_jl_tpu.estimation import scenario as _scen
+            from yieldfactormodels_jl_tpu.estimation.bootstrap import (
+                bootstrap_lambda_grid)
+            from yieldfactormodels_jl_tpu.parallel.mesh import (
+                particle_filter_sharded)
+
+            R = int(os.environ.get("BENCH_SCEN_R", "256"))
+            G = int(os.environ.get("BENCH_SCEN_G", "16"))
+            D = int(os.environ.get("BENCH_SCEN_D", "8"))
+            PN = int(os.environ.get("BENCH_SCEN_PARTICLES", "128"))
+            sreps = int(os.environ.get("BENCH_SCEN_REPS", "5"))
+            nspec, _ = create_model("NS", tuple(MATURITIES),
+                                    float_type="float32")
+            ns_p = stable_ns_params(nspec)
+            grid = np.linspace(0.15, 1.0, G)
+            kdraws = _common.stationary_draws(spec, np.asarray(dev_batch[0]),
+                                              D, scale=0.02)
+            skey = jax.random.PRNGKey(0)
+            fan_shocks = _scen.standard_fan(spec)
+            fh, fn_ = 12, 32
+
+            def run_lat(prev):
+                # the config-3 + config-5 union ONLY — the acceptance
+                # comparison; the fan is isolated below (in-module it
+                # schedules worse on XLA:CPU than its standalone program,
+                # so folding it in would blur the config-3/5 head-to-head)
+                return _scen.evaluate_lattice(
+                    dev_data, static_spec=nspec, static_params=ns_p,
+                    lambda_grid=grid, n_resamples=R, kalman_spec=spec,
+                    kalman_params=dev_batch[0],
+                    sv_draws=(prev["sv_draws"] if prev else kdraws),
+                    n_particles=PN, key=skey, recycle=prev)
+
+            # the separate drivers: config-5, config-3, and one launch per
+            # shock (serving's historical fan)
+            from yieldfactormodels_jl_tpu.ops.smoother import forward_moments
+            _, mouts = forward_moments(spec, dev_batch[0], dev_data, 0,
+                                       dev_data.shape[1], "univariate")
+            fb, fP = mouts["beta_upd"][-1], mouts["P_upd"][-1]
+
+            def run_boot():
+                return jax.block_until_ready(bootstrap_lambda_grid(
+                    nspec, ns_p, dev_data, grid, n_resamples=R, key=skey))
+
+            def run_pf():
+                return jax.block_until_ready(particle_filter_sharded(
+                    spec, kdraws, dev_data, n_particles=PN))
+
+            def run_fan_per_shock():
+                return [jax.block_until_ready(_scen.stress_fan(
+                    spec, dev_batch[0], fb, fP, (s,), fh, fn_, key=skey))
+                    for s in fan_shocks]
+
+            def one_fan():
+                return jax.block_until_ready(_scen.stress_fan(
+                    spec, dev_batch[0], fb, fP, fan_shocks, fh, fn_,
+                    key=skey))
+
+            # warm/compile everything, then INTERLEAVE fused and driver reps
+            # so background contention on this 1-core box drifts into both
+            # sides equally (CLAUDE.md: pinned measurements contend)
+            sout = jax.block_until_ready(run_lat(None))
+            run_boot(), run_pf(), run_fan_per_shock(), one_fan()
+            walls, wb, wp, wfS, wf1 = [], [], [], [], []
+            for _ in range(sreps):
+                t0 = time.perf_counter()
+                sout = jax.block_until_ready(run_lat(sout))
+                walls.append(time.perf_counter() - t0)
+                t0 = time.perf_counter(); run_boot()
+                wb.append(time.perf_counter() - t0)
+                t0 = time.perf_counter(); run_pf()
+                wp.append(time.perf_counter() - t0)
+                t0 = time.perf_counter(); run_fan_per_shock()
+                wfS.append(time.perf_counter() - t0)
+                t0 = time.perf_counter(); one_fan()
+                wf1.append(time.perf_counter() - t0)
+            w_fused = float(np.median(walls))
+            w_boot, w_pf = float(np.median(wb)), float(np.median(wp))
+            w_fanS, w_fan1 = float(np.median(wfS)), float(np.median(wf1))
+            cells = R * G + D
+            ratio = (w_boot + w_pf) / w_fused
+            scen_ctx = (
+                f"; scenario-lattice[R={R} G={G} D={D}x{PN}p]: fused "
+                f"{w_fused * 1e3:.0f} ms p50 ({cells / w_fused:.0f} cells/s)"
+                f" vs config-5+3 drivers {w_boot * 1e3:.0f}+"
+                f"{w_pf * 1e3:.0f} ms -> {ratio:.2f}x; stress-fan[S="
+                f"{len(fan_shocks)} h={fh} n={fn_}]: one-launch "
+                f"{w_fan1 * 1e3:.1f} ms vs per-shock {w_fanS * 1e3:.1f} ms "
+                f"-> {w_fanS / w_fan1:.2f}x")
+        except Exception as e:  # never kill the bench line
+            scen_ctx = f"; scen bench failed ({type(e).__name__}: {e})"
+
     # ---- robustness microbenchmark (opt-in: BENCH_ROBUST=1) ----
     # (a) healthy-path cost of the failure-taxonomy channel: the same jitted
     # batch evaluated through get_loss vs get_loss_coded — the codes ride
@@ -559,7 +665,7 @@ def main():
           f"| pallas {pallas_rate} evals/s; kernels agree: joint={agree} "
           f"pallas={pallas_agree}; finite: {n_finite}/{BATCH}; "
           f"cpu ll sample {ll_cpu:.2f}{grad_ctx}{ssd_ctx}{serving_ctx}"
-          f"{load_ctx}{orch_ctx}{longt_ctx}{robust_ctx}; "
+          f"{load_ctx}{orch_ctx}{longt_ctx}{scen_ctx}{robust_ctx}; "
           f"roofline: {flops_per_eval/1e6:.3f} MFLOP/eval -> "
           f"univariate {gflops(dev_evals_per_sec):.1f} | "
           f"joint {gflops(BATCH / t_joint):.1f} | "

@@ -311,6 +311,91 @@ def unit_longt_pass(T=20000):
                   f"ll={ll:.1f}")
 
 
+def naive_scenario_fan(R=256, G=16, D=8, Pn=128, S=6, h=12, n_paths=32,
+                       block_len=12):
+    """Scenario-lattice wall (the ``BENCH_SCEN`` dual-ratio denominator): a
+    reference-equivalent 1-thread loop over the SAME cells the fused lattice
+    evaluates at its bench defaults — R×G static re-OLS bootstrap passes,
+    D SV particle-filter draws of ``Pn`` particles, and an S-shock stress
+    fan (h-step density recursion + ``n_paths`` sampled paths per shock),
+    all per-step NumPy loops over one AFNS5-shaped panel."""
+    from yieldfactormodels_jl_tpu import create_model
+
+    nspec, _ = create_model("NS", tuple(common.MATURITIES),
+                            float_type="float32")
+    aspec, _ = create_model("AFNS5", tuple(common.MATURITIES),
+                            float_type="float32")
+    data = np.asarray(common.afns5_panel(), dtype=np.float64)
+    N, T = data.shape
+    rng = np.random.default_rng(0)
+    t0 = time.perf_counter()
+
+    # --- bootstrap face: R×G per-step re-OLS static passes ---------------
+    grid = np.linspace(0.15, 1.0, G)
+    delta3 = np.array([0.08, -0.06, 0.03])
+    Phi3 = np.diag([0.9, 0.9, 0.9])
+    Zs = [oracle.dns_loadings(math.log(lam - 1e-2),
+                              np.asarray(common.MATURITIES)) for lam in grid]
+    n_blocks = -(-T // block_len)
+    for r in range(R):
+        starts = rng.integers(0, T - block_len + 1, n_blocks)
+        idx = (starts[:, None] + np.arange(block_len)[None, :]).reshape(-1)[:T]
+        resampled = data[:, idx]
+        for g in range(G):
+            preds = oracle.static_filter(Zs[g], delta3, Phi3, resampled)
+            v = resampled[:, 1:] - preds[:, :-1]
+            _ = -np.sum(v * v) / N / T
+
+    # --- SV-draw face: D particle filters of Pn particles ----------------
+    draws = common.stationary_draws(aspec, common.afns5_params(aspec), D,
+                                    scale=0.02)
+    tensors = _afns5_tensors(aspec, draws)
+    for tt in tensors:
+        _naive_pf_one_draw(rng, *tt[:7], float(tt[7]), data, Pn)
+
+    # --- shock fan: filter to the origin once, then S densities + paths --
+    (tt,) = _afns5_tensors(aspec, [common.afns5_params(aspec)])
+    Z, d, Phi, delta, cholOm, beta, S0, obs_var = tt
+    Ms = Phi.shape[0]
+    Om = cholOm @ cholOm.T
+    P = S0 @ S0.T
+    for t in range(T):  # per-step filtered moments (joint form)
+        y = data[:, t]
+        F = Z @ P @ Z.T + obs_var * np.eye(N)
+        K = P @ Z.T @ np.linalg.inv(F)
+        beta = beta + K @ (y - d - Z @ beta)
+        P = (np.eye(Ms) - K @ Z) @ P
+        if t < T - 1:
+            beta = delta + Phi @ beta
+            P = Phi @ P @ Phi.T + Om
+    # the standard_fan pattern (baseline, parallel +/-, twist +/-, vol x2),
+    # cycled for any S
+    fan_cells = [(0, 0.0, 1.0), (0, .5, 1.0), (0, -.5, 1.0),
+                 (1, .5, 1.0), (1, -.5, 1.0), (0, 0.0, 2.0)]
+    shifts = np.zeros((S, Ms))
+    vols = np.ones(S)
+    for s in range(S):
+        f, v, sc = fan_cells[s % len(fan_cells)]
+        shifts[s, f] = v
+        vols[s] = sc
+    for s in range(S):
+        b, Pm = beta + shifts[s], P * vols[s] ** 2
+        for _k in range(h):  # analytic density recursion
+            b = delta + Phi @ b
+            Pm = Phi @ Pm @ Phi.T + Om
+            _ = Z @ Pm @ Z.T + obs_var * np.eye(N)
+        for _p in range(n_paths):  # sampled paths, per-step loops
+            bp = beta + shifts[s] + np.linalg.cholesky(
+                Pm + 1e-9 * np.eye(Ms)) @ rng.standard_normal(Ms)
+            for _k in range(h):
+                bp = delta + Phi @ bp + cholOm @ rng.standard_normal(Ms)
+                _ = Z @ bp + d + math.sqrt(obs_var) * rng.standard_normal(N)
+
+    wall = time.perf_counter() - t0
+    return wall, (f"{R}x{G} re-OLS passes + {D} PF draws x {Pn} particles + "
+                  f"{S}-shock fan (h={h}, {n_paths} paths)")
+
+
 def unit_ssd_nns_pass():
     """Measured seconds per naive score-driven-neural filter pass (config-6
     lower-bound unit): tests/oracle.msed_neural_filter — per-step loop with
@@ -343,6 +428,7 @@ RUNNERS = {
     "unit-afns5-pass": unit_afns5_pass,
     "unit-longt-pass": unit_longt_pass,
     "unit-ssd-pass": unit_ssd_nns_pass,
+    "scenario-fan": naive_scenario_fan,
 }
 
 

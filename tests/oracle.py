@@ -814,3 +814,31 @@ def rbpf_loglik(Z, Phi, delta, Omega_state, obs_var, data, normals, uniforms,
                 x, Pc, h = x[:, idx], Pc[:, :, idx], h[idx]
                 logw = np.full(Pn, -np.log(Pn))
     return total
+
+
+def gaussian_log_score(mean, cov, y):
+    """Multivariate Gaussian log density log N(y; mean, cov) by the direct
+    textbook formula (explicit inverse + slogdet — a DIFFERENT algebraic
+    route than the library's Cholesky-whitened form, so agreement checks the
+    density, not a transliteration).  Oracle for
+    ``utils/evaluation.log_predictive_score``; one point per call."""
+    mean = np.asarray(mean, dtype=np.float64)
+    cov = np.asarray(cov, dtype=np.float64)
+    y = np.asarray(y, dtype=np.float64)
+    N = mean.shape[0]
+    v = y - mean
+    sign, logdet = np.linalg.slogdet(cov)
+    if sign <= 0:
+        return float("nan")
+    return float(-0.5 * (N * LOG_2PI + logdet + v @ np.linalg.inv(cov) @ v))
+
+
+def crps_sample_naive(samples, y):
+    """Ensemble CRPS by the defining double loop (Gneiting & Raftery 2007,
+    eq. 20): mean |x_i - y| - (1/2m^2) sum_ij |x_i - x_j|.  Oracle for
+    ``utils/evaluation.crps_sample``; 1-D draws per call."""
+    x = np.asarray(samples, dtype=np.float64)
+    m = x.shape[0]
+    t1 = np.mean([abs(xi - y) for xi in x])
+    t2 = sum(abs(xi - xj) for xi in x for xj in x) / (2.0 * m * m)
+    return float(t1 - t2)

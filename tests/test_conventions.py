@@ -42,6 +42,9 @@ def _kernel_files():
         if name.endswith(".py"):
             yield os.path.join(opsdir, name)
     yield os.path.join(PKG, "serving", "online.py")
+    # the fused scenario-lattice module (DESIGN §14): its programs must stay
+    # sentinel-coded (−Inf cells / NaN fan) like every other kernel
+    yield os.path.join(PKG, "estimation", "scenario.py")
 
 
 def _func_depth(node, parents):
@@ -100,7 +103,7 @@ def test_guard_is_not_vacuous():
     (a rotted path would green-light everything)."""
     names = {os.path.basename(p) for p in _kernel_files()}
     assert {"univariate_kf.py", "sqrt_kf.py", "particle.py", "smoother.py",
-            "online.py"} <= names
+            "online.py", "scenario.py"} <= names
 
 
 # ---------------------------------------------------------------------------

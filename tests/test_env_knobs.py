@@ -1,13 +1,14 @@
-"""Guard: every ``YFM_*`` engine env knob referenced anywhere in source is
-documented in CLAUDE.md's Conventions (an undocumented knob is a silent
-behavior switch the next session can't discover) — grep-based, fails loudly
-on the first undocumented name."""
+"""Guard: every ``YFM_*`` engine env knob referenced anywhere in source —
+and every ``BENCH_*`` knob ``bench.py`` reads — is documented in CLAUDE.md
+(an undocumented knob is a silent behavior switch the next session can't
+discover) — grep-based, fails loudly on the first undocumented name."""
 
 import os
 import re
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 KNOB = re.compile(r"\bYFM_[A-Z0-9_]+\b")
+BENCH_KNOB = re.compile(r"\bBENCH_[A-Z0-9_]+\b")
 
 
 def _source_files():
@@ -38,3 +39,22 @@ def test_every_yfm_knob_is_documented_in_claude_md():
     assert not undocumented, (
         f"undocumented YFM_* env knobs: {undocumented} — add them to the "
         f"'Engine env knobs' bullet in CLAUDE.md's Conventions")
+
+
+def test_every_bench_knob_read_by_bench_py_is_documented_in_claude_md():
+    """The same guard the YFM_* knobs carry, extended to bench.py's BENCH_*
+    switches: every knob the headline bench reads must be discoverable in
+    CLAUDE.md — an opt-in bench section nobody can find is a bench section
+    nobody runs."""
+    with open(os.path.join(ROOT, "bench.py")) as fh:
+        knobs = set(BENCH_KNOB.findall(fh.read()))
+    # vacuity guard: the opt-in sections this repo is known to ship
+    assert {"BENCH_SERVING", "BENCH_ORCH", "BENCH_LOAD", "BENCH_LONGT",
+            "BENCH_ROBUST", "BENCH_SCEN"} <= knobs, \
+        f"grep drifted: found only {sorted(knobs)}"
+    with open(os.path.join(ROOT, "CLAUDE.md")) as fh:
+        doc = fh.read()
+    undocumented = sorted(k for k in knobs if k not in doc)
+    assert not undocumented, (
+        f"undocumented BENCH_* env knobs: {undocumented} — add them to the "
+        f"Benchmarks bullet in CLAUDE.md's Commands")

@@ -127,3 +127,76 @@ def test_crps_properties_and_density_pipeline():
     s = np.sqrt(np.diagonal(np.asarray(fd["covs"]), axis1=1, axis2=2))
     scores = crps_gaussian(m, s, data[:, 50:53].T)
     assert scores.shape == (3, len(mats)) and np.isfinite(scores).all()
+
+
+def test_log_predictive_score_matches_oracle():
+    """Cholesky-whitened library form vs the oracle's explicit inv/slogdet
+    route, over random PSD covariances (CLAUDE.md oracle rule)."""
+    from tests.oracle import gaussian_log_score
+    from yieldfactormodels_jl_tpu.utils.evaluation import log_predictive_score
+
+    rng = np.random.default_rng(4)
+    N = 5
+    means = rng.normal(size=(3, 4, N))
+    A = rng.normal(size=(3, 4, N, N))
+    covs = A @ np.swapaxes(A, -1, -2) + 0.5 * np.eye(N)
+    ys = rng.normal(size=(3, 4, N))
+    got = log_predictive_score(means, covs, ys)
+    assert got.shape == (3, 4)
+    for i in range(3):
+        for j in range(4):
+            np.testing.assert_allclose(
+                got[i, j], gaussian_log_score(means[i, j], covs[i, j],
+                                              ys[i, j]), rtol=1e-10)
+
+
+def test_log_predictive_score_sentinels_and_sharpness():
+    """Non-PSD / non-finite inputs score NaN (never raise); the true density
+    outscores a biased and an overdispersed rival on average."""
+    from yieldfactormodels_jl_tpu.utils.evaluation import log_predictive_score
+
+    rng = np.random.default_rng(5)
+    N = 4
+    eye = np.eye(N)
+    assert np.isnan(log_predictive_score(np.zeros(N), -eye, np.zeros(N)))
+    assert np.isnan(log_predictive_score(np.full(N, np.nan), eye, np.zeros(N)))
+    assert np.isnan(log_predictive_score(np.zeros(N), eye,
+                                         np.full(N, np.nan)))
+    y = rng.normal(size=(500, N))
+    true = log_predictive_score(np.zeros(N), eye, y).mean()
+    biased = log_predictive_score(np.full(N, 1.5), eye, y).mean()
+    wide = log_predictive_score(np.zeros(N), 9.0 * eye, y).mean()
+    assert true > biased and true > wide  # higher is better
+
+
+def test_crps_sample_matches_oracle_and_closed_form():
+    """Ensemble CRPS: the sorted-spacings implementation equals the defining
+    double loop, and a large Gaussian ensemble converges to the closed-form
+    ``crps_gaussian``."""
+    from tests.oracle import crps_sample_naive
+    from yieldfactormodels_jl_tpu.utils.evaluation import (crps_gaussian,
+                                                           crps_sample)
+
+    rng = np.random.default_rng(6)
+    for m in (1, 2, 7, 40):
+        x = rng.normal(size=m)
+        y = rng.normal()
+        np.testing.assert_allclose(float(crps_sample(x, y)),
+                                   crps_sample_naive(x, y), rtol=1e-12)
+    # broadcast shape: draws on the trailing (lane) axis, like fan paths
+    paths = rng.normal(size=(3, 5, 2, 64))
+    ys = rng.normal(size=(3, 5, 2))
+    got = crps_sample(paths, ys)
+    assert got.shape == (3, 5, 2)
+    np.testing.assert_allclose(got[1, 2, 0],
+                               crps_sample_naive(paths[1, 2, 0], ys[1, 2, 0]),
+                               rtol=1e-12)
+    # convergence to the Gaussian closed form
+    big = rng.normal(loc=0.3, scale=1.7, size=20000)
+    approx = float(crps_sample(big, 0.8))
+    exact = float(crps_gaussian(0.3, 1.7, 0.8))
+    np.testing.assert_allclose(approx, exact, rtol=2e-2)
+    # NaN draws propagate
+    bad = big.copy()
+    bad[3] = np.nan
+    assert np.isnan(crps_sample(bad, 0.0))

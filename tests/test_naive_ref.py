@@ -29,6 +29,10 @@ def test_units_and_tiny_configs_run():
     assert w > 0
     w, d = naive_ref.naive_afns5_sv_pf(n_draws=1, n_particles=20)
     assert w > 0 and "finite 1/1" in d
+    # the BENCH_SCEN dual-ratio denominator stays runnable at a tiny lattice
+    w, d = naive_ref.naive_scenario_fan(R=2, G=2, D=1, Pn=8, S=2, h=2,
+                                        n_paths=2)
+    assert w > 0 and "fan" in d
 
 
 def test_naive_pf_collapses_to_kalman_loglik():

@@ -1,0 +1,550 @@
+"""Fused scenario lattice (estimation/scenario.py, docs/DESIGN.md §14):
+parity against the separate drivers it fuses, donation invariants
+(bit-identical results, consumed buffers, no recompiles, no
+buffer-not-donated warnings), degenerate/NaN-gapped configurations, the
+8-virtual-device mesh entry, and the serving stress fan + donated online
+update regressions."""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests import oracle
+from yieldfactormodels_jl_tpu import create_model, serving
+from yieldfactormodels_jl_tpu.estimation import scenario as sc
+
+MATS = (0.25, 0.5, 1.0, 2.0, 5.0, 10.0)
+T = 40
+
+
+@pytest.fixture
+def panel(rng):
+    return oracle.simulate_dns_panel(rng, np.asarray(MATS), T=T)
+
+
+@pytest.fixture
+def ns_setup():
+    spec, _ = create_model("NS", MATS, float_type="float64")
+    return spec, oracle.stable_ns_params(spec, dtype=np.float64)
+
+
+@pytest.fixture
+def k_setup():
+    spec, _ = create_model("1C", MATS, float_type="float64")
+    return spec, oracle.stable_1c_params(spec, dtype=np.float64)
+
+
+GRID = np.linspace(0.2, 0.9, 4)
+
+
+def _donation_warnings(w):
+    return [str(i.message) for i in w
+            if "donated" in str(i.message).lower()]
+
+
+# ---------------------------------------------------------------------------
+# parity vs the separate drivers (ISSUE acceptance: same losses as
+# bootstrap_lambda_grid, same PF logliks as estimate_sv's objective)
+# ---------------------------------------------------------------------------
+
+def test_lattice_matches_bootstrap_driver(panel, ns_setup):
+    """The bootstrap face seeded with ``key`` reproduces
+    ``bootstrap_lambda_grid(key=key)`` cell-for-cell: same index stream,
+    same fused-engine dispatch, same CI/selection stats."""
+    from yieldfactormodels_jl_tpu.estimation.bootstrap import (
+        bootstrap_lambda_grid, moving_block_indices)
+
+    spec, p = ns_setup
+    key = jax.random.PRNGKey(11)
+    out = sc.evaluate_lattice(panel, static_spec=spec, static_params=p,
+                              lambda_grid=GRID, n_resamples=6, key=key)
+    losses, lo, hi, freq = bootstrap_lambda_grid(spec, p, panel, GRID,
+                                                 n_resamples=6, key=key)
+    np.testing.assert_allclose(np.asarray(out["losses"]),
+                               np.asarray(losses), rtol=1e-9)
+    np.testing.assert_array_equal(
+        np.asarray(out["resample_idx"]),
+        np.asarray(moving_block_indices(key, T, 12, 6)))
+    np.testing.assert_allclose(np.asarray(out["ci_low"]), np.asarray(lo),
+                               rtol=1e-9)
+    np.testing.assert_allclose(np.asarray(out["ci_high"]), np.asarray(hi),
+                               rtol=1e-9)
+    np.testing.assert_allclose(np.asarray(out["selection_freq"]),
+                               np.asarray(freq), rtol=1e-12)
+
+
+def test_lattice_pf_parity_with_sv_objective(panel, k_setup):
+    """The SV-draw face returns exactly the common-random-numbers PF logliks
+    ``estimate_sv``'s objective evaluates at those parameter points, in its
+    streamed-noise CRN flavor: per-draw ``particle_filter_loglik`` on the
+    SAME shared noise pair (``draw_noise`` at the documented face key) —
+    float64, one noise realization across draws."""
+    from yieldfactormodels_jl_tpu.estimation.sv import pf_draw_logliks
+    from yieldfactormodels_jl_tpu.ops.particle import (draw_noise,
+                                                      particle_filter_loglik)
+
+    spec, p = k_setup
+    rng = np.random.default_rng(7)
+    draws = np.tile(p, (3, 1))
+    draws[1:, spec.layout["delta"][0]] += 0.1 * rng.standard_normal(2)
+    key = jax.random.PRNGKey(5)
+    out = sc.evaluate_lattice(panel, kalman_spec=spec, kalman_params=p,
+                              sv_draws=draws, n_particles=40, key=key)
+    pf_key = sc.face_keys(key)[1]
+    want_seam = np.asarray(pf_draw_logliks(spec, draws, panel, key=pf_key,
+                                           n_particles=40))
+    noise = draw_noise(T, 40, pf_key, jnp.float64)
+    want_direct = np.asarray([
+        particle_filter_loglik(spec, jnp.asarray(d), jnp.asarray(panel),
+                               noise=noise, n_particles=40)
+        for d in draws])
+    got = np.asarray(out["pf_logliks"])
+    np.testing.assert_allclose(got, want_seam, rtol=1e-12)
+    np.testing.assert_allclose(got, want_direct, rtol=1e-9)
+    assert np.isfinite(got).all()
+
+
+def test_lattice_fan_matches_forecast_density(panel, k_setup):
+    """The shock face's baseline cell equals ``api.forecast_density`` (same
+    filter, same density recursion); shifted cells move the mean paths the
+    way the shock says; the vol regime widens every predictive variance."""
+    from yieldfactormodels_jl_tpu.ops.forecast import forecast_density
+
+    spec, p = k_setup
+    shocks = sc.standard_fan(spec, shift=0.5)
+    out = sc.evaluate_lattice(panel, kalman_spec=spec, kalman_params=p,
+                              shocks=shocks, horizon=5)
+    fd = forecast_density(spec, p, panel, 5)
+    fan = out["fan"]
+    np.testing.assert_allclose(np.asarray(fan["means"])[0],
+                               np.asarray(fd["means"]), rtol=1e-10)
+    np.testing.assert_allclose(np.asarray(fan["covs"])[0],
+                               np.asarray(fd["covs"]), rtol=1e-10)
+    means = np.asarray(fan["means"])
+    assert (means[1] > means[0]).all() and (means[2] < means[0]).all()
+    base_var = np.diagonal(np.asarray(fan["covs"])[0], axis1=-2, axis2=-1)
+    vol_var = np.diagonal(np.asarray(fan["covs"])[5], axis1=-2, axis2=-1)
+    assert (vol_var > base_var).all()
+    # the filtered origin state is the forecast origin
+    assert np.isfinite(np.asarray(out["state_beta"])).all()
+
+
+def test_lattice_paths_calibrated_against_density(panel, k_setup):
+    """Sampled baseline paths agree with the analytic density face in
+    distribution (mean within MC error) — ties simulate(start_state=) to
+    density_from_state through one program."""
+    spec, p = k_setup
+    out = sc.evaluate_lattice(panel, kalman_spec=spec, kalman_params=p,
+                              shocks=(sc.ShockSpec("baseline"),),
+                              horizon=4, n_paths=256,
+                              key=jax.random.PRNGKey(2))
+    paths = np.asarray(out["fan"]["paths"])[0]        # (N, h, n)
+    means = np.asarray(out["fan"]["means"])[0]        # (h, N)
+    sds = np.sqrt(np.diagonal(np.asarray(out["fan"]["covs"])[0],
+                              axis1=-2, axis2=-1))    # (h, N)
+    mc_err = 4.0 * sds / np.sqrt(paths.shape[-1])
+    assert (np.abs(paths.mean(axis=-1).T - means) < mc_err + 1e-8).all()
+
+
+# ---------------------------------------------------------------------------
+# donation invariants
+# ---------------------------------------------------------------------------
+
+def test_lattice_donation_bit_identical_consumed_no_recompile(panel, ns_setup,
+                                                              k_setup):
+    """The §14 donation contract: donated and undonated programs agree
+    bit-for-bit; explicitly passed device buffers (index sets, draw batch)
+    and the recycled accumulator are CONSUMED; repeated recycled launches
+    never retrace; and no 'donated buffers were not usable' warning fires
+    anywhere on the lattice path."""
+    nspec, pn = ns_setup
+    kspec, pk = k_setup
+    from yieldfactormodels_jl_tpu.estimation.bootstrap import \
+        moving_block_indices
+
+    key = jax.random.PRNGKey(4)
+    draws_host = np.tile(pk, (3, 1))
+    idx_host = np.asarray(moving_block_indices(key, T, 12, 5))
+    kw = dict(static_spec=nspec, static_params=pn, lambda_grid=GRID,
+              kalman_spec=kspec, kalman_params=pk, n_particles=30, key=key)
+
+    plain = sc.evaluate_lattice(panel, resample_idx=idx_host,
+                                sv_draws=draws_host, donate=False, **kw)
+    sc.reset_trace_counts()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        idx_dev = jnp.asarray(idx_host)
+        draws_dev = jnp.asarray(draws_host, dtype=kspec.dtype)
+        out = sc.evaluate_lattice(panel, resample_idx=idx_dev,
+                                  sv_draws=draws_dev, **kw)
+        jax.block_until_ready(out)
+        # donated device inputs are consumed; their values rode out as the
+        # pass-through outputs
+        assert idx_dev.is_deleted() and draws_dev.is_deleted()
+        np.testing.assert_array_equal(np.asarray(out["resample_idx"]),
+                                      idx_host)
+        np.testing.assert_array_equal(np.asarray(out["sv_draws"]),
+                                      draws_host)
+        # bit-identical to the undonated program
+        np.testing.assert_array_equal(np.asarray(out["losses"]),
+                                      np.asarray(plain["losses"]))
+        np.testing.assert_array_equal(np.asarray(out["pf_logliks"]),
+                                      np.asarray(plain["pf_logliks"]))
+        # recycled launches: buffers consumed, results identical, no retrace
+        for _ in range(2):
+            prev = out
+            out = sc.evaluate_lattice(panel,
+                                      resample_idx=prev["resample_idx"],
+                                      sv_draws=prev["sv_draws"],
+                                      recycle=prev, **kw)
+            jax.block_until_ready(out)
+            assert prev["losses"].is_deleted()
+            assert prev["resample_idx"].is_deleted()
+            np.testing.assert_array_equal(np.asarray(out["losses"]),
+                                          np.asarray(plain["losses"]))
+        assert not _donation_warnings(w)
+    assert sc.trace_counts["lattice"] == 1, dict(sc.trace_counts)
+
+
+def test_lattice_recycle_rejects_stale_buffers(panel, ns_setup):
+    """A recycle dict whose buffers are consumed or shape-mismatched falls
+    back to fresh buffers instead of crashing — a recycle is an optimization,
+    never a correctness hazard."""
+    spec, p = ns_setup
+    key = jax.random.PRNGKey(9)
+    out = sc.evaluate_lattice(panel, static_spec=spec, static_params=p,
+                              lambda_grid=GRID, n_resamples=4, key=key)
+    out2 = sc.evaluate_lattice(panel, static_spec=spec, static_params=p,
+                               lambda_grid=GRID, n_resamples=4, key=key,
+                               recycle=out)
+    # out's accumulator was consumed by out2 — recycling OUT again must not
+    # blow up on the dead buffer (falls back to a fresh accumulator)
+    out3 = sc.evaluate_lattice(panel, static_spec=spec, static_params=p,
+                               lambda_grid=GRID, n_resamples=4, key=key,
+                               recycle=out)
+    np.testing.assert_array_equal(np.asarray(out3["losses"]),
+                                  np.asarray(out2["losses"]))
+    # shape-mismatched recycle (different R) → fresh buffers
+    out4 = sc.evaluate_lattice(panel, static_spec=spec, static_params=p,
+                               lambda_grid=GRID, n_resamples=6, key=key,
+                               recycle=out3)
+    assert np.asarray(out4["losses"]).shape == (6, len(GRID))
+
+
+def test_lattice_recycle_with_sentinel_cells_stays_exact(panel, ns_setup):
+    """Recycling a loss plane that carries −Inf sentinel cells must not
+    poison the next launch: the recycled accumulator zeroes through a
+    finiteness mask (a plain ``acc * 0`` would turn −Inf into NaN scan
+    carries and flush those cells to −Inf forever)."""
+    spec, p = ns_setup
+    bad = np.asarray(p, dtype=np.float64).copy()
+    a, _ = spec.layout["delta"]
+    bad[a] = 1e200  # overflowing level mean → every cell −Inf (sentinel)
+    key = jax.random.PRNGKey(31)
+    kw = dict(static_spec=spec, lambda_grid=GRID, n_resamples=4, key=key)
+    poisoned = sc.evaluate_lattice(panel, static_params=bad, **kw)
+    assert np.isneginf(np.asarray(poisoned["losses"])).all()
+    fresh = sc.evaluate_lattice(panel, static_params=p, donate=False, **kw)
+    recycled = sc.evaluate_lattice(panel, static_params=p, recycle=poisoned,
+                                   **kw)
+    np.testing.assert_array_equal(np.asarray(recycled["losses"]),
+                                  np.asarray(fresh["losses"]))
+    assert np.isfinite(np.asarray(recycled["losses"])).all()
+
+
+# ---------------------------------------------------------------------------
+# degenerate / gapped configurations
+# ---------------------------------------------------------------------------
+
+def test_degenerate_1x1x1_lattice(panel, ns_setup, k_setup):
+    """R = G = D = S = 1, one path: the same program shape as the full sweep,
+    every face present and finite."""
+    nspec, pn = ns_setup
+    kspec, pk = k_setup
+    out = sc.evaluate_lattice(
+        panel, static_spec=nspec, static_params=pn,
+        lambda_grid=GRID[:1], n_resamples=1,
+        kalman_spec=kspec, kalman_params=pk, sv_draws=pk[None, :],
+        n_particles=20, shocks=(sc.ShockSpec("baseline"),), horizon=1,
+        n_paths=1, key=jax.random.PRNGKey(0))
+    assert np.asarray(out["losses"]).shape == (1, 1)
+    assert np.asarray(out["pf_logliks"]).shape == (1,)
+    assert np.asarray(out["fan"]["paths"]).shape == (1, len(MATS), 1, 1)
+    assert np.isfinite(np.asarray(out["losses"])).all()
+    assert np.isfinite(np.asarray(out["pf_logliks"])).all()
+    assert np.isfinite(np.asarray(out["fan"]["paths"])).all()
+
+
+def test_lattice_nan_gap_panel_takes_scan_engine(panel, ns_setup):
+    """A NaN-gapped panel (whole missing columns — the offline convention)
+    auto-dispatches the bootstrap face to the general scan engine and
+    matches it exactly; the fused engine cannot be forced onto gaps."""
+    from yieldfactormodels_jl_tpu.estimation.bootstrap import (
+        _jitted_grid_loss, lambda_to_gamma, moving_block_indices)
+
+    spec, p = ns_setup
+    gapped = np.asarray(panel).copy()
+    gapped[:, 7] = np.nan
+    key = jax.random.PRNGKey(13)
+    out = sc.evaluate_lattice(gapped, static_spec=spec, static_params=p,
+                              lambda_grid=GRID, n_resamples=5, key=key)
+    idx = moving_block_indices(key, T, 12, 5)
+    want = _jitted_grid_loss(spec, T)(
+        lambda_to_gamma(jnp.asarray(GRID)), idx, jnp.asarray(p),
+        jnp.asarray(gapped))
+    np.testing.assert_allclose(np.asarray(out["losses"]), np.asarray(want),
+                               rtol=1e-12)
+    with pytest.raises(ValueError, match="fully-observed"):
+        sc.evaluate_lattice(gapped, static_spec=spec, static_params=p,
+                            lambda_grid=GRID, n_resamples=5,
+                            grid_engine="fused")
+
+
+def test_lattice_validation_is_loud(panel, ns_setup, k_setup):
+    nspec, pn = ns_setup
+    kspec, pk = k_setup
+    with pytest.raises(ValueError, match="empty lattice"):
+        sc.evaluate_lattice(panel)
+    with pytest.raises(ValueError, match="bootstrap face"):
+        sc.evaluate_lattice(panel, lambda_grid=GRID)
+    with pytest.raises(ValueError, match="n_resamples"):
+        sc.evaluate_lattice(panel, static_spec=nspec, static_params=pn,
+                            lambda_grid=GRID)
+    with pytest.raises(ValueError, match="kalman_spec"):
+        sc.evaluate_lattice(panel, sv_draws=pk[None, :])
+    with pytest.raises(ValueError, match="Kalman family"):
+        sc.evaluate_lattice(panel, kalman_spec=nspec, kalman_params=pn,
+                            shocks=(sc.ShockSpec("baseline"),))
+    with pytest.raises(ValueError, match="horizon"):
+        sc.evaluate_lattice(panel, kalman_spec=kspec, kalman_params=pk,
+                            shocks=(sc.ShockSpec("baseline"),), horizon=0)
+    with pytest.raises(ValueError, match="factors"):
+        sc.evaluate_lattice(panel, kalman_spec=kspec, kalman_params=pk,
+                            shocks=(sc.ShockSpec("bad", (1.0,) * 9),))
+
+
+def test_lattice_failed_filter_poisons_fan_not_losses(panel, ns_setup,
+                                                      k_setup):
+    """Sentinel discipline: invalid Kalman params NaN-poison the fan face
+    while the bootstrap face's cells stay finite — faces fail independently,
+    nothing raises inside the program."""
+    nspec, pn = ns_setup
+    kspec, pk = k_setup
+    bad = np.asarray(pk, dtype=np.float64).copy()
+    bad[kspec.layout["obs_var"][0]] = -1.0  # invalid variance → -Inf filter
+    out = sc.evaluate_lattice(panel, static_spec=nspec, static_params=pn,
+                              lambda_grid=GRID, n_resamples=4,
+                              kalman_spec=kspec, kalman_params=bad,
+                              shocks=(sc.ShockSpec("baseline"),), horizon=3)
+    assert np.isfinite(np.asarray(out["losses"])).all()
+    assert np.isnan(np.asarray(out["fan"]["means"])).all()
+    assert np.isnan(np.asarray(out["state_beta"])).all()
+
+
+# ---------------------------------------------------------------------------
+# mesh-sharded entry (8 virtual devices, conftest)
+# ---------------------------------------------------------------------------
+
+def test_sharded_lattice_dry_run_matches_serial(panel, ns_setup, k_setup):
+    """R = 13 and D = 5 (neither a multiple of 8) ride the mesh padded and
+    trimmed; every face matches the serial lattice, stats are computed on
+    trimmed losses only, and the donation path stays warning-free under
+    sharding."""
+    from yieldfactormodels_jl_tpu.parallel import mesh as pmesh
+
+    nspec, pn = ns_setup
+    kspec, pk = k_setup
+    key = jax.random.PRNGKey(21)
+    draws = np.tile(pk, (5, 1))
+    serial = sc.evaluate_lattice(panel, static_spec=nspec, static_params=pn,
+                                 lambda_grid=GRID, n_resamples=13,
+                                 kalman_spec=kspec, kalman_params=pk,
+                                 sv_draws=draws, n_particles=30,
+                                 shocks=(sc.ShockSpec("baseline"),),
+                                 horizon=3, key=key, donate=False)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        sharded = pmesh.scenario_lattice_sharded(
+            panel, static_spec=nspec, static_params=pn, lambda_grid=GRID,
+            n_resamples=13, kalman_spec=kspec, kalman_params=pk,
+            sv_draws=draws, n_particles=30,
+            shocks=(sc.ShockSpec("baseline"),), horizon=3, key=key)
+        jax.block_until_ready(sharded)
+        assert not _donation_warnings(w)
+    np.testing.assert_allclose(np.asarray(sharded["losses"]),
+                               np.asarray(serial["losses"]), rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(sharded["ci_low"]),
+                               np.asarray(serial["ci_low"]), rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(sharded["pf_logliks"]),
+                               np.asarray(serial["pf_logliks"]), rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(sharded["fan"]["means"]),
+                               np.asarray(serial["fan"]["means"]),
+                               rtol=1e-10)
+    assert np.asarray(sharded["losses"]).shape == (13, len(GRID))
+    assert np.asarray(sharded["pf_logliks"]).shape == (5,)
+
+
+def test_sharded_lattice_nan_gap_dry_run(panel, ns_setup):
+    """NaN-gapped panel on the 8-device mesh: the scan engine runs sharded
+    and matches the serial scan engine."""
+    from yieldfactormodels_jl_tpu.parallel import mesh as pmesh
+
+    spec, p = ns_setup
+    gapped = np.asarray(panel).copy()
+    gapped[:, 11] = np.nan
+    key = jax.random.PRNGKey(23)
+    serial = sc.evaluate_lattice(gapped, static_spec=spec, static_params=p,
+                                 lambda_grid=GRID, n_resamples=5, key=key,
+                                 donate=False)
+    sharded = pmesh.scenario_lattice_sharded(
+        gapped, static_spec=spec, static_params=p, lambda_grid=GRID,
+        n_resamples=5, key=key)
+    np.testing.assert_allclose(np.asarray(sharded["losses"]),
+                               np.asarray(serial["losses"]), rtol=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# mesh donation on the existing hot entries
+# ---------------------------------------------------------------------------
+
+def test_sharded_batch_loss_donation_bit_identical_no_recompile(panel):
+    """parallel/mesh._sharded_batch_loss donates the params batch: repeated
+    sweeps give bit-identical losses with ONE trace, and the public wrapper
+    never exposes a consumed buffer (host batches in, fresh results out)."""
+    from yieldfactormodels_jl_tpu.parallel import mesh as pmesh
+
+    spec, _ = create_model("1C", MATS, float_type="float64")
+    p = oracle.stable_1c_params(spec, dtype=np.float64)
+    batch = np.tile(p, (16, 1))
+    batch[:, spec.layout["delta"][0]] += np.linspace(0, 0.05, 16)
+    pmesh.reset_trace_counts()
+    first = np.asarray(pmesh.batch_loss_sharded(spec, batch, panel))
+    second = np.asarray(pmesh.batch_loss_sharded(spec, batch, panel))
+    np.testing.assert_array_equal(first, second)
+    assert np.isfinite(first).all()
+    assert pmesh.trace_counts["batch_loss"] == 1, dict(pmesh.trace_counts)
+    # the donated program consumes the padded device batch it was handed
+    # (placed with the program's sharding — a mismatched layout would be
+    # resharded into a fresh buffer and THAT copy donated instead)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = pmesh.make_mesh()
+    fn = pmesh._sharded_batch_loss(spec, T, mesh, "batch")
+    dev_batch = jax.device_put(jnp.asarray(batch, dtype=spec.dtype),
+                               NamedSharding(mesh, P("batch", None)))
+    lls, alias = fn(dev_batch, jnp.asarray(panel, dtype=spec.dtype),
+                    jnp.asarray(0), jnp.asarray(T))
+    jax.block_until_ready((lls, alias))
+    assert dev_batch.is_deleted()
+    np.testing.assert_array_equal(np.asarray(alias), batch)
+
+
+def test_sharded_multistart_donation_bit_identical_no_recompile(panel):
+    """parallel/mesh._sharded_multistart donates the start buffer (the
+    converged xs reuse its memory): same results across repeated calls, one
+    trace, improved losses."""
+    from yieldfactormodels_jl_tpu.parallel import mesh as pmesh
+
+    spec, _ = create_model("1C", MATS, float_type="float64")
+    from yieldfactormodels_jl_tpu.models.params import untransform_params
+
+    p = oracle.stable_1c_params(spec, dtype=np.float64)
+    raw = np.asarray(untransform_params(spec, jnp.asarray(p)))
+    starts = np.tile(raw, (8, 1))
+    starts += 0.01 * np.random.default_rng(3).standard_normal(starts.shape)
+    pmesh.reset_trace_counts()
+    xs1, lls1 = pmesh.multistart_sharded(spec, starts, panel, max_iters=5)
+    xs2, lls2 = pmesh.multistart_sharded(spec, starts, panel, max_iters=5)
+    np.testing.assert_array_equal(np.asarray(xs1), np.asarray(xs2))
+    np.testing.assert_array_equal(np.asarray(lls1), np.asarray(lls2))
+    assert pmesh.trace_counts["multistart"] == 1, dict(pmesh.trace_counts)
+
+
+# ---------------------------------------------------------------------------
+# serving: donated O(1) updates + the one-launch stress fan
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def service_pair(panel, k_setup):
+    spec, p = k_setup
+    snap = serving.freeze_snapshot(spec, p, panel, end=30)
+    return (serving.YieldCurveService(snap, donate=True),
+            serving.YieldCurveService(snap, donate=False), panel)
+
+
+def test_donated_online_update_bit_identical_no_recompile(service_pair):
+    """ISSUE satellite: donation on serving/online.py's update state —
+    donated and undonated services stay bit-identical through updates,
+    catch-up batches, forecasts and failures, with one trace per program."""
+    svc_d, svc_p, panel = service_pair
+    from yieldfactormodels_jl_tpu.serving import online
+
+    online.reset_trace_counts()
+    for i in range(5):
+        ll_d = svc_d.update(i, panel[:, 30 + i])
+        ll_p = svc_p.update(i, panel[:, 30 + i])
+        assert ll_d == ll_p  # bit-identical loglik
+        np.testing.assert_array_equal(np.asarray(svc_d.snapshot.beta),
+                                      np.asarray(svc_p.snapshot.beta))
+        np.testing.assert_array_equal(np.asarray(svc_d.snapshot.P),
+                                      np.asarray(svc_p.snapshot.P))
+    # one trace per (donate, engine) program, stable across the 5 updates
+    assert online.trace_counts["update"] == 2, dict(online.trace_counts)
+    # catch-up path
+    lls_d = svc_d.update_many("cat", panel[:, 35:38])
+    lls_p = svc_p.update_many("cat", panel[:, 35:38])
+    np.testing.assert_array_equal(lls_d, lls_p)
+    # a rejected update keeps the state without a rebuild, donated or not
+    # (negative obs_var → f < 0 → NaN sentinel in-kernel, like the
+    # test_serving.py rollback regression)
+    import dataclasses as _dc
+
+    spec = svc_d.snapshot.spec
+    bad = np.asarray(svc_d.snapshot.params, dtype=np.float64).copy()
+    bad[spec.layout["obs_var"][0]] = -10.0
+    for svc in (svc_d, svc_p):
+        beta0 = np.asarray(svc.snapshot.beta).copy()
+        svc.snapshot = _dc.replace(svc.snapshot, params=jnp.asarray(bad))
+        with pytest.raises(serving.ServingError):
+            svc.update("bad", panel[:, 38])
+        assert svc.rebuilds == 0  # a rejection is NOT a rebuild
+        np.testing.assert_array_equal(np.asarray(svc.snapshot.beta), beta0)
+    np.testing.assert_array_equal(np.asarray(svc_d.snapshot.beta),
+                                  np.asarray(svc_p.snapshot.beta))
+    # both services keep serving after the rejection (params put back — the
+    # donated flavor restored them with the banked snapshot already, the
+    # plain flavor keeps whatever the operator poked in)
+    good = np.asarray(svc_d._boot_snapshot.params)
+    for svc in (svc_d, svc_p):
+        svc.snapshot = _dc.replace(svc.snapshot, params=jnp.asarray(good))
+        assert np.isfinite(svc.update("next", panel[:, 38]))
+
+
+def test_service_stress_fan_is_one_program(service_pair):
+    """`scenarios(shocks=...)` routes the whole fan through ONE fused fan
+    program: per-shock densities + paths in a single launch, no retrace on
+    repeat, baseline density identical to the forecast verb's."""
+    svc, _, panel = service_pair
+    sc.reset_trace_counts()
+    out = svc.scenarios(8, 6, seed=3, shocks="standard")
+    assert out["names"][0] == "baseline" and len(out["names"]) == 6
+    assert out["paths"].shape == (6, len(MATS), 6, 8)
+    assert out["means"].shape == (6, 6, len(MATS))
+    assert np.isfinite(out["paths"]).all()
+    out2 = svc.scenarios(8, 6, seed=3, shocks="standard")
+    np.testing.assert_array_equal(out["paths"], out2["paths"])
+    assert sc.trace_counts["fan"] == 1, dict(sc.trace_counts)
+    # baseline density face == the forecast verb's density (same moments)
+    fc = svc.forecast(6)
+    np.testing.assert_allclose(out["means"][0], np.asarray(fc["means"]),
+                               rtol=1e-10)
+    # the documented density-only request shape: scenarios(shocks="standard")
+    dens = svc.scenarios(shocks="standard")
+    assert "paths" not in dens and dens["means"].shape[0] == 6
+    with pytest.raises(serving.ServingError, match="unknown shock fan"):
+        svc.stress_fan("bogus")
+    with pytest.raises(serving.ServingError, match="sampled"):
+        svc.scenarios()  # plain path needs an explicit draw count

@@ -42,6 +42,26 @@ def register_engine_cache(fn):
     return fn
 
 
+def make_trace_counter():
+    """Per-module trace-counter triple ``(trace_counts, note_trace,
+    reset_trace_counts)``: ``note_trace(kind)`` is called at the top of a
+    to-be-jitted function body, so it runs once per (re)trace and the
+    counter counts actual compilations — the no-recompile regression idiom
+    shared by serving/online.py, parallel/mesh.py and
+    estimation/scenario.py (one factory, per-module isolation)."""
+    import collections
+
+    counts: collections.Counter = collections.Counter()
+
+    def note_trace(kind: str) -> None:
+        counts[kind] += 1
+
+    def reset_trace_counts() -> None:
+        counts.clear()
+
+    return counts, note_trace, reset_trace_counts
+
+
 def default_dtype():
     return _DEFAULT_DTYPE
 

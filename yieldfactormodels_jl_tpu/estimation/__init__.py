@@ -1,10 +1,11 @@
 from . import optimize, neldermead
 
-__all__ = ["optimize", "neldermead", "bootstrap", "sv", "inference"]
+__all__ = ["optimize", "neldermead", "bootstrap", "sv", "inference",
+           "scenario"]
 
 
 def __getattr__(name):
-    # lazy: bootstrap/sv pull in the particle filter / grid engines
+    # lazy: bootstrap/sv/scenario pull in the particle filter / grid engines
     if name in __all__:
         import importlib
 

@@ -30,9 +30,14 @@ def moving_block_indices(key, T: int, block_len: int, n_resamples: int):
     return idx[:, :T]
 
 
-@register_engine_cache
-@lru_cache(maxsize=32)
-def _jitted_grid_loss(spec: ModelSpec, T: int):
+def _grid_loss_scan_core(spec: ModelSpec, T: int):
+    """Plain (un-jitted) general-engine grid-loss core: one ``api.get_loss``
+    scan per (resample, λ) cell, vmapped over both axes.  Exposed un-jitted
+    (via :func:`grid_loss_core`) so the fused scenario lattice
+    (estimation/scenario.py) can inline it into ITS program; ``acc`` is the
+    lattice's donated per-cell accumulator — ignored here (the scan engine
+    carries its accumulator inside ``get_loss``), accepted for signature
+    parity with the fused core."""
     def one(lam_driver, idx, params, data):
         p = params.at[0].set(lam_driver)
         resampled = data[:, idx]
@@ -40,30 +45,38 @@ def _jitted_grid_loss(spec: ModelSpec, T: int):
 
     over_lams = jax.vmap(one, in_axes=(0, None, None, None))
     over_resamples = jax.vmap(over_lams, in_axes=(None, 0, None, None))
-    return jax.jit(over_resamples)
+
+    def core(gammas, idx, params, data, acc=None):
+        del acc
+        return over_resamples(gammas, idx, params, data)
+
+    return core
 
 
-@register_engine_cache
-@lru_cache(maxsize=32)
-def _jitted_grid_loss_fused(spec: ModelSpec, T: int):
+def _grid_loss_fused_core(spec: ModelSpec, T: int):
     """MXU formulation of the static-λ grid loss for fully-observed panels.
 
     With every column observed the static filter carries no state
     (models/static_model.py:_static_scan re-OLS's β from each y_t), so
 
         pred_t = Z_g (μ + Φ Q_g y_t) = A_g y_t + b_g,
-        A_g = Z_g Φ Q_g (N×N),  Q_g = (Z_gᵀZ_g)⁻¹Z_gᵀ,  b_g = Z_g μ,
+        A_g = Z_g Φ Q_g (N×N),  Q_g = (Z_gᵀZ_g)⁻¹Zᵀ,  b_g = Z_g μ,
 
     and the whole (resample × λ) sweep is one (G·N, N)@(N, R) matmul per time
     step with the R resamples riding the TPU lane axis — instead of 128k
     scalar filters whose M=3 carries waste 125/128 lanes.  Semantics match
-    ``_jitted_grid_loss`` exactly on finite data (same ols_solve ridge-select,
-    same t = 0..T−2 window, same /N/T normalization, −Inf sentinel)."""
+    the scan core exactly on finite data (same ols_solve ridge-select,
+    same t = 0..T−2 window, same /N/T normalization, −Inf sentinel).
+
+    ``acc``: optional (R, G) recycle buffer for the per-cell accumulator —
+    contents are IGNORED (zeroed before the scan); when the caller donates it
+    (scenario lattice), XLA reuses its memory for the loss output instead of
+    allocating a fresh (R, G) buffer every launch."""
     from ..models.loadings import dns_loadings
     from ..models.params import unpack_static
     from ..ops.linalg import ols_solve
 
-    def fused(gammas, idx, params, data):
+    def fused(gammas, idx, params, data, acc=None):
         sp = unpack_static(spec, params)
         mats = spec.maturities_array
         Zg = jax.vmap(lambda g: dns_loadings(g[None], mats))(gammas)  # (G,N,M)
@@ -78,18 +91,53 @@ def _jitted_grid_loss_fused(spec: ModelSpec, T: int):
         Y = data[:, idx]                     # (N, R, T) — one upfront gather
         Y = jnp.moveaxis(Y, -1, 0)           # (T, N, R)
 
-        def step(acc, ys):
+        def step(acc_c, ys):
             y_t, y_next = ys
             pred = (A2 @ y_t).reshape(A.shape[0], N, -1) + b[:, :, None]
             v = y_next[None, :, :] - pred
-            return acc - jnp.sum(v * v, axis=1), None
+            return acc_c - jnp.sum(v * v, axis=1), None
 
-        acc0 = jnp.zeros((A.shape[0], Y.shape[2]), dtype=data.dtype)
-        acc, _ = jax.lax.scan(step, acc0, (Y[:-1], Y[1:]))
-        loss = acc.T / spec.N / T            # (R, G), get_loss normalization
+        if acc is None:
+            acc0 = jnp.zeros((A.shape[0], Y.shape[2]), dtype=data.dtype)
+        else:
+            # recycle the donated buffer: keep the VALUE dependency (a dead
+            # donated arg is dropped by XLA) but zero through a finiteness
+            # mask — a plain ``acc * 0`` would turn recycled −Inf sentinel
+            # cells into NaN carries and poison those cells forever
+            acc0 = (jnp.where(jnp.isfinite(acc), acc, 0.0) * 0.0).T \
+                .astype(data.dtype)
+        acc_f, _ = jax.lax.scan(step, acc0, (Y[:-1], Y[1:]))
+        loss = acc_f.T / spec.N / T          # (R, G), get_loss normalization
         return jnp.where(jnp.isfinite(loss), loss, -jnp.inf)
 
-    return jax.jit(fused)
+    return fused
+
+
+def grid_loss_core(spec: ModelSpec, T: int, engine: str):
+    """The lattice-callable seam: the PLAIN core for an already-resolved
+    engine (``"fused"``/``"scan"``), suitable for inlining inside another
+    jitted program (estimation/scenario.py's fused lattice).  Resolve the
+    engine EAGERLY first (:func:`resolve_grid_engine` — the finiteness probe
+    needs concrete data, so it cannot run at trace time)."""
+    if engine == "fused":
+        return _grid_loss_fused_core(spec, T)
+    if engine == "scan":
+        return _grid_loss_scan_core(spec, T)
+    raise ValueError(f"grid_loss_core needs a resolved engine "
+                     f"('fused'/'scan'), got {engine!r}")
+
+
+@register_engine_cache
+@lru_cache(maxsize=32)
+def _jitted_grid_loss(spec: ModelSpec, T: int):
+    return jax.jit(_grid_loss_scan_core(spec, T))
+
+
+@register_engine_cache
+@lru_cache(maxsize=32)
+def _jitted_grid_loss_fused(spec: ModelSpec, T: int):
+    """Jitted wrapper of :func:`_grid_loss_fused_core` (docstring there)."""
+    return jax.jit(_grid_loss_fused_core(spec, T))
 
 
 def lambda_to_gamma(lam):
@@ -126,6 +174,20 @@ def grid_losses(spec: ModelSpec, gammas, idx, params, data, engine: str = "auto"
     gaps.
     """
     T = data.shape[1]
+    resolved = resolve_grid_engine(spec, data, engine)
+    fn = (_jitted_grid_loss_fused if resolved == "fused"
+          else _jitted_grid_loss)(spec, T)
+    return fn(gammas, idx, jnp.asarray(params, dtype=spec.dtype), data)
+
+
+def resolve_grid_engine(spec: ModelSpec, data, engine: str = "auto") -> str:
+    """EAGER engine dispatch for the (resample × λ) grid: returns ``"fused"``
+    or ``"scan"``.  Extracted from :func:`grid_losses` so the scenario
+    lattice (estimation/scenario.py) resolves the engine at the driver —
+    with concrete data — and bakes the choice into its trace as a static
+    builder key (the finiteness probe cannot run on tracers, per the
+    in-jit sentinel convention).  Semantics identical to the historical
+    inline dispatch, including the loud forced-``"fused"`` validation."""
     if engine not in ("auto", "fused", "scan"):
         raise ValueError(f"engine must be 'auto', 'fused' or 'scan', got {engine!r}")
     if engine == "fused":
@@ -140,15 +202,13 @@ def grid_losses(spec: ModelSpec, gammas, idx, params, data, engine: str = "auto"
             raise ValueError(
                 "engine='fused' requires a fully-observed (finite) panel; "
                 "this data has missing values — use engine='scan'")
-        fn = _jitted_grid_loss_fused(spec, T)
-    elif (engine == "auto"
-          and spec.family == "static_lambda"
-          and not isinstance(data, jax.core.Tracer)
-          and bool(np.isfinite(np.asarray(data)).all())):
-        fn = _jitted_grid_loss_fused(spec, T)
-    else:
-        fn = _jitted_grid_loss(spec, T)
-    return fn(gammas, idx, jnp.asarray(params, dtype=spec.dtype), data)
+        return "fused"
+    if (engine == "auto"
+            and spec.family == "static_lambda"
+            and not isinstance(data, jax.core.Tracer)
+            and bool(np.isfinite(np.asarray(data)).all())):
+        return "fused"
+    return "scan"
 
 
 def grid_stats(losses, n_lambdas: int):

@@ -1,0 +1,452 @@
+"""Fused scenario lattice: bootstrap × λ-grid × SV draws × stress shocks as
+ONE donated, mesh-shardable program (docs/DESIGN.md §14; ROADMAP item 4).
+
+The uncertainty workloads this repo inherited run as separate single-purpose
+drivers — BASELINE config 5 (block bootstrap over a λ-decay grid,
+``bootstrap_lambda_grid``), config 3 (SV particle-filter draw sweeps,
+``estimate_sv``'s objective), and the serving layer's per-request scenario
+fans — each paying its own dispatch, transfer, and allocation round.  This
+module evaluates an arbitrary cross-product of
+
+- **resample axis** (R): moving-block bootstrap index sets — generated
+  IN-PROGRAM from ``key`` with the same stream as ``bootstrap_lambda_grid``,
+  or passed explicitly (the mesh-sharded path),
+- **λ-grid axis** (G): decay drivers, riding the MXU-fused grid-loss core
+  (``bootstrap.grid_loss_core``) with the R axis on the TPU lanes,
+- **SV-draw axis** (D): common-random-numbers particle-filter logliks for a
+  (D, P) parameter-draw batch (``ops/particle.draw_loglik_core``),
+- **shock axis** (S): a stress fan (parallel shift, twist, vol regime) of
+  h-step predictive densities + sampled paths from the panel's filtered
+  terminal state (``ops/forecast.density_fan``,
+  ``models/simulate.simulate(start_state=)``),
+
+in one jitted program: compile-once, launch-once, alloc-light.  The large
+recurring buffers are **donated** (``donate_argnums``), and every donated
+buffer's VALUES flow into an output of matching shape/dtype that aliases it
+— XLA silently drops a donated argument whose contents are dead (no
+aliasing, no memory reuse), so value-use + matched output is the invariant,
+pinned by tests/test_scenario.py:
+
+    resample index sets  →  gathered, then the ``resample_idx`` output
+                            (R, T) integer (explicit-index path — the
+                            mesh-sharded driver and recycled sweeps)
+    SV draw state        →  filtered, then the ``sv_draws`` output (D, P)
+    per-cell accumulator →  zeroed scan carry, then the ``losses`` output
+                            (R, G)
+
+Feeding one launch's outputs back as the next launch's inputs
+(``resample_idx=prev["resample_idx"]``, ``sv_draws=prev["sv_draws"]``,
+``recycle=prev`` for the accumulator) recycles exactly those buffers: the
+draw batch and index sets stay device-resident across rounds with zero
+re-transfer, and the loss plane reuses one allocation.
+
+Sentinel discipline (CLAUDE.md): inside the program failures stay coded —
+−Inf loss cells, −Inf PF draws, NaN-poisoned fan moments on a failed filter
+pass; the only exceptions here are trace-time ``ValueError`` validations at
+the driver.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import lru_cache
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import make_trace_counter, register_engine_cache
+from ..models.specs import ModelSpec
+from .bootstrap import (grid_loss_core, grid_stats, lambda_to_gamma,
+                        moving_block_indices, resolve_grid_engine)
+
+# trace counters (config.make_trace_counter): incremented INSIDE traced
+# bodies, so they count actual (re)compilations — the no-recompile tests pin
+# them across recycled launches
+trace_counts, note_trace, reset_trace_counts = make_trace_counter()
+
+
+# ---------------------------------------------------------------------------
+# shocks
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class ShockSpec:
+    """One stress scenario applied at the filtered state (frozen + hashable —
+    shock tuples are static builder keys).
+
+    ``beta_shift``: state-space displacement added to the filtered mean
+    (padded with zeros to the state dim; factor 0 is level, factor 1 slope
+    for the DNS/AFNS orderings).  ``vol_scale`` multiplies the filtered
+    covariance (the analytic density's vol regime; the shock decays through
+    the Φ P Φᵀ + Ω recursion as it should).  ``sv_phi``/``sv_sigma`` arm the
+    log-vol AR(1) on SAMPLED paths only (models/simulate.py's SV extension —
+    the Gaussian density face has no closed form under SV)."""
+
+    name: str
+    beta_shift: Tuple[float, ...] = ()
+    vol_scale: float = 1.0
+    sv_phi: float = 0.0
+    sv_sigma: float = 0.0
+
+
+def standard_fan(spec: ModelSpec, shift: float = 0.5) -> Tuple[ShockSpec, ...]:
+    """The canonical six-scenario stress fan: baseline, parallel ±``shift``
+    on the level factor, steepener/flattener ±``shift`` on the slope factor,
+    and a doubled-vol regime with SV-sampled paths.  ``shift`` is in yield
+    units (percent, like the panels)."""
+    Ms = spec.state_dim
+
+    def e(i, s):
+        return tuple(s if j == i else 0.0 for j in range(Ms))
+
+    return (
+        ShockSpec("baseline"),
+        ShockSpec("parallel_up", e(0, shift)),
+        ShockSpec("parallel_down", e(0, -shift)),
+        ShockSpec("steepener", e(1, shift)),
+        ShockSpec("flattener", e(1, -shift)),
+        ShockSpec("vol_regime", vol_scale=2.0, sv_phi=0.95, sv_sigma=0.3),
+    )
+
+
+def _shock_arrays(shocks: Tuple[ShockSpec, ...], Ms: int, dtype):
+    """(S, Ms) shifts, (S,) vol scales / sv params from static shock specs."""
+    shifts = np.zeros((len(shocks), Ms))
+    for i, s in enumerate(shocks):
+        if len(s.beta_shift) > Ms:
+            raise ValueError(
+                f"shock {s.name!r} shifts {len(s.beta_shift)} factors but the "
+                f"state dim is {Ms}")
+        shifts[i, :len(s.beta_shift)] = s.beta_shift
+    return (jnp.asarray(shifts, dtype=dtype),
+            jnp.asarray([s.vol_scale for s in shocks], dtype=dtype),
+            jnp.asarray([s.sv_phi for s in shocks], dtype=dtype),
+            jnp.asarray([s.sv_sigma for s in shocks], dtype=dtype))
+
+
+# ---------------------------------------------------------------------------
+# PRNG streams — ONE documented derivation shared by the program and the
+# parity tests: the resample stream is ``key`` ITSELF, so a lattice seeded
+# with ``key`` reproduces ``bootstrap_lambda_grid(key=key)`` cell-for-cell.
+# ---------------------------------------------------------------------------
+
+def face_keys(key):
+    """(resample, pf, paths) PRNG keys derived from the master ``key``."""
+    key = jnp.asarray(key)
+    return key, jax.random.fold_in(key, 1), jax.random.fold_in(key, 2)
+
+
+# ---------------------------------------------------------------------------
+# the fan core (shock axis): densities + sampled paths from one state
+# ---------------------------------------------------------------------------
+
+def _fan_core(spec: ModelSpec, shocks: Tuple[ShockSpec, ...], horizon: int,
+              n_paths: int):
+    """Plain callable ``(params, kp, beta, P, key) -> dict``: the whole
+    shock fan as one vmapped density scan + one (S × n) simulate batch —
+    inlined both by the lattice program and the serving fan program
+    (``_jitted_fan``)."""
+    from ..models.simulate import simulate
+    from ..ops.forecast import density_fan
+
+    def fan(params, kp, beta, P, key):
+        shifts, vols, phis, sigs = _shock_arrays(shocks, spec.state_dim,
+                                                 beta.dtype)
+        out = density_fan(spec, kp, beta, P, shifts, vols, horizon)
+        out = {"means": out["means"], "covs": out["covs"],
+               "state_means": out["state_means"],
+               "state_covs": out["state_covs"]}
+        if n_paths > 0:
+            def one_shock(shift, vol, phi_h, sig_h, k):
+                start = (beta + shift, P * (vol * vol))
+                return jax.vmap(
+                    lambda kk: simulate(spec, params, horizon, kk,
+                                        sv_phi=phi_h, sv_sigma=sig_h,
+                                        start_state=start)["data"],
+                    out_axes=-1)(jax.random.split(k, n_paths))
+
+            keys = jax.random.split(key, len(shocks))
+            out["paths"] = jax.vmap(one_shock)(shifts, vols, phis, sigs,
+                                               keys)  # (S, N, h, n)
+        return out
+
+    return fan
+
+
+# ---------------------------------------------------------------------------
+# the lattice program
+# ---------------------------------------------------------------------------
+
+@register_engine_cache
+@lru_cache(maxsize=16)
+def _jitted_lattice(static_spec: Optional[ModelSpec],
+                    kalman_spec: Optional[ModelSpec],
+                    T: int, R: int, G: int, D: int,
+                    shocks: Tuple[ShockSpec, ...], horizon: int, n_paths: int,
+                    n_particles: int, sv_phi: float, sv_sigma: float,
+                    block_len: int, grid_engine: str, gen_idx: bool,
+                    moment_engine: str, with_stats: bool, donate: bool):
+    """Build (and cache) ONE lattice program for a static configuration.
+    Absent faces (R/D/S of 0) are simply not traced — the degenerate 1×1×1
+    lattice is the same program shape as the full sweep.  ``donate`` keys a
+    separate program so the bit-identical donated-vs-not parity test can
+    hold both."""
+    from ..ops.particle import draw_loglik_core
+
+    S = len(shocks)
+
+    def run(key, idx, gammas, static_params, kalman_params, data, sv_draws,
+            acc):
+        note_trace("lattice")
+        k_idx, k_pf, k_paths = face_keys(key)
+        out = {}
+        if R > 0:
+            idx_arr = (moving_block_indices(k_idx, T, block_len, R)
+                       if gen_idx else idx)
+            core = grid_loss_core(static_spec, T, grid_engine)
+            losses = core(gammas, idx_arr, static_params, data, acc)
+            out["losses"] = losses
+            out["resample_idx"] = idx_arr  # pass-through: aliases donated idx
+        if D > 0:
+            pf = draw_loglik_core(kalman_spec, n_particles, sv_phi, sv_sigma)
+            out["pf_logliks"] = pf(sv_draws, data, k_pf)
+            out["sv_draws"] = sv_draws     # pass-through: aliases donated draws
+        if R > 0 and with_stats:
+            out["ci_low"], out["ci_high"], out["selection_freq"] = \
+                grid_stats(out["losses"], G)
+        if S > 0:
+            from ..ops.smoother import forward_moments
+
+            kp, outs = forward_moments(kalman_spec, kalman_params, data,
+                                       0, T, moment_engine)
+            beta, P = outs["beta_upd"][-1], outs["P_upd"][-1]
+            ok = jnp.all(outs["ll"] > -jnp.inf)
+            fan = _fan_core(kalman_spec, shocks, horizon, n_paths)(
+                kalman_params, kp, beta, P, k_paths)
+            nan = jnp.asarray(jnp.nan, dtype=beta.dtype)
+            # failed filter pass → NaN-poisoned fan + state (sentinel; the
+            # driver layer owns the error policy, CLAUDE.md conventions)
+            out["fan"] = {k: jnp.where(ok, v, nan) for k, v in fan.items()}
+            out["state_beta"] = jnp.where(ok, beta, nan)
+            out["state_P"] = jnp.where(ok, P, nan)
+        return out
+
+    donate_argnums = []
+    if donate:
+        if R > 0 and not gen_idx:
+            donate_argnums.append(1)   # idx ← resample_idx output (R, T)
+        if D > 0:
+            donate_argnums.append(6)   # sv_draws ← sv_draws output (D, P)
+        if R > 0 and grid_engine == "fused":
+            donate_argnums.append(7)   # acc ← losses output (R, G); the
+            # scan core never reads acc (XLA drops dead donated args)
+    return jax.jit(run, donate_argnums=tuple(donate_argnums))
+
+
+def _recycled(recycle, key_path, shape, dtype):
+    """Fetch a recyclable buffer from a previous launch's result dict:
+    shape/dtype must match the current configuration and the buffer must not
+    already be consumed (a dict can only be recycled once) — anything else
+    falls back to a fresh zero buffer of the right signature."""
+    buf = recycle
+    for k in key_path:
+        buf = buf.get(k) if isinstance(buf, dict) else None
+        if buf is None:
+            break
+    if (buf is not None and isinstance(buf, jax.Array)
+            and not buf.is_deleted()
+            and buf.shape == shape and buf.dtype == jnp.dtype(dtype)):
+        return buf
+    return jnp.zeros(shape, dtype=dtype)
+
+
+def evaluate_lattice(
+    data,
+    *,
+    static_spec: Optional[ModelSpec] = None,
+    static_params=None,
+    lambda_grid=None,
+    n_resamples: int = 0,
+    block_len: int = 12,
+    resample_idx=None,
+    grid_engine: str = "auto",
+    kalman_spec: Optional[ModelSpec] = None,
+    kalman_params=None,
+    sv_draws=None,
+    n_particles: int = 200,
+    sv_phi: float = 0.95,
+    sv_sigma: float = 0.2,
+    shocks: Tuple[ShockSpec, ...] = (),
+    horizon: int = 12,
+    n_paths: int = 0,
+    key=None,
+    donate: bool = True,
+    recycle: Optional[dict] = None,
+    with_stats: bool = True,
+) -> dict:
+    """Evaluate a (resample × λ × SV-draw × shock) scenario lattice in ONE
+    program launch.  Every axis is optional; present faces return:
+
+    - bootstrap face (``static_spec`` + ``static_params`` + ``lambda_grid``
+      + ``n_resamples``/``resample_idx``): ``losses`` (R, G),
+      ``resample_idx`` (R, T), and — under ``with_stats`` — the
+      ``bootstrap_lambda_grid`` CI/selection stats.  Seeding with ``key``
+      reproduces ``bootstrap_lambda_grid(key=key)`` cell-for-cell (same
+      index stream, same engine dispatch).
+    - SV-draw face (``kalman_spec`` + ``sv_draws`` (D, P) constrained):
+      ``pf_logliks`` (D,) — the common-random-numbers PF logliks
+      ``estimation/sv.pf_draw_logliks`` computes, at ``face_keys(key)[1]``.
+    - shock face (``kalman_spec`` + ``kalman_params`` + ``shocks``): the
+      panel is filtered once in-program and ``fan`` carries per-shock
+      ``means`` (S, h, N) / ``covs`` (S, h, N, N) predictive densities plus
+      — with ``n_paths`` — sampled ``paths`` (S, N, h, n); ``state_beta``/
+      ``state_P`` return the filtered origin state.  A failed filter pass
+      NaN-poisons the fan (sentinel), never raises.
+
+    ``donate=True`` (default) donates the recurring buffers (module
+    docstring): an explicitly passed device-array ``resample_idx`` or
+    ``sv_draws`` is CONSUMED by the launch (its values come back as the
+    same-named output — re-feed that next round; pass NumPy if the caller
+    keeps a copy), and ``recycle=`` takes a previous launch's result to
+    reuse its loss-plane allocation as this launch's accumulator.
+    ``with_stats=False`` skips the in-program CI/selection stats (the
+    mesh-sharded driver trims padding first and redoes them host-side).
+    """
+    faces = []
+    # ---- bootstrap face -------------------------------------------------
+    R = G = 0
+    gammas = idx_arg = None
+    gen_idx = resample_idx is None
+    if lambda_grid is not None or n_resamples or resample_idx is not None:
+        if static_spec is None or static_params is None or lambda_grid is None:
+            raise ValueError(
+                "the bootstrap face needs static_spec, static_params AND "
+                "lambda_grid (plus n_resamples or resample_idx)")
+        if not gen_idx:
+            # keep the caller's integer dtype: a forced cast would silently
+            # COPY the buffer and the copy, not the caller's array, would be
+            # donated — breaking the consume-and-recycle contract
+            idx_arg = jnp.asarray(resample_idx)
+            if not jnp.issubdtype(idx_arg.dtype, jnp.integer):
+                raise ValueError(
+                    f"resample_idx must be integer time indices, got "
+                    f"{idx_arg.dtype}")
+            R = int(idx_arg.shape[0])
+        else:
+            R = int(n_resamples)
+        if R < 1:
+            raise ValueError("the bootstrap face needs n_resamples >= 1 "
+                             "or an explicit resample_idx")
+        G = int(np.shape(lambda_grid)[0])
+        faces.append("bootstrap")
+    # ---- SV-draw face ---------------------------------------------------
+    D = 0
+    if sv_draws is not None:
+        if kalman_spec is None:
+            raise ValueError("the SV-draw face needs kalman_spec")
+        sv_draws = jnp.asarray(sv_draws, dtype=kalman_spec.dtype)
+        if sv_draws.ndim == 1:
+            sv_draws = sv_draws[None, :]
+        D = int(sv_draws.shape[0])
+        faces.append("sv")
+    # ---- shock face -----------------------------------------------------
+    shocks = tuple(shocks)
+    if shocks:
+        if kalman_spec is None or kalman_params is None:
+            raise ValueError("the shock face needs kalman_spec and "
+                             "kalman_params")
+        if not kalman_spec.is_kalman:
+            raise ValueError(
+                f"the shock face needs a Kalman family with a filtered "
+                f"state; {kalman_spec.family!r} has none")
+        if int(horizon) < 1:
+            raise ValueError(f"horizon must be >= 1, got {horizon}")
+        faces.append("fan")
+    if not faces:
+        raise ValueError("empty lattice: give at least one of the bootstrap "
+                         "(lambda_grid), SV-draw (sv_draws) or shock "
+                         "(shocks) axes")
+    if static_spec is not None and kalman_spec is not None \
+            and static_spec.dtype != kalman_spec.dtype:
+        raise ValueError("static_spec and kalman_spec dtypes differ — the "
+                         "lattice shares one panel")
+
+    spec0 = kalman_spec if kalman_spec is not None else static_spec
+    dtype = spec0.dtype
+    data = jnp.asarray(data, dtype=dtype)
+    T = int(data.shape[1])
+    if key is None:
+        key = jax.random.PRNGKey(0)
+
+    # static resolutions (eager — concrete data; baked into the trace)
+    resolved_engine = (resolve_grid_engine(static_spec, data, grid_engine)
+                      if R else "scan")
+    from .. import config
+    moment_engine = config.kalman_engine()
+    if moment_engine not in ("joint", "univariate"):
+        moment_engine = "univariate"  # loglik-only engines have no moments
+
+    if R:
+        gammas = lambda_to_gamma(jnp.asarray(lambda_grid, dtype=dtype))
+        static_params = jnp.asarray(static_params, dtype=dtype)
+    if shocks:
+        kalman_params = jnp.asarray(kalman_params, dtype=dtype)
+
+    recycle = recycle or {}
+    acc = None
+    if R and donate and resolved_engine == "fused":
+        acc = _recycled(recycle, ("losses",), (R, G), dtype)
+
+    fn = _jitted_lattice(static_spec, kalman_spec, T, R, G, D, shocks,
+                         int(horizon), int(n_paths), int(n_particles),
+                         float(sv_phi), float(sv_sigma), int(block_len),
+                         resolved_engine, bool(gen_idx) if R else True,
+                         moment_engine, bool(with_stats), bool(donate))
+    return fn(jnp.asarray(key), idx_arg, gammas, static_params,
+              kalman_params, data, sv_draws, acc)
+
+
+# ---------------------------------------------------------------------------
+# the serving fan program (one launch per stress-fan request)
+# ---------------------------------------------------------------------------
+
+@register_engine_cache
+@lru_cache(maxsize=32)
+def _jitted_fan(spec: ModelSpec, shocks: Tuple[ShockSpec, ...], horizon: int,
+                n_paths: int):
+    """The serving-side shock fan: ``(params, beta, P, key) -> fan dict``
+    from an ALREADY-FILTERED state (a :class:`~..serving.snapshot.
+    ServingSnapshot`'s moments) — one launch for the whole fan instead of
+    one scenario program per shock (``serving/service.py`` routes
+    ``scenarios(shocks=...)`` here).  No donation: the serving state is
+    long-lived and must survive the call."""
+    from ..models.params import unpack_kalman
+
+    core = _fan_core(spec, shocks, horizon, n_paths)
+
+    def fan(params, beta, P, key):
+        note_trace("fan")
+        kp = unpack_kalman(spec, params)
+        return core(params, kp, beta, P, key)
+
+    return jax.jit(fan)
+
+
+def stress_fan(spec: ModelSpec, params, beta, P,
+               shocks: Tuple[ShockSpec, ...], horizon: int, n_paths: int,
+               key=None) -> dict:
+    """One-launch stress fan from filtered moments (β, P): per-shock
+    predictive densities (+ sampled paths with ``n_paths``).  The serving
+    entry (``YieldCurveService.scenarios(shocks=...)``) and the QUICKSTART
+    walkthrough both come through here."""
+    shocks = tuple(shocks)
+    if not shocks:
+        raise ValueError("stress_fan needs at least one ShockSpec")
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    fn = _jitted_fan(spec, shocks, int(horizon), int(n_paths))
+    return fn(jnp.asarray(params, dtype=spec.dtype),
+              jnp.asarray(beta, dtype=spec.dtype),
+              jnp.asarray(P, dtype=spec.dtype), jnp.asarray(key))

@@ -108,6 +108,39 @@ def _jitted_sv_search_pallas(spec: ModelSpec, T: int, n_particles: int,
 
 @register_engine_cache
 @lru_cache(maxsize=32)
+def _jitted_draw_logliks(spec: ModelSpec, T: int, n_particles: int,
+                         sv_phi: float, sv_sigma: float):
+    from ..ops.particle import draw_loglik_core
+
+    return jax.jit(draw_loglik_core(spec, n_particles, sv_phi, sv_sigma))
+
+
+def pf_draw_logliks(spec: ModelSpec, draws, data, key=None,
+                    n_particles: int = 200, sv_phi: float = 0.95,
+                    sv_sigma: float = 0.2):
+    """(D,) common-random-numbers PF logliks for a (D, P) CONSTRAINED draw
+    batch — the per-point objective value :func:`estimate_sv`'s searches
+    evaluate, in the STREAMED-NOISE flavor of its fused/Pallas path: one
+    shared noise pair (``ops/particle.draw_noise(key)``) reused by every
+    draw, so the sweep is deterministic in the parameters (the fixed-surface
+    CRN property) and pays the proposal/resampling RNG once instead of D
+    times.  The lattice-callable seam: the fused scenario lattice
+    (estimation/scenario.py) inlines the same core
+    (ops/particle.draw_loglik_core) into its one-launch program, and parity
+    between the two paths is pinned in tests/test_scenario.py."""
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    data = jnp.asarray(data, dtype=spec.dtype)
+    draws = jnp.asarray(draws, dtype=spec.dtype)
+    if draws.ndim == 1:
+        draws = draws[None, :]
+    fn = _jitted_draw_logliks(spec, data.shape[1], int(n_particles),
+                              float(sv_phi), float(sv_sigma))
+    return fn(draws, data, key)
+
+
+@register_engine_cache
+@lru_cache(maxsize=32)
 def _jitted_sv_search(spec: ModelSpec, T: int, n_particles: int,
                       sv_phi: float, sv_sigma: float, max_iters: int,
                       f_tol: float):

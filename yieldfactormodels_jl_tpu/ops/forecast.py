@@ -17,6 +17,7 @@ One ``lax.scan`` over the horizon; engine-aware through
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -54,6 +55,24 @@ def density_from_state(spec: ModelSpec, kp, beta, P, horizon: int):
     (_, _), (means, covs, sb, sP) = lax.scan(step, (beta, P), None,
                                              length=horizon)
     return {"means": means, "covs": covs, "state_means": sb, "state_covs": sP}
+
+
+def density_fan(spec: ModelSpec, kp, beta, P, shifts, vol_scales,
+                horizon: int):
+    """Shock-axis batch of :func:`density_from_state`: for every scenario
+    shock s the filtered state is displaced (β + ``shifts[s]``) and its
+    covariance vol-scaled (P · ``vol_scales[s]²``), then the same
+    propagate-then-emit recursion runs — so a whole stress fan (parallel
+    shift, twist, vol regime) is ONE vmapped scan instead of S separate
+    density programs.  ``shifts`` (S, Ms), ``vol_scales`` (S,); outputs gain
+    a LEADING shock axis ((S, h, N) means etc — the per-cell (h, N[,N])
+    blocks stay contiguous for host consumption).  Like
+    ``density_from_state``: no failure gating here, callers own the
+    sentinel/poison policy."""
+    return jax.vmap(
+        lambda sh, vs: density_from_state(spec, kp, beta + sh,
+                                          P * (vs * vs), horizon)
+    )(shifts, vol_scales)
 
 
 def forecast_density(spec: ModelSpec, params, data, horizon: int,

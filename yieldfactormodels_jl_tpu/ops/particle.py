@@ -288,3 +288,40 @@ def particle_filter_loglik(
     if with_code:
         return loss, code
     return loss
+
+
+def draw_noise(T: int, n_particles: int, key, dtype):
+    """The shared CRN noise pair for a draw sweep: ``(normals (T-1, Pn),
+    uniforms (T-1,))`` from one key split — THE derivation
+    ``draw_loglik_core`` consumes, exposed so parity tests and external
+    callers can reproduce the exact streams."""
+    kz, ku = jax.random.split(jnp.asarray(key))
+    return (jax.random.normal(kz, (T - 1, n_particles), dtype=dtype),
+            jax.random.uniform(ku, (T - 1,), dtype=dtype))
+
+
+def draw_loglik_core(spec: ModelSpec, n_particles: int, sv_phi: float,
+                     sv_sigma: float):
+    """Batch plumbing for the SV-draw lattice axis: a PLAIN callable
+    ``(draws (D, P), data (N, T), key) -> (D,)`` vmapping the filter over
+    the draw axis on ONE shared common-noise pair (``draw_noise``): the
+    log-vol proposals and resampling offsets are generated ONCE and reused
+    by every draw — the streamed-noise CRN contract of the fused
+    ``estimate_sv`` objective (``ops/pallas_pf``), which both pins the
+    fixed-surface property (the sweep is deterministic in the parameters)
+    and deletes the per-draw RNG recomputation a key-splitting vmap would
+    pay D times.  A different (but equally valid) noise realization than
+    the key-splitting scan search, same as the Pallas path (see
+    ``estimate_sv``'s docstring).  Un-jitted on purpose:
+    ``estimation/sv.pf_draw_logliks`` jits it for standalone sweeps and the
+    fused scenario lattice (estimation/scenario.py) inlines it into ITS
+    program.  The per-draw filters keep the particle axis on the lane
+    dimension (module docstring); the draw axis vmaps outside them."""
+    def batch(draws, data, key):
+        noise = draw_noise(data.shape[1], n_particles, key, data.dtype)
+        return jax.vmap(
+            lambda p: particle_filter_loglik(
+                spec, p, data, noise=noise, n_particles=n_particles,
+                sv_phi=sv_phi, sv_sigma=sv_sigma))(draws)
+
+    return batch

@@ -27,7 +27,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..estimation import optimize as opt
 from ..models import api
 from ..models.specs import ModelSpec
-from ..config import register_engine_cache
+from ..config import make_trace_counter, register_engine_cache
+
+# trace counters (config.make_trace_counter): incremented INSIDE traced
+# bodies so they count actual (re)compilations — the donation regression
+# tests pin "bit-identical results AND no recompile" across repeated calls
+trace_counts, note_trace, reset_trace_counts = make_trace_counter()
 
 
 def make_mesh(n_devices: Optional[int] = None, axis_name: str = "batch") -> Mesh:
@@ -52,13 +57,26 @@ def pad_to_multiple(arr, multiple: int, axis: int = 0):
 @register_engine_cache
 @lru_cache(maxsize=64)
 def _sharded_batch_loss(spec: ModelSpec, T: int, mesh: Mesh, axis_name: str):
+    """The draws/resamples hot loop, params batch DONATED: the launch
+    consumes the (B, P) buffer, whose values ride back out as a pass-through
+    second output — a donated buffer whose contents are dead gets silently
+    dropped by XLA (no aliasing, no reuse), so the alias target must be a
+    real output (docs/DESIGN.md §14 donation invariant).  The public wrapper
+    returns only the losses; sweep drivers that re-feed the same draw batch
+    should re-feed the returned alias instead of keeping their own handle."""
     batch_sharding = NamedSharding(mesh, P(axis_name, None))
     repl = NamedSharding(mesh, P())
 
-    fn = jax.vmap(lambda p, data, start, end: api.get_loss(spec, p, data, start, end),
-                  in_axes=(0, None, None, None))
+    def fn(params, data, start, end):
+        note_trace("batch_loss")
+        lls = jax.vmap(
+            lambda p: api.get_loss(spec, p, data, start, end))(params)
+        return lls, params
+
     return jax.jit(fn, in_shardings=(batch_sharding, repl, repl, repl),
-                   out_shardings=NamedSharding(mesh, P(axis_name)))
+                   out_shardings=(NamedSharding(mesh, P(axis_name)),
+                                  batch_sharding),
+                   donate_argnums=(0,))
 
 
 def batch_loss_sharded(spec: ModelSpec, params_batch, data, mesh: Optional[Mesh] = None,
@@ -74,10 +92,12 @@ def batch_loss_sharded(spec: ModelSpec, params_batch, data, mesh: Optional[Mesh]
     if end is None:
         end = data.shape[1]
     n_dev = mesh.devices.size
+    # np.asarray first: the donated device buffer below is always FRESH
+    # (jnp.asarray of host memory), never a caller-held jax array
     padded, n = pad_to_multiple(np.asarray(params_batch), n_dev, axis=0)
     fn = _sharded_batch_loss(spec, data.shape[1], mesh, axis_name)
-    out = fn(jnp.asarray(padded, dtype=spec.dtype), data,
-             jnp.asarray(start), jnp.asarray(end))
+    out, _ = fn(jnp.asarray(padded, dtype=spec.dtype), data,
+                jnp.asarray(start), jnp.asarray(end))
     return out[:n]
 
 
@@ -85,6 +105,11 @@ def batch_loss_sharded(spec: ModelSpec, params_batch, data, mesh: Optional[Mesh]
 @lru_cache(maxsize=64)
 def _sharded_multistart(spec: ModelSpec, T: int, mesh: Mesh, axis_name: str,
                         max_iters: int, g_tol: float, f_abstol: float):
+    """Start buffer DONATED: the (S, P) raw starts are consumed by the launch
+    and their memory is reused for the identically-shaped, identically-
+    sharded converged-``xs`` output — the natural aliasing pair (the cascade
+    overwrites starts with solutions), so the donation is always usable and
+    warning-free."""
     batch_sharding = NamedSharding(mesh, P(axis_name, None))
     repl = NamedSharding(mesh, P())
 
@@ -92,14 +117,19 @@ def _sharded_multistart(spec: ModelSpec, T: int, mesh: Mesh, axis_name: str,
         fun = lambda p: opt._finite_objective(spec, data, p, start, end)
         return opt._run_lbfgs(fun, x0, max_iters, g_tol, f_abstol)
 
-    fn = jax.vmap(single, in_axes=(0, None, None, None))
+    def fn(x0s, data, start, end):
+        note_trace("multistart")
+        return jax.vmap(single, in_axes=(0, None, None, None))(
+            x0s, data, start, end)
+
     return jax.jit(
         fn,
         in_shardings=(batch_sharding, repl, repl, repl),
-        out_shardings=(NamedSharding(mesh, P(axis_name, None)),
+        out_shardings=(batch_sharding,
                        NamedSharding(mesh, P(axis_name)),
                        NamedSharding(mesh, P(axis_name)),
                        NamedSharding(mesh, P(axis_name))),
+        donate_argnums=(0,),
     )
 
 
@@ -207,3 +237,108 @@ def bootstrap_grid_sharded(spec: ModelSpec, params, data, lambda_grid,
         jnp.asarray(padded), NamedSharding(mesh, P(axis_name, None)))
     losses = grid_losses(spec, gammas, idx_sharded, params, data)[:n]
     return (losses,) + grid_stats(losses, lam.shape[0])
+
+
+def scenario_lattice_sharded(
+    data,
+    *,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = "batch",
+    static_spec: Optional[ModelSpec] = None,
+    static_params=None,
+    lambda_grid=None,
+    n_resamples: int = 0,
+    block_len: int = 12,
+    grid_engine: str = "auto",
+    kalman_spec: Optional[ModelSpec] = None,
+    kalman_params=None,
+    sv_draws=None,
+    n_particles: int = 200,
+    sv_phi: float = 0.95,
+    sv_sigma: float = 0.2,
+    shocks=(),
+    horizon: int = 12,
+    n_paths: int = 0,
+    key=None,
+    donate: bool = True,
+) -> dict:
+    """The scenario lattice (estimation/scenario.py) with its big axes riding
+    the device mesh: the RESAMPLE axis and the SV-DRAW axis are padded to a
+    device-count multiple and placed with ``NamedSharding(P(axis_name,
+    None))`` — computation-follows-data partitions the one lattice program so
+    each chip evaluates its slice of the (R × G) loss plane and its share of
+    the D particle filters, while the shock fan (a single filtered state)
+    stays replicated.  Padded rows are trimmed BEFORE the CI/selection stats
+    (``with_stats=False`` in-program, stats host-side here) so they cannot
+    bias the percentiles — the ``bootstrap_grid_sharded`` discipline.
+
+    Donation: the sharded index/draw/accumulator buffers are created fresh
+    here and donated by ``evaluate_lattice`` (its aliasing invariants hold
+    under sharding because the alias outputs carry the same sharding as the
+    inputs); callers never see a consumed buffer.  Same per-face returns as
+    :func:`~..estimation.scenario.evaluate_lattice`.
+    """
+    from ..estimation.bootstrap import (grid_stats, moving_block_indices,
+                                        resolve_grid_engine)
+    from ..estimation.scenario import evaluate_lattice, face_keys
+
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    if mesh is None:
+        mesh = make_mesh(axis_name=axis_name)
+    n_dev = mesh.devices.size
+    shard = NamedSharding(mesh, P(axis_name, None))
+    spec0 = kalman_spec if kalman_spec is not None else static_spec
+    if spec0 is None:
+        raise ValueError("scenario_lattice_sharded needs static_spec and/or "
+                         "kalman_spec")
+    data = jnp.asarray(data, dtype=spec0.dtype)
+    T = int(data.shape[1])
+
+    R = D = 0
+    idx_sharded = draws_sharded = None
+    recycle = None
+    if lambda_grid is not None:
+        R = int(n_resamples)
+        # the same index stream as the unsharded lattice / bootstrap driver
+        # (face_keys: the resample stream is the master key itself), padded
+        # by repeating the first rows — trimmed before anything statistical
+        idx = np.asarray(moving_block_indices(face_keys(key)[0], T,
+                                              block_len, R))
+        padded, _ = pad_to_multiple(idx, n_dev, axis=0)
+        idx_sharded = jax.device_put(jnp.asarray(padded, jnp.int32), shard)
+        G = int(np.shape(lambda_grid)[0])
+        if donate and resolve_grid_engine(static_spec, data,
+                                          grid_engine) == "fused":
+            # accumulator sharded like the losses output it aliases
+            recycle = {"losses": jax.device_put(
+                jnp.zeros((int(padded.shape[0]), G), dtype=spec0.dtype),
+                shard)}
+    if sv_draws is not None:
+        draws = np.asarray(sv_draws)
+        if draws.ndim == 1:
+            draws = draws[None, :]
+        D = int(draws.shape[0])
+        padded_d, _ = pad_to_multiple(draws, n_dev, axis=0)
+        draws_sharded = jax.device_put(
+            jnp.asarray(padded_d, dtype=spec0.dtype), shard)
+
+    out = evaluate_lattice(
+        data, static_spec=static_spec, static_params=static_params,
+        lambda_grid=lambda_grid, resample_idx=idx_sharded,
+        block_len=block_len, grid_engine=grid_engine,
+        kalman_spec=kalman_spec, kalman_params=kalman_params,
+        sv_draws=draws_sharded, n_particles=n_particles, sv_phi=sv_phi,
+        sv_sigma=sv_sigma, shocks=tuple(shocks), horizon=horizon,
+        n_paths=n_paths, key=key, donate=donate, recycle=recycle,
+        with_stats=False)
+
+    if R:
+        out["losses"] = out["losses"][:R]
+        out["resample_idx"] = out["resample_idx"][:R]
+        out["ci_low"], out["ci_high"], out["selection_freq"] = grid_stats(
+            out["losses"], int(np.shape(lambda_grid)[0]))
+    if D:
+        out["pf_logliks"] = out["pf_logliks"][:D]
+        out["sv_draws"] = out["sv_draws"][:D]
+    return out

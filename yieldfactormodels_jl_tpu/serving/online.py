@@ -35,7 +35,6 @@ layer (serving/service.py) converts that into a structured error.
 
 from __future__ import annotations
 
-import collections
 import math
 from functools import lru_cache
 from typing import NamedTuple
@@ -44,7 +43,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..config import register_engine_cache
+from ..config import make_trace_counter, register_engine_cache
 from ..models.kalman import _tvl_measurement, measurement_setup
 from ..models.params import unpack_kalman
 from ..models.specs import ModelSpec
@@ -57,22 +56,10 @@ _LOG_2PI = math.log(2.0 * math.pi)
 #: joint posterior, and assoc is a parallel-in-time reformulation)
 ONLINE_ENGINES = ("univariate", "sqrt")
 
-# ---------------------------------------------------------------------------
-# trace counters — incremented INSIDE the traced function bodies, so they
-# count actual (re)compilations; the no-recompile serving tests pin their sum
-# against the bucket-lattice bound (tests/test_serving.py)
-# ---------------------------------------------------------------------------
-
-trace_counts: collections.Counter = collections.Counter()
-
-
-def note_trace(kind: str) -> None:
-    """Call at the top of a to-be-jitted function body: runs once per trace."""
-    trace_counts[kind] += 1
-
-
-def reset_trace_counts() -> None:
-    trace_counts.clear()
+# trace counters (config.make_trace_counter) — note_trace at the top of a
+# traced body runs once per (re)compilation; the no-recompile serving tests
+# pin their sum against the bucket-lattice bound (tests/test_serving.py)
+trace_counts, note_trace, reset_trace_counts = make_trace_counter()
 
 
 class OnlineState(NamedTuple):
@@ -230,9 +217,17 @@ def _check_engine(engine: str) -> None:
 
 @register_engine_cache
 @lru_cache(maxsize=64)
-def _jitted_update(spec: ModelSpec, engine: str):
+def _jitted_update(spec: ModelSpec, engine: str, donate: bool = False):
     """One-step update program: (params, β, cov, y) →
-    (β′, cov′, ll, ok, code)."""
+    (β′, cov′, ll, ok, code).
+
+    ``donate=True`` donates the state arguments (β, cov): the launch CONSUMES
+    the caller's buffers and reuses their memory for the identically-shaped
+    updated-state outputs — the O(1) serving hot loop then allocates nothing
+    per update (docs/DESIGN.md §14).  Callers owning long-lived references
+    to the passed state (the service's snapshot/last-good bookkeeping) must
+    hold independent copies; :class:`~.service.YieldCurveService` keeps them
+    host-side."""
     _check_engine(engine)
 
     def one(params, beta, cov, y):
@@ -242,7 +237,7 @@ def _jitted_update(spec: ModelSpec, engine: str):
                                        engine)
         return st.beta, st.cov, ll, ok, code
 
-    return jax.jit(one)
+    return jax.jit(one, donate_argnums=(1, 2) if donate else ())
 
 
 #: catch-up length buckets: like the batcher's lattice, distinct gap lengths
@@ -259,12 +254,15 @@ def _k_bucket(k: int) -> int:
 
 @register_engine_cache
 @lru_cache(maxsize=64)
-def _jitted_update_k(spec: ModelSpec, engine: str, kb: int):
+def _jitted_update_k(spec: ModelSpec, engine: str, kb: int,
+                     donate: bool = False):
     """Padded k-step catch-up program: (params, β, cov, Y (N, kb),
     valid (kb,)) → (β′, cov′, lls (kb,), oks (kb,)) — one scan, params
     unpacked once.  Steps with ``valid`` false are EXACT no-ops (the carry
     passes through unchanged — NaN-padding alone would still apply the
-    transition), so any k ≤ kb runs through this one program."""
+    transition), so any k ≤ kb runs through this one program.  ``donate``
+    follows the ``_jitted_update`` contract: (β, cov) consumed, their memory
+    reused for the updated state."""
     _check_engine(engine)
 
     def many(params, beta, cov, Y, valid):
@@ -285,7 +283,7 @@ def _jitted_update_k(spec: ModelSpec, engine: str, kb: int):
                                              length=kb)
         return b, c, lls, oks, codes
 
-    return jax.jit(many)
+    return jax.jit(many, donate_argnums=(1, 2) if donate else ())
 
 
 @register_engine_cache
@@ -341,12 +339,15 @@ def _jitted_scenarios(spec: ModelSpec, horizon: int, n: int):
 # ---------------------------------------------------------------------------
 
 def update(spec: ModelSpec, params, state: OnlineState, y,
-           engine: str = "univariate", with_code: bool = False):
+           engine: str = "univariate", with_code: bool = False,
+           donate: bool = False):
     """One recursive update.  Returns ``(OnlineState, ll, ok)`` — all traced
     outputs; the caller decides whether NaN state is an error.
     ``with_code=True`` appends the taxonomy bitmask (same program — the code
-    always rides the kernel outputs)."""
-    runner = _jitted_update(spec, engine)
+    always rides the kernel outputs).  ``donate=True`` consumes ``state``
+    (its buffers are reused for the returned state — the alloc-free serving
+    hot loop); default off so existing callers' states stay valid."""
+    runner = _jitted_update(spec, engine, donate)
     b, c, ll, ok, code = runner(params, state.beta, state.cov, jnp.asarray(y))
     if with_code:
         return OnlineState(b, c), ll, ok, code
@@ -354,12 +355,13 @@ def update(spec: ModelSpec, params, state: OnlineState, y,
 
 
 def update_k(spec: ModelSpec, params, state: OnlineState, Y,
-             engine: str = "univariate", with_code: bool = False):
+             engine: str = "univariate", with_code: bool = False,
+             donate: bool = False):
     """k-step catch-up over the columns of ``Y`` (N, k).  Returns
     ``(OnlineState, lls (k,), oks (k,))`` (+ per-step codes with
     ``with_code=True``).  ``k`` is rounded up onto ``K_BUCKETS`` (padded
     steps are exact no-ops), so varying gap lengths share a handful of
-    compiled programs."""
+    compiled programs.  ``donate`` follows :func:`update`'s contract."""
     Y = jnp.asarray(Y)
     k = int(Y.shape[1])
     kb = _k_bucket(k)
@@ -367,7 +369,7 @@ def update_k(spec: ModelSpec, params, state: OnlineState, Y,
         pad = jnp.full(Y.shape[:1] + (kb - k,), jnp.nan, dtype=Y.dtype)
         Y = jnp.concatenate([Y, pad], axis=1)
     valid = jnp.arange(kb) < k
-    runner = _jitted_update_k(spec, engine, kb)
+    runner = _jitted_update_k(spec, engine, kb, donate)
     b, c, lls, oks, codes = runner(params, state.beta, state.cov, Y, valid)
     if with_code:
         return OnlineState(b, c), lls[:k], oks[:k], codes[:k]

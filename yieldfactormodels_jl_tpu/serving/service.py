@@ -103,7 +103,8 @@ class YieldCurveService:
                  batcher: Optional[MicroBatcher] = None,
                  registry: Optional[SnapshotRegistry] = None,
                  self_heal: bool = False,
-                 refresh_every: Optional[int] = None):
+                 refresh_every: Optional[int] = None,
+                 donate: bool = True):
         _check_engine(engine)
         self.engine = engine
         self.timer = timer if timer is not None else StageTimer()
@@ -111,6 +112,11 @@ class YieldCurveService:
         self.batcher = batcher if batcher is not None else MicroBatcher(lattice)
         self.registry = registry
         self.self_heal = bool(self_heal)
+        # donate=True (default) runs the O(1) update kernels with the state
+        # buffers DONATED — alloc-free per update; all long-lived references
+        # (snapshot, last-good) are kept as host copies so nothing else can
+        # alias a consumed buffer (docs/DESIGN.md §14)
+        self._donate = bool(donate)
         self.stale = False
         self.rebuilds = 0
         self.counters = RequestCounters()
@@ -119,14 +125,15 @@ class YieldCurveService:
         self._last_code = 0
         self._boot_snapshot = snapshot
         self._set_snapshot(snapshot)
-        self._last_good = (self.snapshot, self._state)
+        self._bank_last_good()
         self.last_update = None  # date of the last accepted update
 
     # ---- state plumbing ---------------------------------------------------
 
     def _set_snapshot(self, snapshot: ServingSnapshot) -> None:
         self.snapshot = snapshot
-        cov = snapshot.P
+        dtype = snapshot.spec.dtype
+        cov = jnp.asarray(snapshot.P, dtype=dtype)
         if self.engine == "sqrt":
             # factor once per (re)load; afterwards the sqrt kernel propagates
             # the factor itself and P is re-formed only for the snapshot record
@@ -137,7 +144,56 @@ class YieldCurveService:
                 raise ServingError("snapshot", "filtered covariance is not "
                                    "PSD — cannot start the sqrt engine",
                                    version=snapshot.meta.version)
-        self._state = OnlineState(snapshot.beta, cov)
+        else:
+            # the LIVE state must never alias the snapshot record: the
+            # donated update kernels consume the state buffers, and a shared
+            # buffer would take the frozen snapshot down with them
+            cov = jnp.array(cov, copy=True)
+        self._state = OnlineState(
+            jnp.array(jnp.asarray(snapshot.beta, dtype=dtype), copy=True),
+            cov)
+
+    def _bank_last_good(self, beta=None, cov=None) -> None:
+        """Freeze the current (snapshot, state) as the degrade/heal source —
+        HOST copies, so no later donated launch can consume them.  Callers
+        that already materialized the state host-side (the accept paths'
+        snapshot bookkeeping) pass it in so each accepted update pays ONE
+        device-to-host fetch, not two."""
+        self._last_good = (self.snapshot, OnlineState(
+            np.asarray(self._state.beta) if beta is None else beta,
+            np.asarray(self._state.cov) if cov is None else cov))
+
+    def _restore_last_good(self) -> None:
+        """Put the last-good pair back as the live state (fresh device
+        buffers from the banked host copies).  NOT a rebuild — the callers
+        are the rejected-update paths, where 'keep the state' under donation
+        means restoring what the launch consumed."""
+        snap, st = self._last_good
+        dtype = snap.spec.dtype
+        self.snapshot = snap
+        self._state = OnlineState(jnp.asarray(st.beta, dtype=dtype),
+                                  jnp.asarray(st.cov, dtype=dtype))
+
+    def _bank_alive(self) -> bool:
+        """Whether the banked last-good state is readable.  ``_bank_last_good``
+        always stores host copies, but operators/tests may plant device
+        arrays there — which a donated launch can consume out from under the
+        bank; a dead bank reads as poisoned (rebuild-from-source), never as
+        a crash."""
+        _, st = self._last_good
+        return not any(getattr(a, "is_deleted", lambda: False)()
+                       for a in (st.beta, st.cov))
+
+    def _keep_state_on_reject(self, fallback_state: OnlineState) -> None:
+        """A rejected update 'keeps the last good state'.  Under donation the
+        launch consumed the pre-update buffers, so keeping means restoring
+        the banked copies — or, when the bank itself is unreadable/poisoned,
+        parking the launch's NaN-sentinel outputs so the health watch below
+        drives the full §11 rebuild ladder."""
+        if self._bank_alive():
+            self._restore_last_good()
+        else:
+            self._state = fallback_state
 
     @property
     def version(self) -> int:
@@ -174,12 +230,13 @@ class YieldCurveService:
         h = rh.state_health(self._state.beta, self._state.cov, self.engine)
         if h["code"] == tax.OK and not force:
             return False
-        snap, st = self._last_good
-        if rh.state_health(st.beta, st.cov, self.engine)["code"] == tax.OK:
-            self.snapshot, self._state = snap, st
+        _, st = self._last_good
+        if self._bank_alive() and rh.state_health(
+                st.beta, st.cov, self.engine)["code"] == tax.OK:
+            self._restore_last_good()
         else:
             self._set_snapshot(self._rebuild_source())
-            self._last_good = (self.snapshot, self._state)
+            self._bank_last_good()
         self.rebuilds += 1
         return True
 
@@ -207,7 +264,10 @@ class YieldCurveService:
                                    self.engine)
             cov = jnp.asarray(cov, dtype=self.snapshot.spec.dtype)
             self._state = OnlineState(self._state.beta, cov)
-            P = cov @ cov.T if self.engine == "sqrt" else cov
+            # snapshot record = HOST copy (never aliases the live state —
+            # the next donated update consumes the state buffers)
+            c_h = np.asarray(cov)
+            P = c_h @ c_h.T if self.engine == "sqrt" else c_h
             self.snapshot = dataclasses.replace(self.snapshot, P=P)
         self._updates_since_refresh = 0
 
@@ -248,16 +308,24 @@ class YieldCurveService:
             raise ServingError("update", f"curve has {y.shape[0]} maturities, "
                                f"spec has {self.snapshot.spec.N}", date=date)
         with self.timer.stage("update"):
-            runner = _jitted_update(self.snapshot.spec, self.engine)
+            runner = _jitted_update(self.snapshot.spec, self.engine,
+                                    self._donate)
             b, c, ll, ok, code = runner(self.snapshot.params,
                                         self._state.beta, self._state.cov, y)
             ok = bool(ok)  # device sync: the driver decides, not the kernel
             code = int(code)
         if ok:
-            # tentative accept; the health watch below owns the final word
+            # tentative accept; the health watch below owns the final word.
+            # Snapshot bookkeeping holds HOST copies: the donated kernel owns
+            # the device state buffers and will consume them next update.
             self._state = OnlineState(b, c)
-            P = c @ c.T if self.engine == "sqrt" else c
-            self.snapshot = self.snapshot.advanced(b, P)
+            b_h, c_h = np.asarray(b), np.asarray(c)
+            P = c_h @ c_h.T if self.engine == "sqrt" else c_h
+            self.snapshot = self.snapshot.advanced(b_h, P)
+        elif self._donate:
+            # the launch consumed the pre-update state; "keep the last good
+            # version" now means restoring the banked copies (not a rebuild)
+            self._keep_state_on_reject(OnlineState(b, c))
         # numeric chaos seams (orchestration/chaos.py, docs/DESIGN.md §11):
         # simulate a poison that made it INTO the accepted state — the class
         # of fault the health watch + rebuild path exist for.  ``injected``
@@ -289,7 +357,7 @@ class YieldCurveService:
                 force_restore=injected,
                 date=date, version=self.version)
             return float("nan")
-        self._last_good = (self.snapshot, self._state)
+        self._bank_last_good(beta=b_h, cov=c_h)
         self.stale = False
         self._last_code = code
         self.last_update = date
@@ -305,11 +373,19 @@ class YieldCurveService:
             st, lls, oks, codes = update_k(self.snapshot.spec,
                                            self.snapshot.params,
                                            self._state, Y, engine=self.engine,
-                                           with_code=True)
+                                           with_code=True,
+                                           donate=self._donate)
             oks = np.asarray(oks)
+        if self._donate:
+            # all-or-nothing semantics, donated flavor: the scan consumed the
+            # pre-batch state either way; park the returned state (possibly
+            # NaN) and let the failure paths below restore the banked copies
+            self._state = st
         if not oks.all():
             j = int(np.argmin(oks))
             code = int(np.asarray(codes)[j])
+            if self._donate:
+                self._keep_state_on_reject(st)
             self._degrade(
                 "update",
                 code,
@@ -318,15 +394,18 @@ class YieldCurveService:
             return np.full(int(Y.shape[1]), np.nan)
         h = rh.state_health(st.beta, st.cov, self.engine)
         if h["code"] != tax.OK:
+            if self._donate:
+                self._keep_state_on_reject(st)
             self._degrade("update", h["code"],
                           f"catch-up state failed the health watch "
                           f"({tax.describe(h['code'])})",
                           date=date, version=self.version)
             return np.full(int(Y.shape[1]), np.nan)
         self._state = st
-        P = st.cov @ st.cov.T if self.engine == "sqrt" else st.cov
-        self.snapshot = self.snapshot.advanced(st.beta, P, n=int(Y.shape[1]))
-        self._last_good = (self.snapshot, self._state)
+        b_h, c_h = np.asarray(st.beta), np.asarray(st.cov)
+        P = c_h @ c_h.T if self.engine == "sqrt" else c_h
+        self.snapshot = self.snapshot.advanced(b_h, P, n=int(Y.shape[1]))
+        self._bank_last_good(beta=b_h, cov=c_h)
         self.stale = False
         self.last_update = date
         self._maybe_refresh(int(Y.shape[1]))  # k accepted steps count too
@@ -401,7 +480,7 @@ class YieldCurveService:
                           "engine's factorization — state kept",
                           date=date, version=self.version)
             return float("nan")
-        self._last_good = (self.snapshot, self._state)
+        self._bank_last_good()
         self.stale = False
         self._last_code = code
         if date is not None:
@@ -427,9 +506,27 @@ class YieldCurveService:
                                                     if quantiles else None)))
         return out
 
-    def scenarios(self, n: int, h: int, seed: int = 0) -> dict:
+    def scenarios(self, n: Optional[int] = None, h: int = 12, seed: int = 0,
+                  shocks=None) -> dict:
         """n sampled h-step yield paths: ``paths`` (N, h, n), draws on the
-        trailing (lane) axis."""
+        trailing (lane) axis.  With ``shocks`` (a tuple of
+        :class:`~..estimation.scenario.ShockSpec`, or ``"standard"`` for the
+        canonical six-scenario fan) the request routes through the fused
+        scenario lattice's fan program instead: the WHOLE stress fan —
+        parallel shift, twist, vol regime, n draws each plus the per-shock
+        predictive densities — is ONE device launch (docs/DESIGN.md §14),
+        returned with a leading shock axis (``names`` (S,), ``paths``
+        (S, N, h, n), ``means`` (S, h, N), ``covs`` (S, h, N, N)).  On the
+        fan path ``n`` defaults to 0 (densities only, no sampled paths), so
+        ``scenarios(shocks="standard")`` is a complete request; the plain
+        path needs an explicit draw count."""
+        if shocks is not None:
+            return self.stress_fan(shocks, n=0 if n is None else n, h=h,
+                                   seed=seed)
+        if n is None:
+            raise ServingError("scenarios", "n (the number of sampled "
+                               "paths) is required without a shock fan",
+                               version=self.version)
         with self.timer.stage("scenarios"):
             ticket = self.batcher.submit(
                 self.snapshot, ScenarioRequest(int(n), int(h), int(seed)))
@@ -439,6 +536,45 @@ class YieldCurveService:
             "scenarios", out, "paths",
             lambda: self._run_again(ScenarioRequest(int(n), int(h),
                                                     int(seed))))
+        return out
+
+    def stress_fan(self, shocks="standard", n: int = 0, h: int = 12,
+                   seed: int = 0) -> dict:
+        """One-launch stress fan from the current filtered state (the
+        serving half of the fused scenario lattice).  The fan always carries
+        the per-shock h-step predictive densities; ``n > 0`` adds sampled
+        paths.  Answers come from the snapshot's (β, P) moments, so the
+        engine choice stays invisible; a non-finite fan heals the state and
+        retries once under ``self_heal`` (the ``_finite_or_heal``
+        contract)."""
+        from ..estimation.scenario import ShockSpec, standard_fan, stress_fan
+
+        spec = self.snapshot.spec
+        if isinstance(shocks, str):
+            if shocks != "standard":
+                raise ServingError("scenarios", f"unknown shock fan "
+                                   f"{shocks!r} — pass 'standard' or a tuple "
+                                   f"of ShockSpec", version=self.version)
+            shocks = standard_fan(spec)
+        shocks = tuple(shocks)
+        if not all(isinstance(s, ShockSpec) for s in shocks):
+            raise ServingError("scenarios", "shocks must be ShockSpec "
+                               "instances", version=self.version)
+
+        def run_fan():
+            import jax as _jax
+
+            out = stress_fan(spec, self.snapshot.params, self.snapshot.beta,
+                             self.snapshot.P, shocks, int(h), int(n),
+                             key=_jax.random.PRNGKey(int(seed)))
+            res = {k: np.asarray(v) for k, v in out.items()}
+            res["names"] = tuple(s.name for s in shocks)
+            res["version"] = self.version
+            return res
+
+        with self.timer.stage("scenarios"):
+            out = run_fan()
+        out = self._finite_or_heal("scenarios", out, "means", run_fan)
         return out
 
     def _run_again(self, request) -> dict:
@@ -478,11 +614,13 @@ class YieldCurveService:
         first live request pays no compile.  Returns programs touched."""
         spec = self.snapshot.spec
         with self.timer.stage("warmup"):
-            runner = _jitted_update(spec, self.engine)
+            runner = _jitted_update(spec, self.engine, self._donate)
             nan_curve = jnp.full((spec.N,), jnp.nan, dtype=spec.dtype)
             # all-NaN warmup curve: a pure transition step, real params/state
-            runner(self.snapshot.params, self._state.beta, self._state.cov,
-                   nan_curve)
+            # — passed as COPIES: the donated program consumes its state args
+            runner(self.snapshot.params,
+                   jnp.array(self._state.beta, copy=True),
+                   jnp.array(self._state.cov, copy=True), nan_curve)
             n = 1 + self.batcher.warmup(self.snapshot, horizons=horizons,
                                         batch_sizes=batch_sizes,
                                         scenario_counts=scenario_counts)

@@ -1,4 +1,4 @@
-"""Out-of-sample forecast evaluation: Diebold–Mariano + Gaussian CRPS.
+"""Out-of-sample forecast evaluation: Diebold–Mariano, CRPS, log scores.
 
 Companion to the rolling-forecast pipeline (forecasting.py exports per-origin
 forecasts; the reference leaves accuracy comparison entirely to external
@@ -9,6 +9,16 @@ small-sample correction.  ``crps_gaussian`` scores the predictive DENSITIES
 ``api.forecast_density`` produces (closed form for N(μ, σ²); Gneiting &
 Raftery 2007, eq. 21) — proper scoring, lower is better; CRPS series from
 two models feed straight back into ``diebold_mariano``.
+
+Scenario-lattice scoring (docs/DESIGN.md §14): ``log_predictive_score`` is
+the joint multivariate Gaussian log predictive density of an outcome curve
+under the lattice/fan ``(means, covs)`` output — the metric the treasury
+VAR density-forecasting literature reports (arXiv:2108.06553's log
+predictive likelihoods), higher is better, so the fused fan can be scored
+head-to-head against external frequentist/Bayesian VAR baselines;
+``crps_sample`` is the ensemble (empirical) CRPS for SAMPLED scenario paths
+— the score for the fan's ``paths`` face, where SV regimes make the
+predictive non-Gaussian and the closed form does not apply.
 
 Pure NumPy — this is post-processing of exported forecasts, not device work.
 """
@@ -41,6 +51,68 @@ def crps_gaussian(mean, sd, y):
         out = sd * (z * (2.0 * ndtr(z) - 1.0) + 2.0 * phi
                     - 1.0 / math.sqrt(math.pi))
     return np.where(sd > 0, out, np.nan)
+
+
+def log_predictive_score(means, covs, y):
+    """Joint Gaussian log predictive density log N(y; μ, Σ) — HIGHER is
+    better (the log predictive likelihood of the VAR density-forecasting
+    literature, arXiv:2108.06553).
+
+    ``means`` (..., N), ``covs`` (..., N, N), ``y`` broadcastable to
+    (..., N); returns (...) scores.  Scores the scenario lattice / stress
+    fan's analytic density face against realized curves: e.g. fan ``means``
+    (S, h, N) + ``covs`` (S, h, N, N) against a realized (h, N) future gives
+    an (S, h) score table.  A non-PSD or non-finite covariance (or a
+    non-finite outcome/mean entry) scores NaN — degradation stays visible,
+    never raises.
+    """
+    means = np.asarray(means, dtype=np.float64)
+    covs = np.asarray(covs, dtype=np.float64)
+    y = np.asarray(y, dtype=np.float64)
+    N = means.shape[-1]
+    v = y - means                                          # (..., N) broadcast
+    covs = np.broadcast_to(covs, v.shape + (N,))
+    flat_v = v.reshape(-1, N)
+    flat_c = covs.reshape(-1, N, N)
+    out = np.full(flat_v.shape[0], np.nan)
+    for i in range(flat_v.shape[0]):
+        vi, ci = flat_v[i], flat_c[i]
+        if not (np.all(np.isfinite(vi)) and np.all(np.isfinite(ci))):
+            continue
+        try:
+            L = np.linalg.cholesky(0.5 * (ci + ci.T))
+        except np.linalg.LinAlgError:
+            continue  # non-PSD → NaN score
+        z = np.linalg.solve(L, vi)
+        logdet = 2.0 * np.sum(np.log(np.diag(L)))
+        out[i] = -0.5 * (N * math.log(2.0 * math.pi) + logdet + z @ z)
+    return out.reshape(v.shape[:-1])
+
+
+def crps_sample(samples, y, axis=-1):
+    """Ensemble CRPS from sampled scenario draws — lower is better:
+
+        CRPS = (1/m) Σᵢ |xᵢ − y|  −  (1/2m²) Σᵢⱼ |xᵢ − xⱼ|
+
+    (the fair empirical form of Gneiting & Raftery 2007, eq. 20 — exact for
+    the empirical predictive CDF, no distributional assumption, which is the
+    point for SV-regime fans whose paths are non-Gaussian).  ``samples``
+    carries the draw axis at ``axis`` (default last — the lane-dim draws
+    axis of ``scenarios``/fan ``paths``); ``y`` broadcastable to the
+    remaining shape.  NaNs in any draw of an element propagate to that
+    element's score.
+    """
+    x = np.asarray(samples, dtype=np.float64)
+    x = np.moveaxis(x, axis, -1)                           # (..., m)
+    y = np.broadcast_to(np.asarray(y, dtype=np.float64), x.shape[:-1])
+    m = x.shape[-1]
+    term1 = np.mean(np.abs(x - y[..., None]), axis=-1)
+    # pairwise |xᵢ − xⱼ| via sorted-spacings identity: Σᵢⱼ|xᵢ−xⱼ| =
+    # 2 Σₖ (2k − m + 1) x₍ₖ₎ (O(m log m), no (..., m, m) broadcast)
+    xs = np.sort(x, axis=-1)
+    k = np.arange(m, dtype=np.float64)
+    term2 = np.sum((2.0 * k - m + 1.0) * xs, axis=-1) / (m * m)
+    return term1 - term2
 
 
 def diebold_mariano(err1, err2, h: int = 1, loss: str = "squared",
