@@ -8,7 +8,6 @@ tests/test_estimation.py) on the same objectives.
 """
 
 import numpy as np
-import jax
 import jax.numpy as jnp
 
 from yieldfactormodels_jl_tpu import create_model

@@ -1,101 +1,60 @@
-"""Static-analysis guards for repo-wide mechanical conventions.
+"""Repo-wide mechanical-convention guards — thin wrappers over graftlint.
 
-1. Sentinel convention (CLAUDE.md, DESIGN §4): no ``raise`` inside
-   jit/scan/Pallas kernel bodies under ``ops/`` and ``serving/online.py`` —
-   failures there must be sentinels (−Inf loss, NaN moments) plus a taxonomy
-   code (robustness/taxonomy.py), never exceptions.
+These five tests predate the `analysis/` lint engine; they keep their names
+and their exact behavioral contracts (same file sets, same whitelists, same
+failure messages' content) but delegate the AST walking, call-name
+resolution and jit-context detection to the one shared implementation in
+``yieldfactormodels_jl_tpu.analysis`` (docs/DESIGN.md §15).  The engine's
+own positive/negative fixtures live in tests/test_lint_rules.py; the
+repo-wide zero-findings gate in tests/test_lint.py.
 
-   Mechanical rule (AST, not regex, so strings/comments can't fool it):
-
-   - a ``raise`` inside a NESTED function (a closure — scan bodies, jitted
-     ``one``/``many`` builders, Pallas kernel bodies) is a violation: those
-     run traced, where ``raise`` either fires spuriously at trace time or
-     silently never fires at run time;
-   - a ``raise`` at the top level of a module-level function is allowed only
-     for the trace-time validation classes (ValueError / TypeError /
-     NotImplementedError / AttributeError) — shape/config checks that fire
-     before tracing starts, the documented driver-layer exception.
-
-2. Request-path backpressure convention (DESIGN §12): the serving
-   request-path modules (everything under ``serving/``) may hold work only
-   in BOUNDED buffers and may never block on a bare ``time.sleep`` — an
-   unbounded ``queue.Queue()`` or an uninterruptible sleep is exactly how
-   backpressure regresses silently.  Chaos injection
-   (orchestration/chaos.py, where injected latency legitimately sleeps) and
+1. Sentinel convention (CLAUDE.md, DESIGN §4) → rule YFM001: no ``raise``
+   inside jit/scan/Pallas kernel bodies under ``ops/``,
+   ``serving/online.py`` and ``estimation/scenario.py`` — failures there
+   must be sentinels (−Inf loss, NaN moments) plus a taxonomy code; only
+   trace-time validation classes may raise at the top of kernel-module
+   functions.
+2. Request-path backpressure convention (DESIGN §12) → rule YFM008: the
+   serving request path holds work only in BOUNDED buffers and never blocks
+   on a bare ``time.sleep``.  Chaos injection (orchestration/chaos.py) and
    test code live outside the scanned set by construction.
+3. Engine-coverage convention (CLAUDE.md parity rule) → rule YFM007: every
+   ``config.KALMAN_ENGINES`` entry is named in an oracle-importing test
+   module — no engine ships selectable without oracle-backed parity.
 """
 
-import ast
 import os
 
-ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PKG = os.path.join(ROOT, "yieldfactormodels_jl_tpu")
+from yieldfactormodels_jl_tpu.analysis import LintConfig, run_lint
+from yieldfactormodels_jl_tpu.analysis.rules import (
+    kalman_engines_static, oracle_backed_test_strings)
 
-#: trace-time validation exception classes (allowed in top-level functions)
-WHITELIST = {"ValueError", "TypeError", "NotImplementedError",
-             "AttributeError"}
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CFG = LintConfig(root=ROOT)
 
 
 def _kernel_files():
-    opsdir = os.path.join(PKG, "ops")
-    for name in sorted(os.listdir(opsdir)):
-        if name.endswith(".py"):
-            yield os.path.join(opsdir, name)
-    yield os.path.join(PKG, "serving", "online.py")
-    # the fused scenario-lattice module (DESIGN §14): its programs must stay
-    # sentinel-coded (−Inf cells / NaN fan) like every other kernel
-    yield os.path.join(PKG, "estimation", "scenario.py")
+    return [rel for rel in CFG.lint_files() if CFG.is_kernel(rel)]
 
 
-def _func_depth(node, parents):
-    """Number of enclosing FunctionDef/AsyncFunctionDef/Lambda scopes."""
-    depth = 0
-    p = parents.get(node)
-    while p is not None:
-        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
-            depth += 1
-        p = parents.get(p)
-    return depth
+def _request_path_files():
+    serv = CFG.serving_dir.rstrip("/") + "/"
+    return [rel for rel in CFG.lint_files() if rel.startswith(serv)]
 
 
-def _raised_name(node):
-    exc = node.exc
-    if isinstance(exc, ast.Call):
-        exc = exc.func
-    if isinstance(exc, ast.Name):
-        return exc.id
-    if isinstance(exc, ast.Attribute):
-        return exc.attr
-    return None  # bare `raise` / exotic expression
+def _render(findings):
+    return "\n".join(f"{f.file}:{f.line} {f.message}" for f in findings)
 
 
 def test_no_raise_inside_kernel_bodies():
-    violations = []
-    for path in _kernel_files():
-        with open(path) as fh:
-            tree = ast.parse(fh.read(), filename=path)
-        parents = {}
-        for parent in ast.walk(tree):
-            for child in ast.iter_child_nodes(parent):
-                parents[child] = parent
-        rel = os.path.relpath(path, ROOT)
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Raise):
-                continue
-            depth = _func_depth(node, parents)
-            name = _raised_name(node)
-            if depth >= 2:
-                violations.append(
-                    f"{rel}:{node.lineno} raise inside a nested function "
-                    f"(scan/kernel body) — use the −Inf/NaN sentinel + "
-                    f"taxonomy code instead")
-            elif name not in WHITELIST:
-                violations.append(
-                    f"{rel}:{node.lineno} raises {name or '<bare>'} — only "
-                    f"trace-time validation ({sorted(WHITELIST)}) is allowed "
-                    f"in kernel modules")
-    assert not violations, "sentinel-convention violations:\n" + \
-        "\n".join(violations)
+    """No raise reachable inside kernel/scan bodies; top-level raises in
+    kernel modules restricted to trace-time validation classes (YFM001).
+    Pragma-suppressed findings are honored — ONE suppression policy
+    everywhere (DESIGN §15), so this guard and the CLI can never
+    disagree; today the kernel set carries zero pragmas."""
+    res = run_lint(CFG, files=_kernel_files(), rules=["YFM001"])
+    assert not res.findings, \
+        "sentinel-convention violations:\n" + _render(res.findings)
 
 
 def test_guard_is_not_vacuous():
@@ -106,61 +65,14 @@ def test_guard_is_not_vacuous():
             "online.py", "scenario.py"} <= names
 
 
-# ---------------------------------------------------------------------------
-# request-path guard: bounded queues, no bare sleeps (DESIGN §12)
-# ---------------------------------------------------------------------------
-
-def _request_path_files():
-    servdir = os.path.join(PKG, "serving")
-    for name in sorted(os.listdir(servdir)):
-        if name.endswith(".py"):
-            yield os.path.join(servdir, name)
-
-
-def _call_name(node):
-    """Dotted name of a Call's callee: 'time.sleep', 'queue.Queue', 'Queue'."""
-    fn = node.func
-    parts = []
-    while isinstance(fn, ast.Attribute):
-        parts.append(fn.attr)
-        fn = fn.value
-    if isinstance(fn, ast.Name):
-        parts.append(fn.id)
-    return ".".join(reversed(parts))
-
-
 def test_request_path_bounded_queues_and_no_bare_sleep():
     """No unbounded ``queue.Queue()`` and no bare ``time.sleep`` anywhere in
     the serving request path: depth bounds must be explicit (the gateway's
     deque + admission control) and waits must be interruptible
-    (``Event.wait``/``Condition.wait``).  Chaos/test code is whitelisted by
-    living outside ``serving/``."""
-    violations = []
-    for path in _request_path_files():
-        with open(path) as fh:
-            tree = ast.parse(fh.read(), filename=path)
-        rel = os.path.relpath(path, ROOT)
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            name = _call_name(node)
-            if name in ("time.sleep", "sleep"):
-                violations.append(
-                    f"{rel}:{node.lineno} bare {name}() on the request path "
-                    f"— use an interruptible Event/Condition wait")
-            if name in ("queue.Queue", "Queue", "queue.LifoQueue",
-                        "queue.PriorityQueue", "queue.SimpleQueue"):
-                # stdlib Queue() with no maxsize is unbounded by default;
-                # (the gateway's raw deque is fine: its bound is the
-                # admission check, pinned by tests/test_gateway.py)
-                bounded = bool(node.args) or any(
-                    kw.arg == "maxsize" for kw in node.keywords)
-                if not bounded:
-                    violations.append(
-                        f"{rel}:{node.lineno} unbounded {name}() on the "
-                        f"request path — give it a maxsize (backpressure)")
-    assert not violations, "request-path convention violations:\n" + \
-        "\n".join(violations)
+    (``Event.wait``/``Condition.wait``) — YFM008."""
+    res = run_lint(CFG, files=_request_path_files(), rules=["YFM008"])
+    assert not res.findings, \
+        "request-path convention violations:\n" + _render(res.findings)
 
 
 def test_request_path_guard_is_not_vacuous():
@@ -168,63 +80,22 @@ def test_request_path_guard_is_not_vacuous():
     assert {"gateway.py", "batcher.py", "service.py", "online.py"} <= names
 
 
-# ---------------------------------------------------------------------------
-# engine-coverage guard: every Kalman loglik engine is oracle-backed
-# ---------------------------------------------------------------------------
-
-TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
-
-
-def _oracle_backed_test_files():
-    """(name, AST) of every test module that imports ``tests/oracle.py`` —
-    the independent NumPy float64 loops every numeric kernel must be pinned
-    against (CLAUDE.md: never against another JAX path alone)."""
-    for name in sorted(os.listdir(TESTS_DIR)):
-        if not (name.startswith("test_") and name.endswith(".py")):
-            continue
-        path = os.path.join(TESTS_DIR, name)
-        with open(path) as fh:
-            tree = ast.parse(fh.read(), filename=path)
-        uses_oracle = False
-        for node in ast.walk(tree):
-            if isinstance(node, ast.ImportFrom) and node.module \
-                    and node.module.split(".")[-1] == "oracle":
-                uses_oracle = True
-            if isinstance(node, ast.ImportFrom) and node.module \
-                    and any(a.name == "oracle" for a in node.names):
-                uses_oracle = True
-            if isinstance(node, ast.Import) \
-                    and any(a.name.split(".")[-1] == "oracle"
-                            for a in node.names):
-                uses_oracle = True
-        if uses_oracle:
-            yield name, tree
-
-
 def test_every_kalman_engine_has_oracle_parity_coverage():
-    """Mechanical guard (AST, matching the sentinel guards above): every
-    engine name in ``config.KALMAN_ENGINES`` must appear as a string
-    constant inside at least one oracle-importing test module — a new
+    """Every engine name in ``config.KALMAN_ENGINES`` must appear as a
+    string constant inside at least one oracle-importing test module — a new
     engine cannot ship selectable without an oracle-backed parity test
-    naming it.  (tests/test_assoc_estimation.py carries the canonical
+    naming it (YFM007; tests/test_assoc_estimation.py carries the canonical
     all-engines row and pins its literal list to the registry, so the
-    string-level proxy here is anchored to a real parity test.)"""
-    from yieldfactormodels_jl_tpu.config import KALMAN_ENGINES
+    string-level proxy here is anchored to a real parity test)."""
+    res = run_lint(CFG, files=[], rules=["YFM007"])
+    assert not res.findings, _render(res.findings)
 
-    files = dict(_oracle_backed_test_files())
-    strings = {
-        name: {n.value for n in ast.walk(tree)
-               if isinstance(n, ast.Constant) and isinstance(n.value, str)}
-        for name, tree in files.items()
-    }
-    missing = [e for e in KALMAN_ENGINES
-               if not any(e in ss for ss in strings.values())]
-    assert not missing, (
-        f"engines with no oracle-backed parity coverage: {missing} — add a "
-        f"parity test against tests/oracle.py that names the engine "
-        f"(see test_assoc_estimation.test_engine_oracle_parity_with_nan_gap)")
-    # non-vacuity: the walk must see the canonical coverage module and the
-    # registry must still be the four-engine set (or larger)
-    assert "test_assoc_estimation.py" in files, \
-        "engine-coverage guard rotted: canonical parity module not scanned"
+    # non-vacuity: the statically-parsed registry matches the live one, the
+    # scan saw the canonical coverage module, and the registry is still the
+    # four-engine set (or larger)
+    engines, _ = kalman_engines_static(CFG)
+    from yieldfactormodels_jl_tpu.config import KALMAN_ENGINES
+    assert tuple(engines) == tuple(KALMAN_ENGINES)
     assert len(KALMAN_ENGINES) >= 4
+    assert "test_assoc_estimation.py" in oracle_backed_test_strings(CFG), \
+        "engine-coverage guard rotted: canonical parity module not scanned"

@@ -79,7 +79,7 @@ def test_engine_switch_clears_jitted_estimation_caches(dns_case):
     from yieldfactormodels_jl_tpu.estimation import optimize
 
     from yieldfactormodels_jl_tpu.estimation import bootstrap
-    from yieldfactormodels_jl_tpu.parallel import mesh  # registers its caches
+    from yieldfactormodels_jl_tpu.parallel import mesh  # noqa: F401 -- registers its caches
 
     optimize._jitted_loss(spec, data.shape[1])       # populate lru caches
     bootstrap._jitted_grid_loss(spec, data.shape[1])

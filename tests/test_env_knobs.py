@@ -1,60 +1,70 @@
 """Guard: every ``YFM_*`` engine env knob referenced anywhere in source —
-and every ``BENCH_*`` knob ``bench.py`` reads — is documented in CLAUDE.md
-(an undocumented knob is a silent behavior switch the next session can't
-discover) — grep-based, fails loudly on the first undocumented name."""
+and every ``BENCH_*`` knob the bench layer reads — is documented in
+CLAUDE.md (an undocumented knob is a silent behavior switch the next
+session can't discover).
+
+Thin wrapper over graftlint rule YFM006 (docs/DESIGN.md §15): the knob
+regexes, file walk and CLAUDE.md lookup live once in
+``yieldfactormodels_jl_tpu.analysis.rules``; this module keeps the
+historical test names, per-namespace split and vacuity anchors.
+"""
 
 import os
-import re
+
+from yieldfactormodels_jl_tpu.analysis import LintConfig, SourceModule, run_lint
+from yieldfactormodels_jl_tpu.analysis.rules import knob_occurrences
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-KNOB = re.compile(r"\bYFM_[A-Z0-9_]+\b")
-BENCH_KNOB = re.compile(r"\bBENCH_[A-Z0-9_]+\b")
+CFG = LintConfig(root=ROOT)
 
 
-def _source_files():
-    for dirpath, _, names in os.walk(
-            os.path.join(ROOT, "yieldfactormodels_jl_tpu")):
-        for name in names:
-            if name.endswith(".py"):
-                yield os.path.join(dirpath, name)
-    yield os.path.join(ROOT, "bench.py")
-    bench_dir = os.path.join(ROOT, "benchmarks")
-    for name in os.listdir(bench_dir):
-        if name.endswith(".py"):
-            yield os.path.join(bench_dir, name)
+def _all_knobs(bench_only: bool):
+    """Knob names the linted file set references (YFM_* everywhere;
+    BENCH_* in the bench layer)."""
+    knobs = set()
+    for rel in CFG.lint_files():
+        bench = CFG.matches(rel, CFG.bench_files)
+        if bench_only and not bench:
+            continue
+        mod = SourceModule(CFG.abspath(rel), rel)
+        for knob, _line in knob_occurrences(mod, bench):
+            if bench_only == knob.startswith("BENCH_"):
+                knobs.add(knob)
+    return knobs
+
+
+def _yfm006_findings():
+    # pragma suppressions honored — same policy as the CLI (DESIGN §15)
+    return run_lint(CFG, rules=["YFM006"]).findings
 
 
 def test_every_yfm_knob_is_documented_in_claude_md():
-    knobs = set()
-    for path in _source_files():
-        with open(path) as fh:
-            knobs |= set(KNOB.findall(fh.read()))
-    # vacuity guard: the knobs this repo is known to ship; if the grep rots
+    # vacuity guard: the knobs this repo is known to ship; if the walk rots
     # and finds nothing, fail instead of green-lighting
+    knobs = _all_knobs(bench_only=False)
     assert {"YFM_SSD_PALLAS", "YFM_FUSED_CHECK", "YFM_MSED_CLOSED",
-            "YFM_PF_PALLAS"} <= knobs, f"grep drifted: found only {knobs}"
-    with open(os.path.join(ROOT, "CLAUDE.md")) as fh:
-        doc = fh.read()
-    undocumented = sorted(k for k in knobs if k not in doc)
+            "YFM_PF_PALLAS"} <= knobs, f"knob walk drifted: found only {knobs}"
+    undocumented = sorted(f"{f.file}:{f.line} {f.message}"
+                          for f in _yfm006_findings()
+                          if "YFM_" in f.message)
     assert not undocumented, (
-        f"undocumented YFM_* env knobs: {undocumented} — add them to the "
-        f"'Engine env knobs' bullet in CLAUDE.md's Conventions")
+        "undocumented YFM_* env knobs — add them to the 'Engine env knobs' "
+        "bullet in CLAUDE.md's Conventions:\n" + "\n".join(undocumented))
 
 
 def test_every_bench_knob_read_by_bench_py_is_documented_in_claude_md():
-    """The same guard the YFM_* knobs carry, extended to bench.py's BENCH_*
-    switches: every knob the headline bench reads must be discoverable in
-    CLAUDE.md — an opt-in bench section nobody can find is a bench section
-    nobody runs."""
-    with open(os.path.join(ROOT, "bench.py")) as fh:
-        knobs = set(BENCH_KNOB.findall(fh.read()))
+    """The same guard the YFM_* knobs carry, extended to the whole bench
+    layer's BENCH_* switches (bench.py AND benchmarks/*.py since graftlint):
+    every knob the bench layer reads must be discoverable in CLAUDE.md — an
+    opt-in bench section nobody can find is a bench section nobody runs."""
+    knobs = _all_knobs(bench_only=True)
     # vacuity guard: the opt-in sections this repo is known to ship
     assert {"BENCH_SERVING", "BENCH_ORCH", "BENCH_LOAD", "BENCH_LONGT",
             "BENCH_ROBUST", "BENCH_SCEN"} <= knobs, \
-        f"grep drifted: found only {sorted(knobs)}"
-    with open(os.path.join(ROOT, "CLAUDE.md")) as fh:
-        doc = fh.read()
-    undocumented = sorted(k for k in knobs if k not in doc)
+        f"knob walk drifted: found only {sorted(knobs)}"
+    undocumented = sorted(f"{f.file}:{f.line} {f.message}"
+                          for f in _yfm006_findings()
+                          if "BENCH_" in f.message)
     assert not undocumented, (
-        f"undocumented BENCH_* env knobs: {undocumented} — add them to the "
-        f"Benchmarks bullet in CLAUDE.md's Commands")
+        "undocumented BENCH_* env knobs — add them to the Benchmarks bullet "
+        "in CLAUDE.md's Commands:\n" + "\n".join(undocumented))

@@ -1,11 +1,9 @@
 """Forecast driver + persistence integration tests (tmp dirs, small models)."""
 
-import dataclasses
 import os
 import sqlite3
 
 import numpy as np
-import pytest
 
 from yieldfactormodels_jl_tpu import create_model
 from yieldfactormodels_jl_tpu.forecasting import (

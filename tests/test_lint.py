@@ -1,0 +1,273 @@
+"""graftlint CI gate + suppression machinery (ISSUE 10 tentpole).
+
+The single fast check every PR runs: zero unsuppressed findings over the
+package, the engine importable without jax, the CLI JSON schema stable,
+``--changed-only`` honest against a real git diff, pragmas and the baseline
+round-tripping, and (when ruff is installed) the generic pyflakes-level
+pass clean too.  Per-rule positive/negative fixtures live in
+tests/test_lint_rules.py.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from yieldfactormodels_jl_tpu.analysis import (LintConfig, RULES,
+                                               load_baseline, run_lint,
+                                               save_baseline)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# the gate
+# ---------------------------------------------------------------------------
+
+def test_repo_is_lint_clean():
+    """Zero unsuppressed, unbaselined findings over the package + bench
+    layer — the acceptance bar every future PR inherits."""
+    cfg = LintConfig(root=ROOT)
+    baseline = load_baseline(cfg.abspath(cfg.baseline_path))
+    result = run_lint(cfg, baseline=baseline)
+    assert not result.errors, result.errors
+    msgs = [f"{f.file}:{f.line}: {f.rule} {f.message}"
+            for f in result.findings]
+    assert not msgs, "graftlint findings:\n" + "\n".join(msgs)
+
+
+def test_lint_pass_is_not_vacuous():
+    """All nine rules registered and the walk actually covers the package,
+    the bench layer, and the kernel modules (a rotted glob would green-light
+    everything)."""
+    assert {f"YFM{i:03d}" for i in range(1, 10)} <= set(RULES)
+    cfg = LintConfig(root=ROOT)
+    rels = set(cfg.lint_files())
+    assert {"yieldfactormodels_jl_tpu/ops/univariate_kf.py",
+            "yieldfactormodels_jl_tpu/serving/gateway.py",
+            "yieldfactormodels_jl_tpu/estimation/scenario.py",
+            "bench.py", "benchmarks/run_all.py"} <= rels
+    kernels = {os.path.basename(r) for r in rels if cfg.is_kernel(r)}
+    assert {"univariate_kf.py", "sqrt_kf.py", "particle.py", "smoother.py",
+            "online.py", "scenario.py"} <= kernels
+
+
+def test_engine_imports_without_jax():
+    """The linter must start in ~a second on a CPU-only box: importing the
+    analysis package (as ``python -m`` does via the lazy package __init__)
+    must not pull jax — which on this container would put backend init one
+    device-op away from dialing the TPU tunnel."""
+    code = ("import sys; import yieldfactormodels_jl_tpu.analysis; "
+            "assert 'jax' not in sys.modules, 'analysis import pulled jax'")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run([sys.executable, "-c", code], cwd=ROOT, env=env,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# CLI: JSON schema + exit codes
+# ---------------------------------------------------------------------------
+
+def _cli(*args, cwd=ROOT, timeout=180):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return subprocess.run(
+        [sys.executable, "-m", "yieldfactormodels_jl_tpu.analysis", *args],
+        cwd=cwd, env=env, capture_output=True, text=True, timeout=timeout)
+
+
+def test_cli_json_schema():
+    proc = _cli("--format", "json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    data = json.loads(proc.stdout)
+    assert data["version"] == 1
+    assert set(data) >= {"version", "files_scanned", "counts", "findings",
+                         "suppressed", "baselined", "errors"}
+    assert data["counts"]["findings"] == len(data["findings"]) == 0
+    assert data["files_scanned"] >= 50
+    for bucket in ("findings", "suppressed", "baselined"):
+        for f in data[bucket]:
+            assert set(f) >= {"rule", "file", "line", "col", "message"}
+
+
+def test_cli_list_rules():
+    proc = _cli("--list-rules")
+    assert proc.returncode == 0
+    for rid in ("YFM001", "YFM005", "YFM009"):
+        assert rid in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# fixture repo scaffolding
+# ---------------------------------------------------------------------------
+
+_CLEAN = "def ok():\n    return 1\n"
+_BAD_SERVING = textwrap.dedent("""\
+    import queue
+
+    def pump():
+        return queue.Queue()
+""")
+
+
+def _scaffold(tmp_path, serving_body=_CLEAN):
+    pkg = tmp_path / "yieldfactormodels_jl_tpu"
+    (pkg / "serving").mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "serving" / "__init__.py").write_text("")
+    (pkg / "serving" / "gw.py").write_text(serving_body)
+    (tmp_path / "CLAUDE.md").write_text("no knobs documented\n")
+    return tmp_path
+
+
+def test_changed_only_on_synthetic_git_diff(tmp_path):
+    """--changed-only lints exactly the files git reports as touched: a
+    committed violation is invisible, the same violation in the worktree
+    diff is caught."""
+    root = _scaffold(tmp_path, serving_body=_BAD_SERVING)
+    git_env = dict(os.environ, GIT_AUTHOR_NAME="t", GIT_AUTHOR_EMAIL="t@t",
+                   GIT_COMMITTER_NAME="t", GIT_COMMITTER_EMAIL="t@t")
+
+    def git(*args):
+        proc = subprocess.run(["git", *args], cwd=root, env=git_env,
+                              capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, proc.stderr
+        return proc.stdout
+
+    git("init", "-q")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+
+    # nothing changed: --changed-only sees an empty file set → exit 0 even
+    # though the committed tree contains a violation
+    proc = _cli("--changed-only", "--root", str(root), "--format", "json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(proc.stdout)["counts"]["findings"] == 0
+
+    # touch the violating file: now it is in the diff and the finding fires
+    gw = root / "yieldfactormodels_jl_tpu" / "serving" / "gw.py"
+    gw.write_text(_BAD_SERVING + "\n# touched\n")
+    proc = _cli("--changed-only", "--root", str(root), "--format", "json")
+    assert proc.returncode == 1
+    data = json.loads(proc.stdout)
+    assert [f["rule"] for f in data["findings"]] == ["YFM008"]
+    assert data["findings"][0]["file"].endswith("serving/gw.py")
+
+    # a full (non-changed-only) run still sees it regardless of git state
+    proc = _cli("--root", str(root))
+    assert proc.returncode == 1
+
+
+# ---------------------------------------------------------------------------
+# suppression machinery: pragmas + baseline
+# ---------------------------------------------------------------------------
+
+def test_pragma_suppresses_with_recorded_reason(tmp_path):
+    body = textwrap.dedent("""\
+        import queue
+
+        def pump():
+            # yfmlint: disable=YFM008 -- bounded by the admission check
+            return queue.Queue()
+    """)
+    root = _scaffold(tmp_path, serving_body=body)
+    res = run_lint(LintConfig(root=str(root)))
+    assert not res.findings
+    assert len(res.suppressed) == 1
+    s = res.suppressed[0]
+    assert s.rule == "YFM008"
+    assert s.suppress_reason == "bounded by the admission check"
+
+
+def test_pragma_without_reason_still_suppresses_and_records_empty(tmp_path):
+    body = textwrap.dedent("""\
+        import queue
+
+        def pump():
+            return queue.Queue()  # yfmlint: disable=YFM008
+    """)
+    root = _scaffold(tmp_path, serving_body=body)
+    res = run_lint(LintConfig(root=str(root)))
+    assert not res.findings
+    assert len(res.suppressed) == 1
+    assert res.suppressed[0].suppress_reason == ""
+
+
+def test_pragma_for_other_rule_does_not_suppress(tmp_path):
+    body = textwrap.dedent("""\
+        import queue
+
+        def pump():
+            return queue.Queue()  # yfmlint: disable=YFM001 -- wrong id
+    """)
+    root = _scaffold(tmp_path, serving_body=body)
+    res = run_lint(LintConfig(root=str(root)))
+    assert [f.rule for f in res.findings] == ["YFM008"]
+    assert not res.suppressed
+
+
+def test_baseline_roundtrip(tmp_path):
+    """Findings grandfathered via save_baseline stop being actionable but
+    stay visible; an edited line (moved finding) escapes the baseline."""
+    root = _scaffold(tmp_path, serving_body=_BAD_SERVING)
+    cfg = LintConfig(root=str(root))
+    res = run_lint(cfg)
+    assert [f.rule for f in res.findings] == ["YFM008"]
+
+    bl_path = cfg.abspath(cfg.baseline_path)
+    n = save_baseline(bl_path, res.findings)
+    assert n == 1
+    baseline = load_baseline(bl_path)
+    res2 = run_lint(cfg, baseline=baseline)
+    assert not res2.findings
+    assert [f.rule for f in res2.baselined] == ["YFM008"]
+
+    # shift the violation one line down: the stale baseline no longer
+    # matches and the finding is actionable again
+    gw = root / "yieldfactormodels_jl_tpu" / "serving" / "gw.py"
+    gw.write_text("# moved\n" + _BAD_SERVING)
+    res3 = run_lint(cfg, baseline=baseline)
+    assert [f.rule for f in res3.findings] == ["YFM008"]
+
+
+def test_write_baseline_cli(tmp_path):
+    root = _scaffold(tmp_path, serving_body=_BAD_SERVING)
+    proc = _cli("--root", str(root), "--write-baseline")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert load_baseline(str(root / ".yfmlint-baseline.json"))
+    proc = _cli("--root", str(root))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_repo_baseline_is_wellformed_and_empty():
+    """The committed baseline parses and is empty — the healthy steady
+    state; deliberate debt must be added consciously, not accumulate."""
+    entries = load_baseline(os.path.join(ROOT, ".yfmlint-baseline.json"))
+    assert entries == set()
+
+
+# ---------------------------------------------------------------------------
+# generic lint: ruff (pyflakes-level), gated on availability
+# ---------------------------------------------------------------------------
+
+def test_ruff_pyflakes_clean():
+    """Plain-Python errors are caught the same way as domain rules.  Gated:
+    this container does not ship ruff (and nothing may be pip-installed),
+    so the check runs wherever ruff exists and skips loudly here."""
+    ruff = shutil.which("ruff")
+    if ruff is None:
+        pytest.skip("ruff not installed in this container (see CLAUDE.md: "
+                    "no new deps); [tool.ruff] config in pyproject.toml is "
+                    "exercised wherever ruff is available")
+    proc = subprocess.run(
+        [ruff, "check", "yieldfactormodels_jl_tpu", "bench.py", "benchmarks",
+         "tests"],
+        cwd=ROOT, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -18,7 +18,6 @@ Acceptance coverage (ISSUE 5):
 
 import dataclasses
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest

@@ -3,7 +3,6 @@
 import os
 
 import numpy as np
-import pytest
 
 from yieldfactormodels_jl_tpu.run import run
 

@@ -1,7 +1,6 @@
 """Time-axis (sequence) parallel Kalman loglik on the 8-device virtual mesh."""
 
 import numpy as np
-import jax
 import jax.numpy as jnp
 
 from yieldfactormodels_jl_tpu import create_model

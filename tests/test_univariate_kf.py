@@ -8,7 +8,6 @@ windows, NaN forecasting columns, gradients, and vmap batches.
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from tests.test_kalman import _dns_params
 from yieldfactormodels_jl_tpu import create_model

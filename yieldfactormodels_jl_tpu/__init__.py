@@ -18,97 +18,74 @@ A ground-up re-design of the capabilities of Sicco123/YieldFactorModels.jl
 
 The reference contains zero native (C++/CUDA) components (SURVEY.md §2); the
 native layer of this framework is XLA itself plus optional Pallas kernels.
+
+Every public name resolves lazily (PEP 562): importing the bare package —
+or a jax-free subpackage like ``analysis`` via ``python -m
+yieldfactormodels_jl_tpu.analysis`` — must not pull jax (this container
+auto-registers the axon TPU plugin in every python process, so an eager jax
+import would put backend init one device-op away from dialing the TPU
+tunnel; the linter also wants its one-second startup).  The first access of
+any model/estimation name imports its home module, which imports jax.
 """
 
-from .config import (default_dtype, set_default_dtype,
-                     kalman_engine, set_kalman_engine, KALMAN_ENGINES)
-from .models.specs import ModelSpec
-from .models.registry import create_model, MODEL_CODES
-from .models import api as model_api
-from .models.api import (
-    get_params,
-    n_params,
-    get_param_groups,
-    get_static_model_type,
-    init_state,
-    get_loss,
-    get_loss_array,
-    predict,
-    forecast_density,
-    simulate,
-    smooth,
-    update_factor_loadings,
-    random_initial_params,
-)
-from .models.params import (
-    transform_params,
-    untransform_params,
-    expand_params,
-    get_unique_params,
-    get_new_initial_params,
-    initialize_with_static_params,
-)
-from .utils.data_management import load_data
+#: public name -> home module (relative); resolved on first attribute access
+_LAZY = {name: ".config" for name in (
+    "default_dtype", "set_default_dtype", "kalman_engine",
+    "set_kalman_engine", "KALMAN_ENGINES")}
+_LAZY["ModelSpec"] = ".models.specs"
+_LAZY.update({name: ".models.registry" for name in
+              ("create_model", "MODEL_CODES")})
+_LAZY.update({name: ".models.api" for name in (
+    "get_params", "n_params", "get_param_groups", "get_static_model_type",
+    "init_state", "get_loss", "get_loss_array", "predict",
+    "forecast_density", "simulate", "smooth", "update_factor_loadings",
+    "random_initial_params")})
+_LAZY.update({name: ".models.params" for name in (
+    "transform_params", "untransform_params", "expand_params",
+    "get_unique_params", "get_new_initial_params",
+    "initialize_with_static_params")})
+_LAZY["load_data"] = ".utils.data_management"
+_LAZY.update({name: ".estimation.optimize" for name in (
+    "compute_loss", "estimate", "estimate_steps", "try_initializations")})
+_LAZY["run_rolling_forecasts"] = ".forecasting"
+_LAZY["run"] = ".run"
+_LAZY["save_results"] = ".persistence.io"
+_LAZY.update({name: ".serving" for name in (
+    "YieldCurveService", "ServingSnapshot", "SnapshotRegistry",
+    "freeze_snapshot", "load_snapshot")})
+# "model_api" (the module itself, not an attribute of it) is special-cased
+# in __getattr__ below and deliberately absent from this table
 
-__all__ = [
-    "ModelSpec",
-    "create_model",
-    "MODEL_CODES",
-    "model_api",
-    "get_params",
-    "n_params",
-    "get_param_groups",
-    "get_static_model_type",
-    "init_state",
-    "get_loss",
-    "get_loss_array",
-    "predict",
-    "forecast_density",
-    "simulate",
-    "smooth",
-    "update_factor_loadings",
-    "random_initial_params",
-    "transform_params",
-    "untransform_params",
-    "expand_params",
-    "get_unique_params",
-    "get_new_initial_params",
-    "initialize_with_static_params",
-    "load_data",
-    "default_dtype",
-    "set_default_dtype",
-    "kalman_engine",
-    "set_kalman_engine",
-    "KALMAN_ENGINES",
-]
+#: subpackages reachable as plain attributes (``yfm.serving``) without an
+#: explicit submodule import at the call site
+_SUBMODULES = frozenset({
+    "analysis", "config", "estimation", "forecasting", "models", "ops",
+    "orchestration", "parallel", "persistence", "robustness", "run",
+    "serving", "utils",
+})
+
+__all__ = sorted(set(_LAZY) | {"model_api"})
 
 __version__ = "0.1.0"
 
-# Estimation / forecasting / persistence layers are imported lazily so the
-# core model zoo stays importable in minimal environments.
+
 def __getattr__(name):
-    if name in ("compute_loss", "estimate", "estimate_steps", "try_initializations"):
-        from .estimation import optimize as _opt
+    # importlib, not `from . import`: the latter re-enters this __getattr__
+    # through _handle_fromlist's hasattr and recurses
+    import importlib
 
-        return getattr(_opt, name)
-    if name == "run_rolling_forecasts":
-        from .forecasting import run_rolling_forecasts
-
-        return run_rolling_forecasts
-    if name == "run":
-        from .run import run
-
-        return run
-    if name == "save_results":
-        from .persistence.io import save_results
-
-        return save_results
-    if name in ("YieldCurveService", "ServingSnapshot", "SnapshotRegistry",
-                "freeze_snapshot", "load_snapshot", "serving"):
-        # importlib, not `from . import`: the latter re-enters this
-        # __getattr__ through _handle_fromlist's hasattr and recurses
-        import importlib
-
-        mod = importlib.import_module(".serving", __name__)
-        return mod if name == "serving" else getattr(mod, name)
+    if name == "model_api":
+        return importlib.import_module(".models.api", __name__)
+    home = _LAZY.get(name)
+    if home is not None:
+        mod = importlib.import_module(home, __name__)
+        value = getattr(mod, name)
+        globals()[name] = value  # cache: next access skips __getattr__
+        return value
+    if name in _SUBMODULES:
+        return importlib.import_module("." + name, __name__)
     raise AttributeError(name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__) | set(_SUBMODULES))

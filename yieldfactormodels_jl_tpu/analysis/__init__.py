@@ -1,0 +1,31 @@
+"""graftlint: rule-based AST static analysis for the repo's jit/TPU
+invariants (docs/DESIGN.md §15).
+
+One parse per file, shared scope/decorator/call-name resolution, named rules
+YFM001–YFM009, inline ``# yfmlint: disable=YFM00x -- reason`` pragmas, and a
+committed baseline for deliberately-kept findings.  Import-light on purpose:
+nothing in this package imports jax (enforced by
+tests/test_lint.py::test_engine_imports_without_jax), so the CLI runs in
+about a second on a CPU-only box without touching backend init.
+
+CLI: ``python -m yieldfactormodels_jl_tpu.analysis --format json|text
+[--changed-only]``.
+"""
+
+from .baseline import load_baseline, save_baseline
+from .engine import (Finding, JIT_ENTRY, JIT_WRAPPERS, LintConfig,
+                     LintResult, RULES, SourceModule, TRACE_BODY,
+                     TRACE_BODY_WRAPPERS, call_name, changed_files,
+                     detect_jit_contexts, dotted_name, enclosing_functions,
+                     func_depth, iter_py_files, names_reaching_return,
+                     parent_map, raised_name, rule, run_lint)
+from . import rules as rules  # registers YFM001–YFM009 on import
+
+__all__ = [
+    "Finding", "JIT_ENTRY", "JIT_WRAPPERS", "LintConfig", "LintResult",
+    "RULES", "SourceModule", "TRACE_BODY", "TRACE_BODY_WRAPPERS",
+    "call_name", "changed_files", "detect_jit_contexts", "dotted_name",
+    "enclosing_functions", "func_depth", "iter_py_files", "load_baseline",
+    "names_reaching_return", "parent_map", "raised_name", "rule", "rules",
+    "run_lint", "save_baseline",
+]

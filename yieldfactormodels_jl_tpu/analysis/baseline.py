@@ -1,0 +1,42 @@
+"""Committed lint baseline: grandfathered findings we deliberately keep.
+
+The baseline is a JSON file of ``RULE::file::line`` keys.  A finding whose
+key appears here is reported under ``baselined`` (visible, never actionable)
+so the zero-unsuppressed-findings CI gate stays green while the debt stays
+on the books.  ``--write-baseline`` regenerates it from the current
+unsuppressed findings; an empty baseline is the healthy steady state.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterable, Set
+
+VERSION = 1
+
+
+def load_baseline(path: str) -> Set[str]:
+    """Set of grandfathered finding keys (empty when the file is absent —
+    a missing baseline means nothing is grandfathered, not an error)."""
+    if not os.path.isfile(path):
+        return set()
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    if not isinstance(data, dict) or data.get("version") != VERSION:
+        raise ValueError(
+            f"unrecognized baseline format at {path} (want "
+            f'{{"version": {VERSION}, "entries": [...]}})')
+    return set(data.get("entries", []))
+
+
+def save_baseline(path: str, findings: Iterable) -> int:
+    """Atomically write the baseline from findings (tmp + os.replace — the
+    same publish discipline the linter enforces on everyone else)."""
+    entries = sorted({f.key() for f in findings})
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump({"version": VERSION, "entries": entries}, fh, indent=1)
+        fh.write("\n")
+    os.replace(tmp, path)
+    return len(entries)

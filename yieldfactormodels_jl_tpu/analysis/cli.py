@@ -1,0 +1,117 @@
+"""graftlint CLI: ``python -m yieldfactormodels_jl_tpu.analysis``.
+
+Exit codes: 0 = no unsuppressed/unbaselined findings, 1 = findings,
+2 = usage/parse errors.  ``--format json`` emits the machine schema
+(``version``/``counts``/``findings``/``suppressed``/``baselined``);
+``--changed-only`` restricts the file set to the git worktree diff
+(plus staged and untracked files) — the fast pre-commit mode.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import baseline as _baseline
+from .engine import LintConfig, RULES, changed_files, run_lint
+
+
+def _format_text(result, verbose: bool) -> str:
+    lines = []
+    for f in result.findings:
+        lines.append(f"{f.file}:{f.line}: {f.rule} {f.message}")
+    if verbose:
+        for f in result.suppressed:
+            reason = f.suppress_reason or "(no reason recorded)"
+            lines.append(f"{f.file}:{f.line}: {f.rule} suppressed by pragma "
+                         f"— {reason}")
+        for f in result.baselined:
+            lines.append(f"{f.file}:{f.line}: {f.rule} baselined")
+    lines.append(
+        f"graftlint: {len(result.findings)} finding(s), "
+        f"{len(result.suppressed)} suppressed, "
+        f"{len(result.baselined)} baselined, "
+        f"{result.files_scanned} files scanned")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m yieldfactormodels_jl_tpu.analysis",
+        description="graftlint: rule-based AST static analysis for the "
+                    "repo's jit/TPU invariants (docs/DESIGN.md §15)")
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--changed-only", action="store_true",
+                        help="lint only files changed vs git HEAD "
+                             "(worktree + staged + untracked)")
+    parser.add_argument("--root", default=None,
+                        help="repo root (default: autodetected from the "
+                             "installed package location)")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated rule ids to run "
+                             "(default: all)")
+    parser.add_argument("--baseline", default=None,
+                        help="baseline JSON path (default: "
+                             "<root>/.yfmlint-baseline.json)")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="grandfather the current unsuppressed findings "
+                             "into the baseline and exit 0")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule table and exit")
+    parser.add_argument("-v", "--verbose", action="store_true",
+                        help="also print suppressed/baselined findings")
+    args = parser.parse_args(argv)
+
+    config = LintConfig(root=args.root) if args.root else LintConfig()
+
+    if args.list_rules:
+        from . import rules as _rules  # noqa: F401  (registers RULES)
+        for r in sorted(RULES.values(), key=lambda r: r.id):
+            print(f"{r.id}  {r.name}: {r.summary}")
+        return 0
+
+    rule_ids = None
+    if args.rules:
+        from . import rules as _rules  # noqa: F401
+        rule_ids = [s.strip() for s in args.rules.split(",") if s.strip()]
+        unknown = [r for r in rule_ids if r not in RULES]
+        if unknown:
+            print(f"unknown rule id(s): {unknown}", file=sys.stderr)
+            return 2
+
+    files = None
+    if args.changed_only:
+        changed = changed_files(config.root)
+        if changed is None:
+            print("--changed-only: git diff failed (no git / not a repo / "
+                  "timeout) — refusing to lint an empty set", file=sys.stderr)
+            return 2
+        lintable = set(config.lint_files())
+        files = [f for f in changed if f in lintable]
+
+    baseline_path = args.baseline or config.abspath(config.baseline_path)
+    try:
+        baseline = _baseline.load_baseline(baseline_path)
+    except (ValueError, json.JSONDecodeError) as e:
+        print(f"bad baseline: {e}", file=sys.stderr)
+        return 2
+
+    result = run_lint(config, files=files, rules=rule_ids, baseline=baseline)
+
+    if args.write_baseline:
+        n = _baseline.save_baseline(baseline_path, result.findings)
+        print(f"graftlint: wrote {n} baseline entrie(s) to {baseline_path}")
+        return 0
+
+    if args.format == "json":
+        print(json.dumps(result.to_dict(), indent=1))
+    else:
+        print(_format_text(result, args.verbose))
+    if result.errors:
+        return 2
+    return 1 if result.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

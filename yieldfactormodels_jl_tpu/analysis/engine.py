@@ -1,0 +1,583 @@
+"""graftlint engine: one ``ast.parse`` per source file, many rules.
+
+The repo's correctness conventions (CLAUDE.md, docs/DESIGN.md §§4/14) used to
+be enforced by ad-hoc AST guards scattered across ``tests/test_conventions.py``
+and ``tests/test_env_knobs.py``, each with its own file walk, call-name
+resolution and non-vacuity boilerplate.  This module is the one shared
+implementation: a :class:`SourceModule` wraps a parsed file with cached
+parent/pragma/jit-context maps, :func:`run_lint` feeds every module through
+every registered rule (``rules.py``) exactly once, and findings flow through
+pragma suppression (``# yfmlint: disable=YFM00x -- reason``) and the committed
+baseline before anything is reported.
+
+Deliberately jax-free: the linter must be runnable in about a second on a
+CPU-only box without touching backend init (see the package ``__init__``'s
+lazy import table, which exists so ``python -m yieldfactormodels_jl_tpu
+.analysis`` never imports jax).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import subprocess
+import tokenize
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+# ---------------------------------------------------------------------------
+# shared AST resolution helpers (the layer tests/test_conventions.py used to
+# hand-roll; tests now import these)
+# ---------------------------------------------------------------------------
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def dotted_name(expr) -> str:
+    """Dotted name of a Name/Attribute chain: ``'os.environ.get'``; ``''``
+    for anything whose base is not a plain Name (subscripts, calls...)."""
+    parts = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def call_name(node: ast.Call) -> str:
+    """Dotted name of a Call's callee: 'time.sleep', 'queue.Queue', 'Queue'."""
+    return dotted_name(node.func)
+
+
+def raised_name(node: ast.Raise) -> Optional[str]:
+    """Class name a ``raise`` statement raises (last attribute segment), or
+    ``None`` for a bare ``raise`` / exotic expression."""
+    exc = node.exc
+    if isinstance(exc, ast.Call):
+        exc = exc.func
+    if isinstance(exc, ast.Name):
+        return exc.id
+    if isinstance(exc, ast.Attribute):
+        return exc.attr
+    return None
+
+
+def parent_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for parent in ast.walk(tree):
+        for child in ast.iter_child_nodes(parent):
+            parents[child] = parent
+    return parents
+
+
+def func_depth(node: ast.AST, parents: Dict[ast.AST, ast.AST]) -> int:
+    """Number of enclosing FunctionDef/AsyncFunctionDef/Lambda scopes."""
+    depth = 0
+    p = parents.get(node)
+    while p is not None:
+        if isinstance(p, _FUNC_NODES):
+            depth += 1
+        p = parents.get(p)
+    return depth
+
+
+def enclosing_functions(node: ast.AST,
+                        parents: Dict[ast.AST, ast.AST]) -> List[ast.AST]:
+    """Innermost-first chain of enclosing function scopes."""
+    chain = []
+    p = parents.get(node)
+    while p is not None:
+        if isinstance(p, _FUNC_NODES):
+            chain.append(p)
+        p = parents.get(p)
+    return chain
+
+
+def iter_py_files(root: str, *, exclude_dirs: Sequence[str] = ("__pycache__",)
+                  ) -> Iterable[str]:
+    """Sorted ``.py`` paths under ``root`` (deterministic walk order)."""
+    for dirpath, dirnames, names in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d not in exclude_dirs)
+        for name in sorted(names):
+            if name.endswith(".py"):
+                yield os.path.join(dirpath, name)
+
+
+# ---------------------------------------------------------------------------
+# jit-context detection
+# ---------------------------------------------------------------------------
+
+#: wrappers whose first functional argument is compiled as one program —
+#: a function handed to these is a jit context (decorator or call form)
+JIT_WRAPPERS = frozenset({
+    "jax.jit", "jit", "pjit", "jax.pmap", "pmap",
+    "jax.experimental.pjit.pjit",
+})
+
+#: wrappers whose body argument runs *traced* (inside someone's trace):
+#: scan/loop/branch bodies and vmapped closures — ``raise``/host calls there
+#: either fire spuriously at trace time or silently never fire at run time
+TRACE_BODY_WRAPPERS = frozenset({
+    "lax.scan", "jax.lax.scan", "lax.while_loop", "jax.lax.while_loop",
+    "lax.cond", "jax.lax.cond", "lax.fori_loop", "jax.lax.fori_loop",
+    "lax.switch", "jax.lax.switch", "lax.map", "jax.lax.map",
+    "jax.vmap", "vmap", "jax.checkpoint", "jax.remat", "shard_map",
+    "jax.grad", "jax.value_and_grad",
+})
+
+#: marker kinds: how a function entered the jit set (whitelisted trace-time
+#: validation raises are allowed at the top of a JIT-entry function, never
+#: inside a traced body function)
+JIT_ENTRY = "jit_entry"
+TRACE_BODY = "trace_body"
+ENCLOSED = "enclosed"
+
+
+def _decorator_marks(fn) -> bool:
+    for dec in fn.decorator_list:
+        target = dec
+        if isinstance(dec, ast.Call):
+            name = dotted_name(dec.func)
+            if name in JIT_WRAPPERS:
+                return True
+            if name.split(".")[-1] == "partial" and dec.args:
+                target = dec.args[0]
+        if dotted_name(target) in JIT_WRAPPERS:
+            return True
+    return False
+
+
+def detect_jit_contexts(tree: ast.AST,
+                        parents: Dict[ast.AST, ast.AST]
+                        ) -> Dict[ast.AST, str]:
+    """Map of function nodes → marker kind (:data:`JIT_ENTRY` /
+    :data:`TRACE_BODY` / :data:`ENCLOSED`).
+
+    Detection is syntactic and local to one module: ``@jax.jit``-family
+    decorators (incl. ``@partial(jax.jit, ...)``), functions/lambdas passed by
+    name or inline to ``jax.jit(...)``/``pjit``/``pmap`` (jit entries) and to
+    ``lax.scan``/``while_loop``/``cond``/``vmap``/... (traced bodies), plus
+    every function *nested inside* a marked one (closures run traced too).
+    """
+    marked: Dict[ast.AST, str] = {}
+    defs_by_name: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+            if _decorator_marks(node):
+                marked[node] = JIT_ENTRY
+
+    def mark(expr, kind):
+        if isinstance(expr, ast.Lambda):
+            marked.setdefault(expr, kind)
+        elif isinstance(expr, ast.Name):
+            for d in defs_by_name.get(expr.id, ()):
+                marked.setdefault(d, kind)
+        elif isinstance(expr, (ast.Tuple, ast.List)):
+            for el in expr.elts:       # lax.switch branch lists
+                mark(el, kind)
+
+    def is_function_valued(expr) -> bool:
+        if isinstance(expr, ast.Lambda):
+            return True
+        if isinstance(expr, ast.Name):
+            return expr.id in defs_by_name
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return any(is_function_valued(el) for el in expr.elts)
+        return False
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        if name in JIT_WRAPPERS and node.args:
+            mark(node.args[0], JIT_ENTRY)
+        elif name in TRACE_BODY_WRAPPERS:
+            # the traced callable is not always args[0]: cond's branches are
+            # args[1:3], fori_loop's body is args[2], while_loop traces BOTH
+            # cond_fun and body_fun, switch takes a branch list — so mark
+            # every function-valued argument (incl. keywords) conservatively
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                if is_function_valued(arg):
+                    mark(arg, TRACE_BODY)
+
+    # closure rule: everything defined inside a marked function runs traced
+    for node in ast.walk(tree):
+        if isinstance(node, _FUNC_NODES) and node not in marked:
+            if any(p in marked for p in enclosing_functions(node, parents)):
+                marked[node] = ENCLOSED
+    return marked
+
+
+# ---------------------------------------------------------------------------
+# donation data-flow: which names can reach a function's outputs
+# ---------------------------------------------------------------------------
+
+def names_reaching_return(fn) -> set:
+    """Over-approximate set of local names whose value can flow into the
+    function's return value (backward reachability through assignments).
+
+    Seeds with every Name under a ``return`` (for a Lambda: the body), then
+    closes over assignment edges: if an assigned target (including a
+    subscript/attribute base like ``out["losses"]``) is reachable, every name
+    on the right-hand side becomes reachable.  Used by the donation-aliasing
+    rule: a donated parameter whose name never reaches an output is the
+    silent-drop shape XLA discards (docs/DESIGN.md §14).
+    """
+    def expr_names(e) -> set:
+        return {n.id for n in ast.walk(e) if isinstance(n, ast.Name)}
+
+    if isinstance(fn, ast.Lambda):
+        return expr_names(fn.body)
+
+    reach: set = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Return) and node.value is not None:
+            reach |= expr_names(node.value)
+
+    def target_names(t) -> set:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            out = set()
+            for el in t.elts:
+                out |= target_names(el)
+            return out
+        if isinstance(t, ast.Starred):
+            return target_names(t.value)
+        if isinstance(t, (ast.Subscript, ast.Attribute)):
+            base = dotted_name(t.value if not isinstance(t.value, ast.Subscript)
+                               else t.value.value)
+            return {base.split(".")[0]} if base else set()
+        if isinstance(t, ast.Name):
+            return {t.id}
+        return set()
+
+    edges: List[Tuple[set, set]] = []  # (targets, rhs names)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            tnames = set()
+            for t in node.targets:
+                tnames |= target_names(t)
+            edges.append((tnames, expr_names(node.value)))
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            if node.value is not None:
+                edges.append((target_names(node.target),
+                              expr_names(node.value)))
+        elif isinstance(node, ast.NamedExpr):
+            edges.append((target_names(node.target), expr_names(node.value)))
+        elif isinstance(node, ast.For):
+            edges.append((target_names(node.target), expr_names(node.iter)))
+
+    changed = True
+    while changed:
+        changed = False
+        for targets, rhs in edges:
+            if targets & reach and not rhs <= reach:
+                reach |= rhs
+                changed = True
+    return reach
+
+
+# ---------------------------------------------------------------------------
+# findings, pragmas, modules
+# ---------------------------------------------------------------------------
+
+PRAGMA_RE = re.compile(
+    r"yfmlint:\s*disable=([A-Z]{3}\d{3}(?:\s*,\s*[A-Z]{3}\d{3})*)"
+    r"(?:\s*--\s*(.+?))?\s*$")
+
+
+@dataclass
+class Finding:
+    rule: str
+    file: str            # repo-relative path
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+    suppress_reason: Optional[str] = None  # pragma reason ('' if none given)
+    baselined: bool = False
+
+    def key(self) -> str:
+        return f"{self.rule}::{self.file}::{self.line}"
+
+    def to_dict(self) -> dict:
+        d = {"rule": self.rule, "file": self.file, "line": self.line,
+             "col": self.col, "message": self.message}
+        if self.suppressed:
+            d["suppressed"] = True
+            d["suppress_reason"] = self.suppress_reason
+        if self.baselined:
+            d["baselined"] = True
+        return d
+
+
+class SourceModule:
+    """One parsed source file with lazily-built, shared resolution maps —
+    every rule sees the same single ``ast.parse``."""
+
+    def __init__(self, path: str, rel: str, source: Optional[str] = None):
+        self.path = path
+        self.rel = rel
+        if source is None:
+            with open(path, encoding="utf-8") as fh:
+                source = fh.read()
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self._parents: Optional[Dict[ast.AST, ast.AST]] = None
+        self._pragmas: Optional[Dict[int, Tuple[frozenset, str]]] = None
+        self._jit: Optional[Dict[ast.AST, str]] = None
+
+    @property
+    def parents(self) -> Dict[ast.AST, ast.AST]:
+        if self._parents is None:
+            self._parents = parent_map(self.tree)
+        return self._parents
+
+    @property
+    def jit_contexts(self) -> Dict[ast.AST, str]:
+        if self._jit is None:
+            self._jit = detect_jit_contexts(self.tree, self.parents)
+        return self._jit
+
+    def func_depth(self, node) -> int:
+        return func_depth(node, self.parents)
+
+    def jit_marker(self, node) -> Optional[Tuple[ast.AST, str]]:
+        """(outermost-marked-scope, marker-kind) when ``node`` sits inside a
+        detected jit context, else ``None``."""
+        chain = enclosing_functions(node, self.parents)
+        for fn in reversed(chain):        # outermost first
+            kind = self.jit_contexts.get(fn)
+            if kind is not None:
+                return fn, kind
+        return None
+
+    @property
+    def pragmas(self) -> Dict[int, Tuple[frozenset, str]]:
+        """line → (rule ids disabled on that line, recorded reason).
+
+        A pragma comment applies to its own line; a pragma on a standalone
+        comment line also covers the line directly below it (the usual
+        "comment above the offending statement" placement).
+        """
+        if self._pragmas is None:
+            pragmas: Dict[int, Tuple[frozenset, str]] = {}
+            try:
+                toks = list(tokenize.generate_tokens(
+                    io.StringIO(self.source).readline))
+            except tokenize.TokenError:
+                toks = []
+            lines = self.source.splitlines()
+            for tok in toks:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = PRAGMA_RE.search(tok.string)
+                if not m:
+                    continue
+                ids = frozenset(s.strip() for s in m.group(1).split(","))
+                reason = (m.group(2) or "").strip()
+                line = tok.start[0]
+                pragmas[line] = (ids | pragmas.get(line, (frozenset(), ""))[0],
+                                 reason)
+                text = lines[line - 1] if line <= len(lines) else ""
+                if text.strip().startswith("#"):  # standalone comment line
+                    nxt = line + 1
+                    pragmas[nxt] = (
+                        ids | pragmas.get(nxt, (frozenset(), ""))[0], reason)
+            self._pragmas = pragmas
+        return self._pragmas
+
+    def suppression_for(self, finding: Finding):
+        entry = self.pragmas.get(finding.line)
+        if entry and finding.rule in entry[0]:
+            return entry[1]
+        return None
+
+
+# ---------------------------------------------------------------------------
+# config + rule registry
+# ---------------------------------------------------------------------------
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+@dataclass
+class LintConfig:
+    """File sets and repo anchors the rules resolve against (all paths
+    repo-root-relative; tests point ``root`` at fixture trees)."""
+
+    root: str = field(default_factory=_repo_root)
+    package: str = "yieldfactormodels_jl_tpu"
+    #: kernel modules under the historical sentinel guard: every *nested*
+    #: function there is treated as a traced body (scan/kernel closures)
+    kernel_globs: Tuple[str, ...] = (
+        "yieldfactormodels_jl_tpu/ops/*.py",
+        "yieldfactormodels_jl_tpu/serving/online.py",
+        "yieldfactormodels_jl_tpu/estimation/scenario.py",
+    )
+    serving_dir: str = "yieldfactormodels_jl_tpu/serving"
+    atomic_dirs: Tuple[str, ...] = (
+        "yieldfactormodels_jl_tpu/orchestration",
+        "yieldfactormodels_jl_tpu/persistence",
+    )
+    bench_files: Tuple[str, ...] = ("bench.py", "benchmarks/*.py")
+    tests_dir: str = "tests"
+    claude_md: str = "CLAUDE.md"
+    config_module: str = "yieldfactormodels_jl_tpu/config.py"
+    reference_root: str = "/root/reference"
+    raise_whitelist: frozenset = frozenset(
+        {"ValueError", "TypeError", "NotImplementedError", "AttributeError"})
+    baseline_path: str = ".yfmlint-baseline.json"
+
+    def abspath(self, rel: str) -> str:
+        return os.path.join(self.root, rel)
+
+    def matches(self, rel: str, patterns: Sequence[str]) -> bool:
+        import fnmatch
+        rel = rel.replace(os.sep, "/")
+        return any(fnmatch.fnmatch(rel, p) for p in patterns)
+
+    def is_kernel(self, rel: str) -> bool:
+        return self.matches(rel, self.kernel_globs)
+
+    def in_package(self, rel: str) -> bool:
+        return rel.replace(os.sep, "/").startswith(self.package + "/")
+
+    def lint_files(self) -> List[str]:
+        """The default linted set: the package + the bench layer (bench-only
+        code obeys the same conventions, notably knob documentation)."""
+        rels: List[str] = []
+        pkg = self.abspath(self.package)
+        for path in iter_py_files(pkg):
+            rels.append(os.path.relpath(path, self.root))
+        for pattern in self.bench_files:
+            import glob as _glob
+            for path in sorted(_glob.glob(self.abspath(pattern))):
+                if path.endswith(".py"):
+                    rels.append(os.path.relpath(path, self.root))
+        return rels
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    name: str
+    summary: str
+    scope: str                      # 'module' | 'project'
+    fn: Callable
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def rule(rule_id: str, name: str, summary: str, scope: str = "module"):
+    """Register a rule.  ``scope='module'`` rules run once per
+    :class:`SourceModule` as ``fn(module, config) -> iterable[Finding]``;
+    ``scope='project'`` rules run once per lint pass as
+    ``fn(modules, config) -> iterable[Finding]``."""
+    def wrap(fn):
+        RULES[rule_id] = Rule(rule_id, name, summary, scope, fn)
+        return fn
+    return wrap
+
+
+# ---------------------------------------------------------------------------
+# the run
+# ---------------------------------------------------------------------------
+
+@dataclass
+class LintResult:
+    findings: List[Finding] = field(default_factory=list)    # actionable
+    suppressed: List[Finding] = field(default_factory=list)  # pragma'd
+    baselined: List[Finding] = field(default_factory=list)   # grandfathered
+    files_scanned: int = 0
+    errors: List[str] = field(default_factory=list)          # unparseable
+
+    def to_dict(self) -> dict:
+        return {
+            "version": 1,
+            "files_scanned": self.files_scanned,
+            "counts": {"findings": len(self.findings),
+                       "suppressed": len(self.suppressed),
+                       "baselined": len(self.baselined)},
+            "findings": [f.to_dict() for f in self.findings],
+            "suppressed": [f.to_dict() for f in self.suppressed],
+            "baselined": [f.to_dict() for f in self.baselined],
+            "errors": list(self.errors),
+        }
+
+
+def changed_files(root: str) -> Optional[List[str]]:
+    """Repo-relative paths touched vs HEAD (worktree + staged + untracked) —
+    the ``--changed-only`` file set.  Returns ``None`` when git itself fails
+    (missing binary, timeout, not a repo): "couldn't diff" must stay
+    distinguishable from "nothing changed", or a broken pre-commit hook
+    green-lights every diff."""
+    rels: set = set()
+    for args in (["git", "diff", "--name-only", "HEAD"],
+                 ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            out = subprocess.run(args, cwd=root, capture_output=True,
+                                 text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if out.returncode != 0:
+            return None
+        rels |= {ln.strip() for ln in out.stdout.splitlines() if ln.strip()}
+    return sorted(rels)
+
+
+def run_lint(config: Optional[LintConfig] = None,
+             files: Optional[Sequence[str]] = None,
+             rules: Optional[Sequence[str]] = None,
+             baseline: Optional[set] = None) -> LintResult:
+    """Parse each file once, run the selected rules, partition findings into
+    actionable / pragma-suppressed / baselined."""
+    from . import rules as _rules  # noqa: F401  (registers RULES on import)
+
+    config = config or LintConfig()
+    rels = list(files) if files is not None else config.lint_files()
+    selected = [RULES[r] for r in rules] if rules is not None \
+        else list(RULES.values())
+    baseline = baseline or set()
+
+    result = LintResult()
+    modules: List[SourceModule] = []
+    for rel in rels:
+        path = config.abspath(rel)
+        if not os.path.isfile(path):
+            continue
+        try:
+            modules.append(SourceModule(path, rel.replace(os.sep, "/")))
+        except SyntaxError as e:
+            result.errors.append(f"{rel}: {e}")
+    result.files_scanned = len(modules)
+
+    raw: List[Tuple[Finding, Optional[SourceModule]]] = []
+    for r in selected:
+        if r.scope == "module":
+            for mod in modules:
+                for f in r.fn(mod, config):
+                    raw.append((f, mod))
+        else:
+            for f in r.fn(modules, config):
+                mod = next((m for m in modules if m.rel == f.file), None)
+                raw.append((f, mod))
+
+    for f, mod in sorted(raw, key=lambda p: (p[0].file, p[0].line, p[0].rule)):
+        reason = mod.suppression_for(f) if mod is not None else None
+        if reason is not None:
+            f.suppressed, f.suppress_reason = True, reason
+            result.suppressed.append(f)
+        elif f.key() in baseline:
+            f.baselined = True
+            result.baselined.append(f)
+        else:
+            result.findings.append(f)
+    return result

@@ -1,0 +1,566 @@
+"""graftlint rules YFM001–YFM009 (rule table in docs/DESIGN.md §15).
+
+Each rule is a small function over a parsed :class:`~.engine.SourceModule`
+(or the whole module list for project-scope rules) registered via
+:func:`~.engine.rule`.  Rules only *report* — suppression (pragmas) and
+grandfathering (baseline) are the engine's job, so a rule never needs its
+own escape hatch.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from functools import lru_cache
+from typing import Iterable, List
+
+from .engine import (Finding, JIT_ENTRY, LintConfig, SourceModule, call_name,
+                     dotted_name, enclosing_functions, iter_py_files,
+                     names_reaching_return, raised_name, rule)
+
+
+def _finding(rule_id: str, mod: SourceModule, node, message: str) -> Finding:
+    return Finding(rule_id, mod.rel, getattr(node, "lineno", 1),
+                   getattr(node, "col_offset", 0), message)
+
+
+# ---------------------------------------------------------------------------
+# YFM001 — sentinel discipline
+# ---------------------------------------------------------------------------
+
+@rule("YFM001", "sentinel-discipline",
+      "no `raise` reachable inside kernel/scan bodies — failures are "
+      "sentinels (−Inf loss, NaN moments) plus a taxonomy code")
+def yfm001_sentinel_discipline(mod: SourceModule,
+                               config: LintConfig) -> Iterable[Finding]:
+    if not config.in_package(mod.rel):
+        return
+    kernel = config.is_kernel(mod.rel)
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Raise):
+            continue
+        depth = mod.func_depth(node)
+        name = raised_name(node)
+        if kernel:
+            # historical kernel-module semantics (tests/test_conventions.py):
+            # any nested raise is a traced-body raise; top-level raises must
+            # be trace-time validation classes
+            if depth >= 2:
+                yield _finding(
+                    "YFM001", mod, node,
+                    "raise inside a nested function (scan/kernel body) — "
+                    "use the −Inf/NaN sentinel + taxonomy code instead")
+            elif name not in config.raise_whitelist:
+                yield _finding(
+                    "YFM001", mod, node,
+                    f"raises {name or '<bare>'} — only trace-time validation "
+                    f"({sorted(config.raise_whitelist)}) is allowed in "
+                    f"kernel modules")
+            continue
+        marker = mod.jit_marker(node)
+        if marker is None:
+            continue
+        scope, kind = marker
+        # a whitelisted validation raise sitting directly in a JIT-entry
+        # function fires at trace time (shape/config checks) — allowed;
+        # anything inside a traced body, nested closure, or of a
+        # non-whitelisted class is a sentinel violation
+        immediate = mod.func_depth(node) == mod.func_depth(scope) + 1 \
+            if not isinstance(scope, ast.Lambda) else False
+        if kind == JIT_ENTRY and immediate and name in config.raise_whitelist:
+            continue
+        yield _finding(
+            "YFM001", mod, node,
+            f"raise {name or '<bare>'} inside a jit context "
+            f"({kind}) — failures inside traced code must be sentinels "
+            f"(−Inf/NaN + taxonomy code), not exceptions")
+
+
+# ---------------------------------------------------------------------------
+# YFM002 — donation aliasing (docs/DESIGN.md §14)
+# ---------------------------------------------------------------------------
+
+def _donated_indices(expr, scope=None) -> List[int]:
+    """Constant indices named by a ``donate_argnums=`` value, unioned across
+    conditional branches (``(1, 2) if donate else ()``).  A ``tuple(name)``
+    /bare ``name`` spec is resolved against ``scope`` (the enclosing
+    function/module) by unioning the name's literal list assignments and
+    ``name.append(<const>)`` calls — the scenario-lattice build-a-list
+    idiom; an over-approximation is fine (extra indices only tighten the
+    check)."""
+    out: List[int] = []
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, int):
+        out.append(expr.value)
+    elif isinstance(expr, (ast.Tuple, ast.List)):
+        for el in expr.elts:
+            out.extend(_donated_indices(el, scope))
+    elif isinstance(expr, ast.IfExp):
+        out.extend(_donated_indices(expr.body, scope))
+        out.extend(_donated_indices(expr.orelse, scope))
+    elif isinstance(expr, ast.Call) and dotted_name(expr.func) in (
+            "tuple", "list") and len(expr.args) == 1:
+        out.extend(_donated_indices(expr.args[0], scope))
+    elif isinstance(expr, ast.Name) and scope is not None:
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == expr.id
+                    for t in node.targets) and \
+                    isinstance(node.value, (ast.Tuple, ast.List)):
+                out.extend(_donated_indices(node.value))
+            elif isinstance(node, ast.Call) and \
+                    dotted_name(node.func) == f"{expr.id}.append":
+                for a in node.args:
+                    out.extend(_donated_indices(a))
+    return out
+
+
+def _local_defs(mod: SourceModule):
+    defs = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, node)
+    return defs
+
+
+def _check_donation(mod, site_node, fn, indices) -> Iterable[Finding]:
+    args = fn.args
+    params = [a.arg for a in args.posonlyargs + args.args]
+    reach = names_reaching_return(fn)
+    for idx in sorted(set(indices)):
+        if idx >= len(params):
+            yield _finding(
+                "YFM002", mod, site_node,
+                f"donate_argnums index {idx} is out of range for "
+                f"{getattr(fn, 'name', '<lambda>')}({', '.join(params)})")
+            continue
+        pname = params[idx]
+        if pname not in reach:
+            yield _finding(
+                "YFM002", mod, site_node,
+                f"donated argument {idx} ({pname!r}) never flows into a "
+                f"returned value of {getattr(fn, 'name', '<lambda>')} — "
+                f"XLA will silently drop the donation (no aliasing, no "
+                f"reuse); pass it through to a shape-matched output "
+                f"(docs/DESIGN.md §14)")
+
+
+def _donate_kw(call: ast.Call):
+    return next((k for k in call.keywords
+                 if k.arg in ("donate_argnums", "donate_argnames")), None)
+
+
+@rule("YFM002", "donation-aliasing",
+      "every donate_argnums input must flow into an output — XLA silently "
+      "drops a donated buffer whose contents are dead")
+def yfm002_donation_aliasing(mod: SourceModule,
+                             config: LintConfig) -> Iterable[Finding]:
+    if not config.in_package(mod.rel):
+        return
+    defs = None
+    for node in ast.walk(mod.tree):
+        # decorator form: @partial(jax.jit, donate_argnums=...) / @jax.jit(...)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call) and _donate_kw(dec) is not None:
+                    indices = _donated_indices(_donate_kw(dec).value,
+                                               scope=mod.tree)
+                    yield from _check_donation(mod, dec, node, indices)
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        kw = _donate_kw(node)
+        if kw is None or not node.args:
+            continue
+        # resolve a dynamic spec (a Name / tuple(name) built with literal
+        # appends) against the innermost enclosing function, else the module
+        chain = enclosing_functions(node, mod.parents)
+        scope = chain[0] if chain else mod.tree
+        indices = _donated_indices(kw.value, scope=scope)
+        target = node.args[0]
+        fn = None
+        if isinstance(target, ast.Lambda):
+            fn = target
+        elif isinstance(target, ast.Name):
+            if defs is None:
+                defs = _local_defs(mod)
+            fn = defs.get(target.id)
+        if fn is None:
+            continue  # non-local callee: not analyzable statically
+        yield from _check_donation(mod, node, fn, indices)
+
+
+# ---------------------------------------------------------------------------
+# YFM003 — engine-cache idiom order
+# ---------------------------------------------------------------------------
+
+def _dec_name(dec) -> str:
+    target = dec.func if isinstance(dec, ast.Call) else dec
+    return dotted_name(target).split(".")[-1]
+
+
+@rule("YFM003", "cache-idiom-order",
+      "@register_engine_cache must sit directly above @lru_cache so the "
+      "registrar holds the cache-clearable wrapper")
+def yfm003_cache_idiom(mod: SourceModule,
+                       config: LintConfig) -> Iterable[Finding]:
+    if not config.in_package(mod.rel):
+        return
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        names = [_dec_name(d) for d in node.decorator_list]
+        if "register_engine_cache" not in names:
+            continue
+        reg = names.index("register_engine_cache")
+        if "lru_cache" not in names:
+            yield _finding(
+                "YFM003", mod, node,
+                f"{node.name}: @register_engine_cache without @lru_cache — "
+                f"the registrar must receive a cache_clear-able wrapper")
+        elif names.index("lru_cache") < reg:
+            yield _finding(
+                "YFM003", mod, node,
+                f"{node.name}: decorator order is @lru_cache above "
+                f"@register_engine_cache — swap them (cache under the "
+                f"registrar) or engine switches leave stale traces alive")
+
+
+# ---------------------------------------------------------------------------
+# YFM004 — host impurity inside jit contexts
+# ---------------------------------------------------------------------------
+
+#: host-side calls that burn into the trace (stale value) or fire once per
+#: trace instead of once per run — banned inside jit contexts
+_HOST_CALLS = frozenset({
+    "print", "input", "open",
+    "time.time", "time.perf_counter", "time.monotonic", "time.process_time",
+    "time.sleep", "os.getenv", "os.urandom", "datetime.now",
+    "datetime.datetime.now", "datetime.utcnow", "datetime.datetime.utcnow",
+})
+_HOST_PREFIXES = ("np.random.", "numpy.random.", "random.")
+#: the documented trace-counter idiom (config.make_trace_counter): ONE host
+#: call at the top of a to-be-jitted body, counting actual (re)traces
+_ALLOWED = frozenset({"note_trace"})
+
+
+@rule("YFM004", "host-impurity-in-jit",
+      "no host-side effects (time/np.random/print/os.environ) inside jitted "
+      "bodies — they burn into the trace instead of running per call")
+def yfm004_host_impurity(mod: SourceModule,
+                         config: LintConfig) -> Iterable[Finding]:
+    if not config.in_package(mod.rel):
+        return
+    kernel = config.is_kernel(mod.rel)
+
+    def in_context(node) -> bool:
+        if mod.jit_marker(node) is not None:
+            return True
+        # kernel modules: every nested function is a traced body
+        return kernel and mod.func_depth(node) >= 2
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if not name or name.split(".")[-1] in _ALLOWED:
+                continue
+            if name in _HOST_CALLS or name.startswith(_HOST_PREFIXES):
+                if in_context(node):
+                    yield _finding(
+                        "YFM004", mod, node,
+                        f"host call {name}() inside a jit context — its "
+                        f"value/effect is frozen at trace time; hoist it to "
+                        f"the driver layer")
+        elif isinstance(node, ast.Attribute):
+            if dotted_name(node) == "os.environ" and in_context(node):
+                yield _finding(
+                    "YFM004", mod, node,
+                    "os.environ read inside a jit context — env knobs are "
+                    "trace-time constants; read them in the builder, not "
+                    "the traced body")
+
+
+# ---------------------------------------------------------------------------
+# YFM005 — atomic publish (tmp + os.replace)
+# ---------------------------------------------------------------------------
+
+_WRITE_MODE = re.compile(r"[wax]")
+
+
+def _is_write_channel(node: ast.Call) -> bool:
+    name = call_name(node)
+    if name.split(".")[-1] == "savetxt":
+        return True
+    if name.split(".")[-1] in ("write_text", "write_bytes"):
+        return True
+    if name.split(".")[-1] == "open" or name == "open":
+        mode = None
+        if len(node.args) >= 2:
+            mode = node.args[1]
+        for k in node.keywords:
+            if k.arg == "mode":
+                mode = k.value
+        if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+            return bool(_WRITE_MODE.search(mode.value))
+        return False
+    return False
+
+
+def _write_target(node: ast.Call):
+    """The path expression a write channel writes to."""
+    tail = call_name(node).split(".")[-1]
+    if tail in ("write_text", "write_bytes"):
+        return node.func.value  # the path object
+    return node.args[0] if node.args else None
+
+
+def _expr_tokens(expr):
+    """(names, string constants) appearing anywhere in an expression."""
+    names = {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+    names |= {n.attr for n in ast.walk(expr) if isinstance(n, ast.Attribute)}
+    strs = [n.value for n in ast.walk(expr)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)]
+    return names, strs
+
+
+@rule("YFM005", "atomic-publish",
+      "writes under orchestration/ and persistence/ publish via "
+      "writer-unique tmp + os.replace — a torn file must be unobservable")
+def yfm005_atomic_publish(mod: SourceModule,
+                          config: LintConfig) -> Iterable[Finding]:
+    rel = mod.rel.replace(os.sep, "/")
+    if not any(rel.startswith(d + "/") for d in config.atomic_dirs):
+        return
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call) and _is_write_channel(node)):
+            continue
+        funcs = enclosing_functions(node, mod.parents)
+        # the WRITTEN path must be the buffer a same-function os.replace/
+        # os.link later publishes (name overlap with the publish's source
+        # arg, or a visibly tmp-suffixed expression) — an unrelated atomic
+        # publish elsewhere in the function must not vouch for this write
+        publish_names: set = set()
+        for fn in funcs[:1]:  # innermost enclosing function owns the publish
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Call) and sub.args and \
+                        call_name(sub) in ("os.replace", "os.link"):
+                    publish_names |= _expr_tokens(sub.args[0])[0]
+        target = _write_target(node)
+        target_ok = False
+        if target is not None:
+            names, strs = _expr_tokens(target)
+            target_ok = bool(names & publish_names) or \
+                any("tmp" in n.lower() for n in names) or \
+                any(".tmp" in s for s in strs)
+        if not funcs or not publish_names or not target_ok:
+            yield _finding(
+                "YFM005", mod, node,
+                f"{call_name(node)}() writes a shard/DB/artifact path that "
+                f"is not a tmp buffer published by a same-function "
+                f"os.replace — build in a writer-unique tmp file and "
+                f"publish atomically (tmp + os.replace)")
+
+
+# ---------------------------------------------------------------------------
+# YFM006 — env knobs documented in CLAUDE.md
+# ---------------------------------------------------------------------------
+
+_YFM_KNOB = re.compile(r"\bYFM_[A-Z0-9_]+\b")
+_BENCH_KNOB = re.compile(r"\bBENCH_[A-Z0-9_]+\b")
+
+
+def claude_md_text(config: LintConfig) -> str:
+    path = config.abspath(config.claude_md)
+    if not os.path.isfile(path):
+        return ""
+    # memoize on (path, mtime): one read per lint pass instead of one per
+    # module, while fixture tests that rewrite the doc stay correct
+    return _read_cached(path, os.stat(path).st_mtime_ns)
+
+
+@lru_cache(maxsize=8)
+def _read_cached(path: str, _mtime_ns: int) -> str:
+    with open(path, encoding="utf-8") as fh:
+        return fh.read()
+
+
+def knob_occurrences(mod: SourceModule, bench: bool):
+    """(knob, lineno) pairs for every YFM_* — and, in bench-layer files,
+    BENCH_* — name in the source (comments and strings included: a knob
+    mentioned anywhere must be discoverable in CLAUDE.md)."""
+    for i, line in enumerate(mod.source.splitlines(), start=1):
+        for m in _YFM_KNOB.finditer(line):
+            yield m.group(0), i
+        if bench:
+            for m in _BENCH_KNOB.finditer(line):
+                yield m.group(0), i
+
+
+@rule("YFM006", "env-knob-docs",
+      "every YFM_*/BENCH_* knob referenced in source must be documented in "
+      "CLAUDE.md — an undocumented knob is a silent behavior switch")
+def yfm006_env_knob_docs(mod: SourceModule,
+                         config: LintConfig) -> Iterable[Finding]:
+    rel = mod.rel.replace(os.sep, "/")
+    bench = config.matches(rel, config.bench_files)
+    if not (bench or config.in_package(rel)):
+        return
+    # exact-token membership, not substring containment: a knob that is a
+    # proper prefix of a documented one (e.g. the lock knob vs its _TTL
+    # variant) must not pass on the longer name's substring
+    doc = claude_md_text(config)
+    documented = set(_YFM_KNOB.findall(doc)) | set(_BENCH_KNOB.findall(doc))
+    seen = set()  # report each undocumented knob once per file
+    for knob, line in knob_occurrences(mod, bench):
+        if knob in documented or knob in seen:
+            continue
+        seen.add(knob)
+        bullet = ("the Benchmarks bullet in CLAUDE.md's Commands"
+                  if knob.startswith("BENCH_")
+                  else "the env-knob bullets in CLAUDE.md's Conventions")
+        yield Finding("YFM006", mod.rel, line, 0,
+                      f"undocumented env knob {knob} — add it to {bullet}")
+
+
+# ---------------------------------------------------------------------------
+# YFM007 — every registered engine has oracle-backed parity coverage
+# ---------------------------------------------------------------------------
+
+def kalman_engines_static(config: LintConfig):
+    """(engines tuple, lineno) parsed from config.py's AST — the linter must
+    not import the package (that would pull jax)."""
+    path = config.abspath(config.config_module)
+    if not os.path.isfile(path):
+        return (), 1
+    with open(path, encoding="utf-8") as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "KALMAN_ENGINES"
+                for t in node.targets):
+            if isinstance(node.value, (ast.Tuple, ast.List)):
+                vals = tuple(el.value for el in node.value.elts
+                             if isinstance(el, ast.Constant))
+                return vals, node.lineno
+    return (), 1
+
+
+def oracle_backed_test_strings(config: LintConfig):
+    """test-module name → set of string constants, for every test module
+    that imports tests/oracle.py (the independent NumPy loops every numeric
+    kernel must be pinned against)."""
+    tdir = config.abspath(config.tests_dir)
+    out = {}
+    if not os.path.isdir(tdir):
+        return out
+    for path in iter_py_files(tdir):
+        name = os.path.basename(path)
+        if not (name.startswith("test_") and name.endswith(".py")):
+            continue
+        with open(path, encoding="utf-8") as fh:
+            try:
+                tree = ast.parse(fh.read(), filename=path)
+            except SyntaxError:
+                continue
+        uses_oracle = False
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and node.module and (
+                    node.module.split(".")[-1] == "oracle"
+                    or any(a.name == "oracle" for a in node.names)):
+                uses_oracle = True
+            if isinstance(node, ast.Import) and any(
+                    a.name.split(".")[-1] == "oracle" for a in node.names):
+                uses_oracle = True
+        if uses_oracle:
+            out[name] = {n.value for n in ast.walk(tree)
+                         if isinstance(n, ast.Constant)
+                         and isinstance(n.value, str)}
+    return out
+
+
+@rule("YFM007", "engine-oracle-parity",
+      "every config.KALMAN_ENGINES entry must be named in an "
+      "oracle-importing test module — no engine ships without parity",
+      scope="project")
+def yfm007_engine_parity(modules, config: LintConfig) -> Iterable[Finding]:
+    engines, lineno = kalman_engines_static(config)
+    if not engines:
+        return
+    strings = oracle_backed_test_strings(config)
+    for engine in engines:
+        if not any(engine in ss for ss in strings.values()):
+            yield Finding(
+                "YFM007", config.config_module, lineno, 0,
+                f"engine {engine!r} has no oracle-backed parity coverage — "
+                f"add a parity test against tests/oracle.py that names it "
+                f"(see test_assoc_estimation.test_engine_oracle_parity_"
+                f"with_nan_gap)")
+
+
+# ---------------------------------------------------------------------------
+# YFM008 — request-path hygiene (DESIGN §12)
+# ---------------------------------------------------------------------------
+
+_UNBOUNDED_QUEUES = ("queue.Queue", "Queue", "queue.LifoQueue",
+                     "queue.PriorityQueue", "queue.SimpleQueue")
+
+
+@rule("YFM008", "request-path-hygiene",
+      "no unbounded queue.Queue() and no bare time.sleep under serving/ — "
+      "backpressure must not regress silently")
+def yfm008_request_path(mod: SourceModule,
+                        config: LintConfig) -> Iterable[Finding]:
+    rel = mod.rel.replace(os.sep, "/")
+    if not rel.startswith(config.serving_dir.rstrip("/") + "/"):
+        return
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        if name in ("time.sleep", "sleep"):
+            yield _finding(
+                "YFM008", mod, node,
+                f"bare {name}() on the request path — use an interruptible "
+                f"Event/Condition wait")
+        if name in _UNBOUNDED_QUEUES:
+            bounded = bool(node.args) or any(
+                kw.arg == "maxsize" for kw in node.keywords)
+            if not bounded:
+                yield _finding(
+                    "YFM008", mod, node,
+                    f"unbounded {name}() on the request path — give it a "
+                    f"maxsize (backpressure)")
+
+
+# ---------------------------------------------------------------------------
+# YFM009 — docstring citations must point at real reference files
+# ---------------------------------------------------------------------------
+
+_CITATION = re.compile(r"/root/reference/([A-Za-z0-9_./-]+)")
+
+
+@rule("YFM009", "citation-exists",
+      "docstring citations of /root/reference/<file> must name files that "
+      "exist — a typo'd citation is unverifiable parity provenance")
+def yfm009_citations(mod: SourceModule,
+                     config: LintConfig) -> Iterable[Finding]:
+    ref = config.reference_root
+    if not os.path.isdir(ref):
+        return  # reference tree absent on this box: nothing verifiable
+    if not config.in_package(mod.rel):
+        return
+    seen = set()
+    for i, line in enumerate(mod.source.splitlines(), start=1):
+        for m in _CITATION.finditer(line):
+            rel = m.group(1).rstrip("./")  # sentence period / brace prefix
+            # strip a trailing :lines range that the char class can't include
+            if (rel, i) in seen:
+                continue
+            seen.add((rel, i))
+            path = os.path.join(ref, rel)
+            if not (os.path.isfile(path) or os.path.isdir(path)):
+                yield Finding(
+                    "YFM009", mod.rel, i, 0,
+                    f"citation /root/reference/{m.group(1)} does not exist "
+                    f"under {ref} — fix the path (typo'd citations are "
+                    f"silent provenance rot)")

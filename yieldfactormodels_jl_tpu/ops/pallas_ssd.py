@@ -34,7 +34,6 @@ ops/pallas_kf.py before its adjoint existed.)
 
 from __future__ import annotations
 
-import math
 from functools import partial
 
 import jax

@@ -20,7 +20,6 @@ on unobserved steps), so smoothing across data gaps needs no special casing.
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 from jax import lax
 

@@ -370,7 +370,10 @@ def _legacy_path(results_folder, model_string, thread_id, window_type, kind):
 
 def _write_csv(path: str, rows: np.ndarray) -> str:
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    np.savetxt(path, rows, delimiter=",", fmt="%.18g")
+    # torn-file-proof publish (YFM005); pid+tid: worker THREADS share a pid
+    tmp = f"{path}.tmp-{os.getpid()}-{threading.get_ident()}"
+    np.savetxt(tmp, rows, delimiter=",", fmt="%.18g")
+    os.replace(tmp, path)
     return path
 
 

@@ -7,6 +7,20 @@ import os
 import numpy as np
 
 
+def savetxt_atomic(path: str, rows, **kwargs) -> str:
+    """``np.savetxt`` through a writer-unique tmp + ``os.replace`` publish:
+    a reader (or a concurrent thread re-exporting the same model string)
+    never observes a torn CSV — the same discipline as the forecast shards
+    (graftlint YFM005).  The suffix carries the thread id, not just the pid:
+    the orchestrator's in-process workers share a pid."""
+    import threading
+
+    tmp = f"{path}.tmp-{os.getpid()}-{threading.get_ident()}"
+    np.savetxt(tmp, rows, **kwargs)
+    os.replace(tmp, path)
+    return path
+
+
 def save_results(spec, results: dict, loss: float, params, thread_id: str,
                  data_type: str) -> None:
     """Write filtered factors/states, fitted ŷ, loading columns, loss, params."""
@@ -19,13 +33,17 @@ def save_results(spec, results: dict, loss: float, params, thread_id: str,
 
     factors = np.asarray(results["factors"], dtype=np.float64)
     states = np.asarray(results["states"], dtype=np.float64)
-    np.savetxt(path(f"factors_filtered_{data_type}"),
-               np.concatenate([factors, states], axis=0).T, delimiter=",")
-    np.savetxt(path(f"fit_filtered_{data_type}"),
-               np.asarray(results["preds"], dtype=np.float64).T, delimiter=",")
-    np.savetxt(path(f"factor_loadings_1_filtered_{data_type}"),
-               np.asarray(results["factor_loadings_1"], dtype=np.float64).T, delimiter=",")
-    np.savetxt(path(f"factor_loadings_2_filtered_{data_type}"),
-               np.asarray(results["factor_loadings_2"], dtype=np.float64).T, delimiter=",")
-    np.savetxt(path("loss"), np.asarray([loss], dtype=np.float64), delimiter=",")
-    np.savetxt(path("out_params"), np.asarray(params, dtype=np.float64), delimiter=",")
+    savetxt_atomic(path(f"factors_filtered_{data_type}"),
+                   np.concatenate([factors, states], axis=0).T, delimiter=",")
+    savetxt_atomic(path(f"fit_filtered_{data_type}"),
+                   np.asarray(results["preds"], dtype=np.float64).T, delimiter=",")
+    savetxt_atomic(path(f"factor_loadings_1_filtered_{data_type}"),
+                   np.asarray(results["factor_loadings_1"], dtype=np.float64).T,
+                   delimiter=",")
+    savetxt_atomic(path(f"factor_loadings_2_filtered_{data_type}"),
+                   np.asarray(results["factor_loadings_2"], dtype=np.float64).T,
+                   delimiter=",")
+    savetxt_atomic(path("loss"), np.asarray([loss], dtype=np.float64),
+                   delimiter=",")
+    savetxt_atomic(path("out_params"), np.asarray(params, dtype=np.float64),
+                   delimiter=",")
