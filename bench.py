@@ -426,6 +426,28 @@ def main():
                 f", degraded {100 * rep.degraded_rate:.1f}%")
         except Exception as e:  # never kill the bench line
             load_ctx = f"; load bench failed ({type(e).__name__}: {e})"
+        # mesh-scaling dimension (DESIGN §16): sharded-store throughput vs
+        # mesh size at fixed total registry capacity.  Always a CPU-pinned
+        # subprocess with the 8-virtual-device mesh (the single-chip relay
+        # exposes no multi-device mesh; the honest stamp rides the JSON) —
+        # XLA_FLAGS must precede jax init, hence the subprocess.
+        try:
+            menv = {**os.environ, "JAX_PLATFORMS": "cpu"}
+            menv.pop("PALLAS_AXON_POOL_IPS", None)
+            menv.pop("JAX_COMPILATION_CACHE_DIR", None)
+            menv["XLA_FLAGS"] = (menv.get("XLA_FLAGS", "")
+                                 + " --xla_force_host_platform_device_"
+                                   "count=8").strip()
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--load-mesh-bench"],
+                env=menv, capture_output=True, text=True, timeout=900)
+            tail = (proc.stdout.strip().splitlines() or ["no output"])[-1]
+            load_ctx += ("; " + tail if "load-mesh-bench" in tail else
+                         f"; load-mesh-bench subprocess failed rc="
+                         f"{proc.returncode} ({tail[:200]})")
+        except Exception as e:  # never kill the bench line
+            load_ctx += f"; load-mesh bench failed ({type(e).__name__}: {e})"
 
     # ---- long-panel engine split (opt-in: BENCH_LONGT=1) ----
     # sequential univariate scan vs the O(log T) associative-scan engine at
@@ -809,6 +831,92 @@ def _longt_bench():
     return 0
 
 
+def _load_mesh_bench():
+    """Subprocess mode (CPU, 8 virtual devices — exported by the caller
+    before jax inits): the BENCH_LOAD ``mesh_scaling`` line.  A sharded
+    state store of FIXED total capacity (8192 live filter states) is swept
+    across mesh sizes ``BENCH_LOAD_MESH`` (default 1,2,4,8); each size
+    serves the same update traffic through a ShardedGateway and reports the
+    unpaced max sustained QPS plus paced p50/p99 (robustness/loadgen.
+    mesh_scaling, docs/DESIGN.md §16).  Fixed total capacity means a bigger
+    mesh holds smaller shards — the production scaling shape; on this
+    harness the win is the per-launch compute partition, on real chips the
+    shards run concurrently too."""
+    import dataclasses
+
+    import jax
+    jax.config.update("jax_enable_x64", True)
+
+    from yieldfactormodels_jl_tpu import create_model, serving
+    from yieldfactormodels_jl_tpu.parallel import mesh as pmesh
+    from yieldfactormodels_jl_tpu.robustness import loadgen
+
+    mesh_sizes = tuple(
+        int(x) for x in
+        os.environ.get("BENCH_LOAD_MESH", "1,2,4,8").split(",") if x)
+    n_dev = len(jax.devices())
+    mesh_sizes = tuple(m for m in mesh_sizes if m <= n_dev) or (1,)
+    total = 8192
+
+    spec, _ = create_model("1C", tuple(MATURITIES), float_type="float64")
+    # the tests' stable 1C point (oracle.stable_1c_params): λ = 0.5, obs var
+    # 4e-4, state chol 0.05 I, Φ = 0.9 I — a finite-loglik serving state
+    p = np.zeros(spec.n_params)
+    p[spec.layout["gamma"][0]] = math.log(0.5)
+    p[spec.layout["obs_var"][0]] = 4e-4
+    a, _ = spec.layout["chol"]
+    rows, cols = spec.chol_indices
+    for k, (r, c) in enumerate(zip(rows, cols)):
+        p[a + k] = 0.05 if r == c else 0.0
+    a, b = spec.layout["delta"]
+    p[a:b] = [5.0, -1.0, 0.5]
+    a, b = spec.layout["phi"]
+    p[a:b] = np.diag([0.9, 0.9, 0.9]).reshape(-1)
+    # stationary 3-factor DNS panel matched to those params (the tests'
+    # simulate_dns_panel DGP — make_panel above is the 5-factor AFNS DGP)
+    rng = np.random.default_rng(3)
+    tau = 0.5 * MATURITIES
+    Z = np.column_stack([np.ones_like(MATURITIES),
+                         (1 - np.exp(-tau)) / tau,
+                         (1 - np.exp(-tau)) / tau - np.exp(-tau)])
+    Phi = np.diag([0.95, 0.9, 0.85])
+    delta = np.array([0.3, -0.1, 0.05])
+    beta = np.linalg.solve(np.eye(3) - Phi, delta)
+    data = np.zeros((N_MATURITIES, 96))
+    for t in range(96):
+        beta = delta + Phi @ beta + 0.1 * rng.standard_normal(3)
+        data[:, t] = Z @ beta + 0.02 * rng.standard_normal(N_MATURITIES)
+    data += 5.0
+    snap = serving.freeze_snapshot(spec, p, data, end=64)
+
+    def factory(m):
+        store = serving.ShardedStateStore(
+            spec, mesh=pmesh.make_mesh(m), shard_capacity=total // m,
+            lattice=serving.BucketLattice(update_batch_sizes=(1, 4, 16)))
+        # mesh sizes that don't divide `total` (BENCH_LOAD_MESH=3,5,...)
+        # get the largest registry that fits — m*(total//m) states
+        keys = store.register_many(
+            dataclasses.replace(snap,
+                                meta=dataclasses.replace(snap.meta,
+                                                         task_id=i))
+            for i in range(store.capacity))
+        store.warmup()
+        gw = serving.ShardedGateway(store, queue_max=2048, queue_age_ms=0.0)
+        return gw, keys
+
+    out = loadgen.mesh_scaling(factory, data, mesh_sizes=mesh_sizes,
+                               n=512, burst=128, duration_s=1.0)
+    plat = jax.devices()[0].platform
+    out["device_fallback"] = plat != "tpu"
+    out["fallback_reason"] = "" if plat == "tpu" else os.environ.get(
+        "BENCH_FALLBACK_REASON",
+        f"mesh sweep on the {n_dev}-virtual-device {plat} harness (the "
+        f"single-chip relay exposes no multi-device mesh)")
+    print(f"load-mesh-bench[1C f64, {total} resident states]: "
+          + json.dumps(out))
+    return 0
+
+
 def _orch_bench():
     """2-worker in-process orchestration bench (CPU-pinned subprocess mode):
     tasks/sec on a clean RW rolling run through the leased queue, plus the
@@ -1018,6 +1126,8 @@ if __name__ == "__main__":
         sys.exit(_orch_bench())
     elif "--longt-bench" in sys.argv:
         sys.exit(_longt_bench())
+    elif "--load-mesh-bench" in sys.argv:
+        sys.exit(_load_mesh_bench())
     elif "--inner" in sys.argv:
         main()
     else:

@@ -66,10 +66,13 @@ def test_guard_is_not_vacuous():
 
 
 def test_request_path_bounded_queues_and_no_bare_sleep():
-    """No unbounded ``queue.Queue()`` and no bare ``time.sleep`` anywhere in
-    the serving request path: depth bounds must be explicit (the gateway's
-    deque + admission control) and waits must be interruptible
-    (``Event.wait``/``Condition.wait``) — YFM008."""
+    """No unbounded ``queue.Queue()``, no bare ``time.sleep``, and no host
+    gather (``jax.device_get``/``np.asarray``/``block_until_ready``) inside
+    the per-request ROUTING functions (gateway ``pump()``/``_pump_locked``
+    → ``_dispatch_updates``/``_submit_read`` → store ``_route_waves``)
+    anywhere in the serving request path: depth bounds must be explicit,
+    waits interruptible, and device values cross to host only at the
+    response boundary (the collect/finish functions) — YFM008."""
     res = run_lint(CFG, files=_request_path_files(), rules=["YFM008"])
     assert not res.findings, \
         "request-path convention violations:\n" + _render(res.findings)
@@ -77,7 +80,8 @@ def test_request_path_bounded_queues_and_no_bare_sleep():
 
 def test_request_path_guard_is_not_vacuous():
     names = {os.path.basename(p) for p in _request_path_files()}
-    assert {"gateway.py", "batcher.py", "service.py", "online.py"} <= names
+    assert {"gateway.py", "batcher.py", "service.py", "online.py",
+            "store.py"} <= names
 
 
 def test_every_kalman_engine_has_oracle_parity_coverage():

@@ -459,6 +459,33 @@ def test_yfm008_quiet_on_bounded_queue_and_event_wait(tmp_path):
     assert not res.findings
 
 
+def test_yfm008_fires_on_host_gather_in_routing_function(tmp_path):
+    """The DESIGN §16 routing-path rule: a host transfer inside the
+    per-request routing functions (pump → batch formation → shard routing)
+    is an O(registry) tax — it must live at the response boundary."""
+    res = lint(tmp_path, f"{PKG}/serving/extra.py", """\
+        import jax
+        import numpy as np
+
+        def _pump_locked(self, batch):
+            beta = np.asarray(self.state.beta)   # host gather while routing
+            return jax.device_get(batch)
+    """, ["YFM008"])
+    assert len(fired(res, "YFM008")) == 2
+
+
+def test_yfm008_quiet_on_host_transfer_at_response_boundary(tmp_path):
+    # same calls, but in a collect/finish function: the response boundary
+    res = lint(tmp_path, f"{PKG}/serving/extra.py", """\
+        import jax
+        import numpy as np
+
+        def _collect(self, outs):
+            return [np.asarray(o) for o in jax.device_get(outs)]
+    """, ["YFM008"])
+    assert not res.findings
+
+
 def test_yfm008_scoped_to_serving(tmp_path):
     # the orchestrator's poll loop may sleep (chaos/test code likewise by
     # living outside serving/)

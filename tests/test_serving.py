@@ -299,6 +299,101 @@ def test_registry_quarantines_malformed_params_row(dns_setup, merged_db):
     assert list(reg.last_errors) == [5]
 
 
+def test_registry_batched_load_matches_serial_and_compiles_once(
+        tmp_path, dns_setup):
+    """ISSUE satellite: ``load_all`` freezes every task through ONE vmapped
+    filter pass (snapshot._jitted_freeze_batch) instead of a per-task serial
+    loop that compiles once per distinct window end.  Pins: (a) the batched
+    snapshots equal the serial ones to f64 roundoff, (b) one warm boot =
+    one freeze trace regardless of how many distinct ends the DB holds, and
+    (c) the measured warm-boot wall does not regress vs the serial loop
+    (the serial path pays one compile per end)."""
+    import time
+
+    from yieldfactormodels_jl_tpu.serving import snapshot as ssnap
+
+    spec, p, data = dns_setup
+    base = os.path.join(str(tmp_path), "db", "forecasts_expanding.sqlite3")
+    dummy = np.zeros((2, 3))
+    results = {k: dummy for k in ("preds", "factors", "states",
+                                  "factor_loadings_1", "factor_loadings_2")}
+    # six tasks with six DISTINCT window ends — same-shape grouping would
+    # batch none of them; the causal full-pass trick batches all six
+    task_ids = [T_ORIGIN - 2 * i for i in range(6)]
+    for task in task_ids:
+        db.save_oos_forecast_sharded(base, spec.model_string, "1",
+                                     "expanding", task, results, loss=-1.0,
+                                     params=p, forecast_horizon=2)
+    merged = db.merge_forecast_shards(base, task_ids=task_ids)
+
+    ssnap._jitted_freeze_batch.cache_clear()
+    reg_serial = serving.SnapshotRegistry()
+    t0 = time.perf_counter()
+    keys_serial = reg_serial.load_all(merged, spec, data, batch=False)
+    t_serial = time.perf_counter() - t0
+
+    reg_batch = serving.SnapshotRegistry()
+    t0 = time.perf_counter()
+    keys_batch = reg_batch.load_all(merged, spec, data)
+    t_batch = time.perf_counter() - t0
+
+    assert keys_batch == keys_serial and len(keys_batch) == 6
+    for key in keys_batch:
+        a, b = reg_batch.get(*key), reg_serial.get(*key)
+        assert a.meta == b.meta
+        np.testing.assert_allclose(np.asarray(a.beta), np.asarray(b.beta),
+                                   rtol=1e-12, atol=1e-12)
+        np.testing.assert_allclose(np.asarray(a.P), np.asarray(b.P),
+                                   rtol=1e-12, atol=1e-12)
+    # warm-boot wall: serial pays ~6 compiles, the batch pays 1 — the batch
+    # must not be slower (generous factor: timing on a contended CPU box)
+    assert t_batch < 1.5 * t_serial, (t_batch, t_serial)
+    # ...and a second boot reuses the cached program entirely
+    assert ssnap._jitted_freeze_batch.cache_info().currsize == 1
+
+
+def test_registry_thread_safety_put_get_hammer(dns_setup):
+    """ISSUE satellite: ``put``/``get``/``keys`` hammered from two threads —
+    the gateway worker and the health-rebuild path share one registry; no
+    exception may escape and every completed put must be readable."""
+    import threading
+
+    spec, p, data = dns_setup
+    snap = serving.freeze_snapshot(spec, p, data, end=T_ORIGIN)
+    reg = serving.SnapshotRegistry()
+    errors, done = [], threading.Event()
+
+    def writer():
+        try:
+            for i in range(300):
+                reg.put(dataclasses.replace(
+                    snap, meta=dataclasses.replace(snap.meta, task_id=i)))
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+        finally:
+            done.set()
+
+    def reader():
+        try:
+            while not done.is_set() or len(reg) < 300:
+                for key in reg.keys():
+                    reg.get(*key)  # must never see a half-written entry
+                if errors:
+                    return
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer),
+               threading.Thread(target=reader)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+    assert len(reg) == 300
+    assert reg.get(spec.model_string, 299).meta.task_id == 299
+
+
 def test_shared_batcher_banks_other_submitters_results(dns_setup):
     """A service flushing a SHARED batcher must not drop another submitter's
     pending results — they stay banked until collected by ticket."""

@@ -1,0 +1,461 @@
+"""Mesh-resident serving state (serving/store.py, docs/DESIGN.md §16).
+
+Acceptance coverage for the sharded-state tentpole:
+
+- 8-virtual-device sharded-update parity against ``tests/oracle.
+  online_filter`` (the f64 NumPy loop), including partially-quoted and
+  whole-column-NaN curves, with the shard path pinned to the UNSHARDED
+  ``serving/online`` update too (bit-level loglik, padding-invariant slot
+  state);
+- one compiled program per update bucket across a 1→2→4→8 mesh sweep at
+  fixed shard capacity — zero retraces, zero donation warnings;
+- the chaos-armed ``nonpsd_cov`` slot rebuild: corruption written into the
+  resident slot is caught by the batched health watch and the slot is
+  rewritten from the banked last-good WITHOUT gathering the shard;
+- slot lifecycle (capacity, eviction, unknown keys), duplicate-key waves,
+  the batch-last ``NamedSharding`` global view, and the sharded gateway's
+  end-to-end routing incl. deadline-degraded last-good answers.
+"""
+
+import dataclasses
+import warnings
+
+import jax
+import numpy as np
+import pytest
+
+import yieldfactormodels_jl_tpu as yfm
+from tests import oracle
+from yieldfactormodels_jl_tpu import serving
+from yieldfactormodels_jl_tpu.models.params import unpack_kalman
+from yieldfactormodels_jl_tpu.orchestration import chaos
+from yieldfactormodels_jl_tpu.parallel import mesh as pmesh
+from yieldfactormodels_jl_tpu.robustness import health as rh
+from yieldfactormodels_jl_tpu.robustness import loadgen
+from yieldfactormodels_jl_tpu.robustness import taxonomy as tax
+from yieldfactormodels_jl_tpu.serving import online as so
+
+MATS = tuple(np.array([3, 12, 24, 60, 120, 240, 360]) / 12.0)
+T_PANEL = 48
+T_ORIGIN = 40
+
+LATTICE = dict(horizons=(4, 8), batch_sizes=(1, 4), scenario_counts=(4,),
+               update_batch_sizes=(1, 4))
+
+
+@pytest.fixture(scope="module")
+def dns_setup():
+    rng = np.random.default_rng(11)
+    spec, _ = yfm.create_model("1C", MATS, float_type="float64")
+    p = oracle.stable_1c_params(spec, np.float64)
+    data = oracle.simulate_dns_panel(rng, np.asarray(MATS), T=T_PANEL)
+    snap = serving.freeze_snapshot(spec, p, data, end=T_ORIGIN)
+    return spec, p, data, snap
+
+
+@pytest.fixture(autouse=True)
+def _chaos_clean():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+def _snap_for(snap, task_id):
+    return dataclasses.replace(
+        snap, meta=dataclasses.replace(snap.meta, task_id=task_id))
+
+
+def _store(spec, snap, n_keys, mesh_size=8, shard_capacity=4, **kw):
+    store = serving.ShardedStateStore(
+        spec, mesh=pmesh.make_mesh(mesh_size), shard_capacity=shard_capacity,
+        lattice=serving.BucketLattice(**LATTICE), **kw)
+    keys = store.register_many(_snap_for(snap, i) for i in range(n_keys))
+    return store, keys
+
+
+def _oracle_final_state(spec, p, data, curves):
+    """f64 NumPy element-masked filter over conditioning sample + curves."""
+    kp = unpack_kalman(spec, np.asarray(p))
+    Z = np.asarray(oracle.dns_loadings(float(np.asarray(kp.gamma)[0]),
+                                       np.asarray(MATS)))
+    panel = np.concatenate(
+        [data[:, :T_ORIGIN], np.stack(curves, axis=1)], axis=1) \
+        if curves else data[:, :T_ORIGIN]
+    betas, Ps, _ = oracle.online_filter(
+        Z, np.zeros(spec.N), np.asarray(kp.Phi), np.asarray(kp.delta),
+        np.asarray(kp.Omega_state), float(kp.obs_var), panel)
+    return betas[-1], Ps[-1]
+
+
+# ---------------------------------------------------------------------------
+# parity: sharded updates == oracle == unsharded serving path
+# ---------------------------------------------------------------------------
+
+def test_sharded_update_oracle_parity_8_devices(dns_setup):
+    """Keys spread over all 8 shards ride shard-routed micro-batches through
+    three rounds of live curves — one partially quoted, one whole-column-NaN
+    (a pure transition step) — and every key's final state matches the f64
+    NumPy oracle; logliks are bit-identical to the unsharded
+    ``YieldCurveService`` update path."""
+    spec, p, data, snap = dns_setup
+    store, keys = _store(spec, snap, 16)  # 16 keys on 8 shards
+    assert store.n_shards == 8
+    assert len({store.shard_of(k) for k in keys}) == 8
+
+    curves = [data[:, T_ORIGIN].copy(), data[:, T_ORIGIN + 1].copy(),
+              data[:, T_ORIGIN + 2].copy()]
+    curves[1][2] = np.nan          # partially-quoted tenor
+    curves.append(np.full(spec.N, np.nan))  # whole curve missing: predict only
+
+    svc = serving.YieldCurveService(snap)
+    svc_lls = [svc.update(t, y) for t, y in enumerate(curves)]
+
+    for t, y in enumerate(curves):
+        res = store.update_batch([(k, y) for k in keys], dates=[t] * 16)
+        for r in res:
+            assert r.get("error") is None and not r.get("degraded")
+            # float64 roundoff only: the lanes batch the update's matvec
+            # into a matmul, so states (and hence lls) agree to the last
+            # few bits, not bit-for-bit — the bit-level pin lives in
+            # test_sharded_update_padding_invariant_bit_level
+            np.testing.assert_allclose(r["ll"], svc_lls[t], rtol=1e-12)
+            assert r["version"] == t + 1
+
+    b_ref, P_ref = _oracle_final_state(spec, p, data, curves)
+    for k in keys:
+        got = store.snapshot_of(k)
+        np.testing.assert_allclose(np.asarray(got.beta), b_ref, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(got.P), P_ref, atol=1e-6)
+        assert got.meta.version == len(curves)
+    # the unsharded path agrees to float64 roundoff (the lanes batch a
+    # matvec into a matmul — everything else is the same filter_step)
+    np.testing.assert_allclose(np.asarray(store.snapshot_of(keys[0]).beta),
+                               np.asarray(svc.snapshot.beta), rtol=1e-12,
+                               atol=1e-12)
+
+
+def test_sharded_update_padding_invariant_bit_level(dns_setup):
+    """Trimmed-row bit-exactness: a key updated alone (bucket-1 launch) and
+    the same key riding a padded bucket-4 launch with three other keys end
+    in BIT-IDENTICAL slot state — padding rows and lane neighbours cannot
+    perturb a request's arithmetic."""
+    spec, p, data, snap = dns_setup
+    store_a, keys_a = _store(spec, snap, 4, mesh_size=1, shard_capacity=4)
+    store_b, keys_b = _store(spec, snap, 4, mesh_size=1, shard_capacity=4)
+    y = data[:, T_ORIGIN]
+    ra = store_a.update_batch([(keys_a[0], y)])           # bucket 1
+    rb = store_b.update_batch([(k, y) for k in keys_b])   # bucket 4
+    np.testing.assert_array_equal(ra[0]["ll"], rb[0]["ll"])
+    sa, sb = store_a.snapshot_of(keys_a[0]), store_b.snapshot_of(keys_b[0])
+    np.testing.assert_array_equal(np.asarray(sa.beta), np.asarray(sb.beta))
+    np.testing.assert_array_equal(np.asarray(sa.P), np.asarray(sb.P))
+
+
+def test_sqrt_engine_store_matches_univariate(dns_setup):
+    spec, p, data, snap = dns_setup
+    store_u, keys_u = _store(spec, snap, 4, mesh_size=2, shard_capacity=2)
+    store_s, keys_s = _store(spec, snap, 4, mesh_size=2, shard_capacity=2,
+                             engine="sqrt")
+    for t in range(3):
+        y = data[:, T_ORIGIN + t]
+        ru = store_u.update_batch([(k, y) for k in keys_u])
+        rs = store_s.update_batch([(k, y) for k in keys_s])
+        np.testing.assert_allclose(ru[0]["ll"], rs[0]["ll"], rtol=1e-9)
+    np.testing.assert_allclose(
+        np.asarray(store_s.snapshot_of(keys_s[1]).P),
+        np.asarray(store_u.snapshot_of(keys_u[1]).P), atol=1e-8)
+
+
+def test_duplicate_key_waves_match_sequential_updates(dns_setup):
+    """Two updates for the SAME key in one batch commute through successive
+    waves — equal to two sequential single-update batches."""
+    spec, p, data, snap = dns_setup
+    store, keys = _store(spec, snap, 2, mesh_size=2, shard_capacity=2)
+    y0, y1 = data[:, T_ORIGIN], data[:, T_ORIGIN + 1]
+    res = store.update_batch([(keys[0], y0), (keys[0], y1)])
+    assert [r["version"] for r in res] == [1, 2]
+
+    store2, keys2 = _store(spec, snap, 2, mesh_size=2, shard_capacity=2)
+    r0 = store2.update_batch([(keys2[0], y0)])
+    r1 = store2.update_batch([(keys2[0], y1)])
+    np.testing.assert_array_equal(res[0]["ll"], r0[0]["ll"])
+    np.testing.assert_array_equal(res[1]["ll"], r1[0]["ll"])
+    np.testing.assert_array_equal(
+        np.asarray(store.snapshot_of(keys[0]).beta),
+        np.asarray(store2.snapshot_of(keys2[0]).beta))
+
+
+# ---------------------------------------------------------------------------
+# one program per bucket across mesh sizes; donation stays warning-free
+# ---------------------------------------------------------------------------
+
+def test_no_recompile_across_mesh_sweep_1_2_4_8(dns_setup):
+    """Fixed shard capacity → the (engine, capacity, bucket) program keys
+    never mention mesh size: the whole 1→2→4→8 sweep compiles each update
+    bucket ONCE, and the donated launches never warn about unusable donated
+    buffers."""
+    spec, p, data, snap = dns_setup
+    cap = 6  # unique to this test: the lru cache must start cold
+    so.reset_trace_counts()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        for m in (1, 2, 4, 8):
+            store = serving.ShardedStateStore(
+                spec, mesh=pmesh.make_mesh(m), shard_capacity=cap,
+                lattice=serving.BucketLattice(**LATTICE))
+            keys = store.register_many(
+                _snap_for(snap, i) for i in range(2 * m))
+            r = store.update_batch([(k, data[:, T_ORIGIN]) for k in keys])
+            assert all("error" not in x for x in r)
+            r = store.update_batch([(keys[0], data[:, T_ORIGIN + 1])])
+            assert np.isfinite(r[0]["ll"])
+    assert so.trace_counts["store_update"] <= \
+        serving.BucketLattice(**LATTICE).n_update_programs
+    donation = [str(i.message) for i in w
+                if "donat" in str(i.message).lower()]
+    assert donation == []
+
+
+def test_warmup_then_updates_are_trace_free(dns_setup):
+    spec, p, data, snap = dns_setup
+    store, keys = _store(spec, snap, 8)
+    store.warmup()
+    so.reset_trace_counts()
+    for t in range(3):
+        res = store.update_batch(
+            [(k, data[:, T_ORIGIN + t]) for k in keys[t:t + 5]])
+        assert all(np.isfinite(r["ll"]) for r in res)
+    assert so.trace_counts["store_update"] == 0
+
+
+# ---------------------------------------------------------------------------
+# health watch, chaos rebuild, slot lifecycle
+# ---------------------------------------------------------------------------
+
+def test_chaos_nonpsd_cov_slot_rebuild(dns_setup):
+    """A ``nonpsd_cov`` fault injected INTO the accepted resident slot is
+    caught by the batched watch; the slot is rewritten from the banked
+    last-good (pre-update) state and later updates continue from there —
+    the oracle path that SKIPS the corrupted curve."""
+    spec, p, data, snap = dns_setup
+    store, keys = _store(spec, snap, 8)
+    k = keys[3]
+    y0, y1, y2 = (data[:, T_ORIGIN + i] for i in range(3))
+    assert np.isfinite(store.update_batch([(k, y0)])[0]["ll"])
+
+    chaos.configure("nonpsd_cov:@1", seed=0)
+    res = store.update_batch([(k, y1)])[0]
+    assert res["degraded"] and res["stale"]
+    assert "NONPSD_COV" in res["code"]
+    assert store.rebuilds == 1
+    assert store.health()["status"] == "stale"
+    chaos.reset()
+
+    # the rebuilt slot equals the banked pre-corruption state...
+    got = store.snapshot_of(k)
+    b_ref, P_ref = _oracle_final_state(spec, p, data, [y0])
+    np.testing.assert_allclose(np.asarray(got.beta), b_ref, atol=1e-6)
+    # ...and the next healthy update proceeds from it (y1 skipped)
+    res2 = store.update_batch([(k, y2)])[0]
+    assert np.isfinite(res2["ll"]) and not res2.get("degraded")
+    assert store.health()["status"] == "ok"
+    b_ref2, _ = _oracle_final_state(spec, p, data, [y0, y2])
+    np.testing.assert_allclose(np.asarray(store.snapshot_of(k).beta),
+                               b_ref2, atol=1e-6)
+    # isolation: the other 7 keys never noticed
+    for other in keys:
+        if other != k:
+            assert store.snapshot_of(other).meta.version == 0
+
+
+def test_failed_update_keeps_state_in_program(dns_setup):
+    """A slot whose innovation chain fails (NaN-poisoned covariance — e.g.
+    an operator registering a broken snapshot) degrades ITS requests only:
+    the kernel's accept mask keeps the resident state without any host
+    restore, batch neighbours complete, and a kernel REJECT never counts as
+    a rebuild.  (A non-finite curve is NOT a failure — its elements are
+    masked as unquoted, the pure-transition case in the parity test.)"""
+    spec, p, data, snap = dns_setup
+    store, keys = _store(spec, snap, 1, mesh_size=1, shard_capacity=2)
+    bad = dataclasses.replace(
+        _snap_for(snap, 55), P=np.full((spec.state_dim,) * 2, np.nan))
+    kbad = store.register(bad)
+    y_good = data[:, T_ORIGIN]
+    res = store.update_batch([(kbad, y_good), (keys[0], y_good)])
+    assert res[0]["degraded"] and np.isnan(res[0]["ll"])
+    assert np.isfinite(res[1]["ll"])
+    # the healthy neighbour's state is exactly the single-update state
+    b_ref, _ = _oracle_final_state(spec, p, data, [y_good])
+    np.testing.assert_allclose(np.asarray(store.snapshot_of(keys[0]).beta),
+                               b_ref, atol=1e-6)
+    assert store.rebuilds == 0  # reject ≠ rebuild: state was never touched
+
+
+def test_slot_lifecycle_and_structural_errors(dns_setup):
+    spec, p, data, snap = dns_setup
+    store, keys = _store(spec, snap, 4, mesh_size=2, shard_capacity=2)
+    # full: a fifth registration is a loud structural error
+    with pytest.raises(serving.ServingError):
+        store.register(_snap_for(snap, 99))
+    # unknown key: per-request error result, batch unaffected
+    res = store.update_batch([(("nope", 0), data[:, T_ORIGIN]),
+                              (keys[0], data[:, T_ORIGIN])])
+    assert "error" in res[0] and np.isfinite(res[1]["ll"])
+    # wrong curve length: ditto
+    res = store.update_batch([(keys[1], np.zeros(3))])
+    assert "error" in res[0]
+    # evict frees the slot for a new tenant and kills reads
+    store.evict(keys[2])
+    assert keys[2] not in store
+    with pytest.raises(serving.ServingError):
+        store.snapshot_of(keys[2])
+    newkey = store.register(_snap_for(snap, 77))
+    assert store.shard_of(newkey) in (0, 1)
+    assert len(store) == 4
+
+
+def test_global_view_is_batch_last_namedsharding(dns_setup):
+    spec, p, data, snap = dns_setup
+    store, keys = _store(spec, snap, 8, mesh_size=4, shard_capacity=2)
+    gv = store.global_view()
+    Ms = spec.state_dim
+    assert gv["beta"].shape == (Ms, 8)
+    assert gv["cov"].shape == (Ms, Ms, 8)
+    spec_parts = gv["beta"].sharding.spec
+    assert tuple(spec_parts) == (None, "batch")
+    # values round-trip: every key's slot matches its snapshot view
+    beta_g = np.asarray(gv["beta"])
+    for k in keys:
+        s, sl = store._slot[k]
+        np.testing.assert_array_equal(
+            beta_g[:, s * store.shard_capacity + sl],
+            np.asarray(store.snapshot_of(k).beta))
+
+
+def test_state_health_batch_matches_scalar_watch():
+    rng = np.random.default_rng(0)
+    Ms, B = 3, 6
+    betas = rng.standard_normal((Ms, B))
+    covs = np.stack([np.eye(Ms)] * B, axis=-1) * 0.5
+    covs[:, :, 2] -= 2.0 * np.eye(Ms)[:, :, None][:, :, 0]  # non-PSD
+    betas[0, 4] = np.nan                                     # NaN state
+    codes = rh.state_health_batch(betas, covs, "univariate")
+    for j in range(B):
+        ref = rh.state_health(betas[:, j], covs[:, :, j], "univariate")
+        assert int(codes[j]) == ref["code"]
+    assert int(codes[2]) == tax.NONPSD_COV
+    assert int(codes[4]) == tax.NAN_STATE
+
+
+# ---------------------------------------------------------------------------
+# the sharded gateway: routing, reads, degraded answers, ledger
+# ---------------------------------------------------------------------------
+
+def test_sharded_gateway_end_to_end(dns_setup):
+    spec, p, data, snap = dns_setup
+    store, keys = _store(spec, snap, 8)
+    gw = serving.ShardedGateway(store, queue_max=64, queue_age_ms=0.0)
+    t_u = gw.submit_update(0, data[:, T_ORIGIN], key=keys[0])
+    t_f = gw.submit_forecast(4, quantiles=(0.1, 0.9), key=keys[0])
+    t_s = gw.submit_scenarios(4, 4, seed=3, key=keys[1])
+    assert gw.pump() == 3
+    r_u, r_f, r_s = gw.poll(t_u), gw.poll(t_f), gw.poll(t_s)
+    assert np.isfinite(r_u["ll"]) and not r_u["stale"]
+    assert r_f["means"].shape == (4, spec.N) and 0.1 in r_f["quantiles"]
+    assert r_s["paths"].shape == (spec.N, 4, 4)
+    c = store.counters.to_dict()
+    assert c["admitted"] == 3 and c["completed"] == 3 and c["errors"] == 0
+    assert store.health()["requests"] == c
+    # the forecast equals a single-service forecast from the same state
+    svc = serving.YieldCurveService(snap,
+                                    lattice=serving.BucketLattice(**LATTICE))
+    svc.update(0, data[:, T_ORIGIN])
+    np.testing.assert_allclose(r_f["means"], svc.forecast(4)["means"],
+                               rtol=1e-10)
+    # a key missing is an admission-layer structural error
+    with pytest.raises(serving.ServingError):
+        gw.submit_update(0, data[:, T_ORIGIN])
+
+
+def test_sharded_gateway_deadline_answers_from_keys_last_good(dns_setup):
+    spec, p, data, snap = dns_setup
+    store, keys = _store(spec, snap, 4, mesh_size=2, shard_capacity=2)
+    store.update_batch([(keys[1], data[:, T_ORIGIN])])
+    fake = [0.0]
+    gw = serving.ShardedGateway(store, queue_max=16, queue_age_ms=0.0,
+                                clock=lambda: fake[0])
+    t = gw.submit_forecast(4, key=keys[1], deadline_ms=5.0)
+    fake[0] = 1.0  # the deadline expired before the pump
+    gw.pump()
+    out = gw.poll(t)
+    assert out["degraded"] and out["stale"] and out["key"] == keys[1]
+    bank_b, bank_c = store._bank[keys[1]]
+    np.testing.assert_array_equal(out["beta"], bank_b)
+    np.testing.assert_array_equal(out["P"], bank_c)
+    assert store.counters.deadline == 1 and store.counters.degraded == 1
+
+
+def test_degraded_answer_for_missing_key_is_error_not_crash(dns_setup):
+    """A deadline-expired request whose key was evicted between admission
+    and the pump must NOT raise out of pump() (that would strand the
+    batch's tickets and kill the worker thread) — its ticket banks the
+    structured error instead."""
+    spec, p, data, snap = dns_setup
+    store, keys = _store(spec, snap, 2, mesh_size=1, shard_capacity=2)
+    fake = [0.0]
+    gw = serving.ShardedGateway(store, queue_max=16, queue_age_ms=0.0,
+                                clock=lambda: fake[0])
+    t_doomed = gw.submit_forecast(4, key=keys[0], deadline_ms=5.0)
+    t_ok = gw.submit_update(0, data[:, T_ORIGIN], key=keys[1])
+    store.evict(keys[0])
+    fake[0] = 1.0  # the deadline expired before the pump
+    assert gw.pump() == 2  # never raises: worker-isolation contract
+    with pytest.raises(serving.ServingError):
+        gw.poll(t_doomed)
+    assert np.isfinite(gw.poll(t_ok)["ll"])
+    assert store.counters.errors == 1
+
+
+def test_register_many_partial_failure_leaves_store_unchanged(dns_setup):
+    """Bulk boot is all-or-nothing: a non-PSD snapshot mid-list must leave
+    NO half-registered tables behind (a partial boot would alias later
+    tenants onto zero-state slots)."""
+    spec, p, data, snap = dns_setup
+    store = serving.ShardedStateStore(
+        spec, mesh=pmesh.make_mesh(2), shard_capacity=2,
+        lattice=serving.BucketLattice(**LATTICE), engine="sqrt")
+    bad = dataclasses.replace(
+        _snap_for(snap, 1), P=-np.eye(spec.state_dim))  # non-PSD under sqrt
+    with pytest.raises(serving.ServingError):
+        store.register_many([_snap_for(snap, 0), bad])
+    assert len(store) == 0 and store.keys() == []
+    # duplicate keys are rejected up front too
+    with pytest.raises(serving.ServingError):
+        store.register_many([_snap_for(snap, 0), _snap_for(snap, 0)])
+    assert len(store) == 0
+    # and a clean list still boots
+    keys = store.register_many([_snap_for(snap, i) for i in range(3)])
+    assert len(store) == 3 and len(keys) == 3
+    assert np.isfinite(store.update_batch(
+        [(keys[2], data[:, T_ORIGIN])])[0]["ll"])
+
+
+def test_mesh_scaling_ledger_record(dns_setup):
+    """The loadgen mesh dimension: a tiny 1→2 sweep produces the scaling
+    ledger record (real numbers land in BASELINE.md via BENCH_LOAD; here we
+    pin the record's shape and that both meshes actually serve)."""
+    spec, p, data, snap = dns_setup
+
+    def factory(m):
+        store, keys = _store(spec, snap, 4 * m, mesh_size=m,
+                             shard_capacity=4)
+        store.warmup()
+        gw = serving.ShardedGateway(store, queue_max=256, queue_age_ms=0.0)
+        return gw, keys
+
+    out = loadgen.mesh_scaling(factory, data[:, :T_ORIGIN],
+                               mesh_sizes=(1, 2), n=24, burst=8)
+    assert out["mesh_sizes"] == [1, 2]
+    assert len(out["capacity_qps"]) == 2
+    assert all(c > 0 for c in out["capacity_qps"])
+    assert np.isfinite(out["scaling"])

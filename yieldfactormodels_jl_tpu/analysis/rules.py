@@ -504,15 +504,34 @@ def yfm007_engine_parity(modules, config: LintConfig) -> Iterable[Finding]:
 _UNBOUNDED_QUEUES = ("queue.Queue", "Queue", "queue.LifoQueue",
                      "queue.PriorityQueue", "queue.SimpleQueue")
 
+#: the per-request ROUTING functions (gateway pump → batch formation →
+#: shard routing): work here happens BEFORE the flush, once per request, so
+#: a host gather is an O(registry)-scaling tax the response boundary never
+#: pays back.  Host transfer belongs in the collect/response functions only
+#: (DESIGN §16 routing state machine).
+_ROUTING_FUNCS = frozenset({"pump", "_pump_locked", "_dispatch_updates",
+                            "_submit_read", "_route_waves", "_admit"})
+
+#: calls that move device values to host (or force a device sync)
+_HOST_TRANSFERS = ("jax.device_get", "device_get", "np.asarray", "np.array",
+                   "numpy.asarray", "numpy.array", "jax.block_until_ready")
+
 
 @rule("YFM008", "request-path-hygiene",
-      "no unbounded queue.Queue() and no bare time.sleep under serving/ — "
-      "backpressure must not regress silently")
+      "no unbounded queue.Queue(), no bare time.sleep, and no host "
+      "gather/sync inside the per-request routing functions under serving/ "
+      "— backpressure and O(batch) host traffic must not regress silently")
 def yfm008_request_path(mod: SourceModule,
                         config: LintConfig) -> Iterable[Finding]:
     rel = mod.rel.replace(os.sep, "/")
     if not rel.startswith(config.serving_dir.rstrip("/") + "/"):
         return
+    routing_spans = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name in _ROUTING_FUNCS:
+            routing_spans.append((node.name, node.lineno,
+                                  node.end_lineno or node.lineno))
     for node in ast.walk(mod.tree):
         if not isinstance(node, ast.Call):
             continue
@@ -530,6 +549,19 @@ def yfm008_request_path(mod: SourceModule,
                     "YFM008", mod, node,
                     f"unbounded {name}() on the request path — give it a "
                     f"maxsize (backpressure)")
+        if name and (name in _HOST_TRANSFERS
+                     or name.split(".")[-1] in ("device_get",
+                                                "block_until_ready")):
+            lineno = getattr(node, "lineno", 0)
+            for fname, lo, hi in routing_spans:
+                if lo <= lineno <= hi:
+                    yield _finding(
+                        "YFM008", mod, node,
+                        f"host transfer {name}() inside routing function "
+                        f"{fname}() — the per-request routing path must "
+                        f"stay device-side; gather only at the response "
+                        f"boundary (collect/finish)")
+                    break
 
 
 # ---------------------------------------------------------------------------

@@ -43,6 +43,24 @@ def make_mesh(n_devices: Optional[int] = None, axis_name: str = "batch") -> Mesh
     return Mesh(np.asarray(devs[:n_devices]), (axis_name,))
 
 
+def batch_last_sharding(mesh: Mesh, ndim: int,
+                        axis_name: str = "batch") -> NamedSharding:
+    """``NamedSharding`` splitting the TRAILING axis over the mesh — the
+    lane-rule twin of the ``P(axis, None)`` leading-axis shardings above, for
+    state that keeps its batch axis LAST (per-element serving state,
+    ops/particle.py layout).  ``ndim`` is the array rank: every leading axis
+    is replicated, the last rides the mesh."""
+    return NamedSharding(mesh, P(*([None] * (ndim - 1) + [axis_name])))
+
+
+def shard_devices(mesh: Mesh):
+    """The mesh's devices in shard order (flat mesh-major order) — the
+    placement contract between a mesh and per-shard resident state
+    (serving/store.py): shard s of a batch-last sharded global array lives
+    on ``shard_devices(mesh)[s]``."""
+    return list(mesh.devices.flat)
+
+
 def pad_to_multiple(arr, multiple: int, axis: int = 0):
     """Pad a batch axis up to a device-count multiple (returns arr, true_n)."""
     n = arr.shape[axis]

@@ -71,6 +71,31 @@ def state_health(beta, cov, engine: str = "univariate") -> dict:
                 min_eig=min_eig, cond=cond)
 
 
+def state_health_batch(betas, covs, engine: str = "univariate") -> np.ndarray:
+    """Vectorized :func:`state_health` for a micro-batch of states — ``betas``
+    (Ms, B), ``covs`` (Ms, Ms, B) per the lane rule — returning an int32
+    taxonomy-code vector (B,).  One batched ``eigvalsh`` instead of B host
+    calls: the sharded store's per-request watch must stay O(batch) cheap
+    (serving/store.py), and the verdicts match :func:`state_health` bit for
+    bit (pinned in tests/test_store.py)."""
+    b = np.asarray(betas, dtype=np.float64)
+    c = np.asarray(covs, dtype=np.float64)
+    B = b.shape[-1]
+    P = np.moveaxis(c, -1, 0)                      # (B, Ms, Ms)
+    if engine == "sqrt":
+        P = P @ np.swapaxes(P, -1, -2)
+    P = 0.5 * (P + np.swapaxes(P, -1, -2))
+    codes = np.zeros(B, dtype=np.int32)
+    finite = np.isfinite(b).all(axis=0) & np.isfinite(P).all(axis=(1, 2))
+    codes[~finite] = tax.NAN_STATE
+    if finite.any():
+        w = np.linalg.eigvalsh(np.where(finite[:, None, None], P,
+                                        np.eye(P.shape[-1])[None]))
+        nonpsd = w[:, 0] < -EIG_TOL * np.maximum(1.0, np.abs(w[:, -1]))
+        codes[finite & nonpsd] = tax.NONPSD_COV
+    return codes
+
+
 def refresh_state(beta, cov, engine: str = "univariate", floor: float = 0.0):
     """The periodic square-root refresh: return a scrubbed ``cov``.
 

@@ -74,10 +74,13 @@ def _percentiles_ms(latencies) -> Tuple[float, float, float]:
 
 
 class _MixedTraffic:
-    """Seeded request generator: kind by cumulative mix, curves by column."""
+    """Seeded request generator: kind by cumulative mix, curves by column.
+    With ``keys`` (a sequence of state-store keys) each request addresses a
+    uniformly-drawn key — the multi-user traffic shape the sharded gateway
+    routes across the mesh (DESIGN §16)."""
 
     def __init__(self, gateway, curves, mix, horizon, n_scenarios,
-                 quantiles, seed):
+                 quantiles, seed, keys=None):
         self.gateway = gateway
         self.curves = np.asarray(curves)
         self.cum = np.cumsum(np.asarray(mix, dtype=np.float64))
@@ -87,7 +90,13 @@ class _MixedTraffic:
         self.n_scenarios = int(n_scenarios)
         self.quantiles = quantiles
         self.rng = np.random.default_rng(seed)
+        self.keys = list(keys) if keys is not None else None
         self.i = 0
+
+    def _kw(self) -> dict:
+        if self.keys is None:
+            return {}
+        return {"key": self.keys[self.rng.integers(len(self.keys))]}
 
     def submit_one(self) -> int:
         """Submit the next mixed request; returns its ticket (a shed raises
@@ -96,10 +105,12 @@ class _MixedTraffic:
         self.i += 1
         gw, T = self.gateway, self.curves.shape[1]
         if u < self.cum[0]:
-            return gw.submit_update(i, self.curves[:, i % T])
+            return gw.submit_update(i, self.curves[:, i % T], **self._kw())
         if u < self.cum[1]:
-            return gw.submit_forecast(self.horizon, self.quantiles)
-        return gw.submit_scenarios(self.n_scenarios, self.horizon, seed=i)
+            return gw.submit_forecast(self.horizon, self.quantiles,
+                                      **self._kw())
+        return gw.submit_scenarios(self.n_scenarios, self.horizon, seed=i,
+                                   **self._kw())
 
 
 def run_load(gateway, curves, *, duration_s: float = 2.0,
@@ -108,7 +119,7 @@ def run_load(gateway, curves, *, duration_s: float = 2.0,
              horizon: int = 8, n_scenarios: int = 8,
              quantiles: Optional[Tuple[float, ...]] = None,
              burst: int = 4, seed: int = 0,
-             drain_rounds: int = 200) -> LoadReport:
+             drain_rounds: int = 200, keys=None) -> LoadReport:
     """Drive ``duration_s`` of mixed traffic at ``offered_qps`` through the
     gateway, closed-loop (each burst is submitted, pumped, then collected —
     outstanding tickets are re-polled after later pumps, so a stalled cycle
@@ -117,7 +128,7 @@ def run_load(gateway, curves, *, duration_s: float = 2.0,
     outstanding is reported ``abandoned`` (only a permanently-stalled worker
     leaves any)."""
     traffic = _MixedTraffic(gateway, curves, mix, horizon, n_scenarios,
-                            quantiles, seed)
+                            quantiles, seed, keys=keys)
     latencies, outstanding = [], []
     ok = degraded = shed = errors = 0
     t_start = time.perf_counter()
@@ -175,14 +186,14 @@ def run_load(gateway, curves, *, duration_s: float = 2.0,
 def measure_capacity(gateway, curves, *, n: int = 128,
                      mix: Tuple[float, float, float] = (0.6, 0.3, 0.1),
                      horizon: int = 8, n_scenarios: int = 8,
-                     burst: int = 8, seed: int = 1) -> float:
+                     burst: int = 8, seed: int = 1, keys=None) -> float:
     """Max sustained QPS: the UNPACED closed-loop completion rate — bursts
     submitted back-to-back with the service always busy, queue depth bounded
     by the burst, nothing shed.  This is the saturation throughput the paced
     ``run_load`` offered rate is set against (chaos should be DISARMED here;
     arm it for the measured run, not the yardstick)."""
     traffic = _MixedTraffic(gateway, curves, mix, horizon, n_scenarios,
-                            None, seed)
+                            None, seed, keys=keys)
     answered = 0
     t0 = time.perf_counter()
     while traffic.i < n:
@@ -201,3 +212,53 @@ def measure_capacity(gateway, curves, *, n: int = 128,
                 pass
     wall = time.perf_counter() - t0
     return answered / wall if wall > 0 else float("inf")
+
+
+def mesh_scaling(gateway_factory, curves, *,
+                 mesh_sizes: Tuple[int, ...] = (1, 2, 4, 8),
+                 n: int = 256, burst: int = 64,
+                 mix: Tuple[float, float, float] = (1.0, 0.0, 0.0),
+                 duration_s: float = 0.0, seed: int = 1) -> dict:
+    """The MESH-SIZE dimension of the sustained-load ledger (DESIGN §16):
+    for each mesh size ``m``, build a fresh sharded gateway via
+    ``gateway_factory(m) -> (gateway, keys)`` (a :class:`~..serving.gateway.
+    ShardedGateway` over a store whose TOTAL capacity is held fixed, so a
+    bigger mesh means smaller shards — the production scaling shape), then
+    measure the unpaced closed-loop capacity (:func:`measure_capacity`) and,
+    optionally (``duration_s > 0``), a paced :func:`run_load` pass for the
+    latency percentiles at ~80% of that capacity.
+
+    Returns one ledger record::
+
+        {"mesh_sizes": [...], "capacity_qps": [...],
+         "p50_ms": [...], "p99_ms": [...],            # NaN when unpaced
+         "scaling": capacity[largest] / capacity[smallest]}
+
+    This is how the "throughput scales with the mesh" claim becomes a
+    MEASURED line (BASELINE.md discipline: both sides of every claim), on
+    the 8-virtual-device CPU harness today and on real chips unchanged.
+    """
+    sizes = sorted(set(int(m) for m in mesh_sizes))
+    caps, p50s, p99s = [], [], []
+    for m in sizes:
+        gateway, keys = gateway_factory(m)
+        cap = measure_capacity(gateway, curves, n=n, mix=mix, burst=burst,
+                               seed=seed, keys=keys)
+        caps.append(round(cap, 2))
+        if duration_s > 0:
+            rep = run_load(gateway, curves, duration_s=duration_s,
+                           offered_qps=0.8 * cap, mix=mix, burst=burst,
+                           seed=seed, keys=keys)
+            p50s.append(rep.p50_ms)
+            p99s.append(rep.p99_ms)
+        else:
+            p50s.append(float("nan"))
+            p99s.append(float("nan"))
+    return {
+        "mesh_sizes": sizes,
+        "capacity_qps": caps,
+        "p50_ms": p50s,
+        "p99_ms": p99s,
+        "scaling": round(caps[-1] / caps[0], 3) if caps and caps[0] else
+        float("nan"),
+    }

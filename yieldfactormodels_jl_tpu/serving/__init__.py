@@ -7,20 +7,27 @@ small lattice of precompiled programs (``batcher``), the
 ``YieldCurveService`` driver with per-stage latency accounting (``service``),
 and the resilient request pipeline in front of it all — bounded queue,
 admission control/load shedding, per-request deadlines with degraded
-last-good answers (``gateway``).
+last-good answers (``gateway``) — and the device-scale half: mesh-resident
+per-user filter states sharded across the device mesh with shard-routed
+donated micro-batch updates (``store``, ``ShardedGateway``;
+docs/DESIGN.md §16).
 """
 
 from .batcher import (BucketLattice, DEFAULT_LATTICE, ForecastRequest,
                       MicroBatcher, ScenarioRequest)
-from .gateway import ServingGateway
+from .gateway import ServingGateway, ShardedGateway
 from .online import (ONLINE_ENGINES, OnlineState, reset_trace_counts,
                      scenario_paths, trace_counts, update, update_k)
 from .service import RequestCounters, YieldCurveService
 from .snapshot import (ServingError, ServingSnapshot, SnapshotMeta,
-                       SnapshotRegistry, freeze_snapshot, load_snapshot)
+                       SnapshotRegistry, freeze_snapshot,
+                       freeze_snapshots_batch, load_snapshot)
+from .store import ShardedStateStore
 
 __all__ = [
     "BucketLattice",
+    "ShardedGateway",
+    "ShardedStateStore",
     "DEFAULT_LATTICE",
     "ForecastRequest",
     "MicroBatcher",
@@ -40,5 +47,6 @@ __all__ = [
     "SnapshotMeta",
     "SnapshotRegistry",
     "freeze_snapshot",
+    "freeze_snapshots_batch",
     "load_snapshot",
 ]

@@ -49,9 +49,15 @@ class BucketLattice:
     horizons: Tuple[int, ...] = (4, 8, 16, 32, 64)
     batch_sizes: Tuple[int, ...] = (1, 4, 16)
     scenario_counts: Tuple[int, ...] = (8, 32, 128)
+    #: padded per-shard update-batch shapes for the sharded state store
+    #: (serving/store.py): a shard's micro-batch of online updates rounds up
+    #: onto these, so arbitrary request mixes share ``len(update_batch_sizes)``
+    #: compiled shard-update programs per (engine, capacity)
+    update_batch_sizes: Tuple[int, ...] = (1, 4, 16)
 
     def __post_init__(self):
-        for name in ("horizons", "batch_sizes", "scenario_counts"):
+        for name in ("horizons", "batch_sizes", "scenario_counts",
+                     "update_batch_sizes"):
             vals = getattr(self, name)
             if not vals or list(vals) != sorted(set(vals)) or min(vals) < 1:
                 raise ValueError(f"{name} must be strictly increasing ≥ 1, "
@@ -59,13 +65,21 @@ class BucketLattice:
 
     @property
     def n_programs(self) -> int:
-        """Upper bound on distinct compiled serving programs."""
+        """Upper bound on distinct compiled read-path (forecast/scenario)
+        serving programs."""
         return (len(self.horizons) * len(self.batch_sizes)
                 + len(self.horizons) * len(self.scenario_counts))
 
+    @property
+    def n_update_programs(self) -> int:
+        """Upper bound on distinct compiled shard-update programs per
+        (engine, shard capacity) — the store-side twin of ``n_programs``."""
+        return len(self.update_batch_sizes)
+
     @staticmethod
     def _round_up(value: int, axis: Tuple[int, ...], name: str) -> int:
-        stage = "forecast" if name == "horizons" else "scenarios"
+        stage = {"horizons": "forecast",
+                 "update_batch_sizes": "update"}.get(name, "scenarios")
         if value < 1:
             # a non-positive size would otherwise round UP to the first
             # bucket and come back silently truncated to an empty/short array
@@ -87,6 +101,10 @@ class BucketLattice:
 
     def scenario_bucket(self, n: int) -> int:
         return self._round_up(int(n), self.scenario_counts, "scenario_counts")
+
+    def update_bucket(self, b: int) -> int:
+        return self._round_up(int(b), self.update_batch_sizes,
+                              "update_batch_sizes")
 
 
 DEFAULT_LATTICE = BucketLattice()

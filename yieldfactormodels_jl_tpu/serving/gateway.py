@@ -292,8 +292,7 @@ class ServingGateway:
                 run_updates.append(req)
             else:
                 run_batched.append(req)
-        for req in run_updates:
-            self._finish(req.ticket, self._dispatch_update(req))
+        self._dispatch_updates(run_updates)
         if run_batched:
             self._dispatch_batched(run_batched)
         elif est_degraded:
@@ -313,6 +312,21 @@ class ServingGateway:
         return {"kind": req.kind, "degraded": True, "stale": True,
                 "reason": reason, "version": snap.meta.version,
                 "beta": np.asarray(snap.beta), "P": np.asarray(snap.P)}
+
+    def _dispatch_updates(self, reqs: List[_Pending]) -> None:
+        """Answer the drained update requests (arrival order).  Hook: the
+        sharded gateway overrides this to route the whole batch through the
+        state store's per-shard programs instead of one-by-one dispatch."""
+        for req in reqs:
+            self._finish(req.ticket, self._dispatch_update(req))
+
+    def _submit_read(self, req: _Pending) -> int:
+        """Submit one batched-read request to the micro-batcher; returns the
+        batcher ticket.  Hook: the sharded gateway resolves the request's
+        KEY to its mesh-resident state here (device slices — no host
+        gather on the routing path, YFM008)."""
+        svc = self.service
+        return svc.batcher.submit(svc.snapshot, req.payload)
 
     def _dispatch_update(self, req: _Pending) -> dict:
         chaos.maybe_delay("slow_update", self.slow_update_s)
@@ -345,8 +359,7 @@ class ServingGateway:
         tickets: Dict[int, int] = {}
         for req in reqs:
             try:
-                tickets[req.ticket] = svc.batcher.submit(svc.snapshot,
-                                                         req.payload)
+                tickets[req.ticket] = self._submit_read(req)
             except ServingError as e:   # lattice rejection: fails at submit
                 self.counters.errors += 1
                 self._finish(req.ticket, {"error": e})
@@ -397,3 +410,95 @@ class ServingGateway:
         if self._worker is not None:
             self._worker.join(timeout)
             self._worker = None
+
+
+class ShardedGateway(ServingGateway):
+    """The gateway in front of a :class:`~.store.ShardedStateStore` — same
+    admission control / deadlines / shedding machinery, but every request
+    names a KEY (``(model_string, task_id)``) and the pump routes work to
+    the mesh shard that owns that key's state (DESIGN §16):
+
+    - updates drain into ONE ``store.update_batch`` call — grouped by owning
+      shard, padded onto the lattice's update buckets, one donated SPMD
+      program per (shard, bucket), O(batch) host traffic;
+    - forecasts/scenarios resolve their key to DEVICE slices
+      (``store.snapshot_of``) and ride the shared micro-batcher exactly as
+      before — host transfer happens only in the batcher's response path;
+    - a deadline-degraded request answers from that KEY's banked last-good
+      state (``store.last_good_snapshot_of``), stale-flagged as ever.
+
+    The store duck-types the service surface the base gateway reads
+    (``counters``/``timer``/``batcher``), so health and latency stay ONE
+    operator report.
+    """
+
+    def __init__(self, store, **kwargs):
+        super().__init__(store, **kwargs)
+        self.store = store
+
+    # ---- key-addressed admission -----------------------------------------
+
+    def submit_update(self, date, yields, deadline_ms=None, *,
+                      key=None) -> int:
+        if key is None:
+            raise ServingError("admission", "sharded updates need key= (the "
+                               "(model_string, task_id) state address)")
+        return self._admit("update", (key, date, np.asarray(yields)),
+                           deadline_ms)
+
+    def submit_forecast(self, h, quantiles=None, deadline_ms=None, *,
+                        key=None) -> int:
+        if key is None:
+            raise ServingError("admission", "sharded forecasts need key=")
+        req = ForecastRequest(int(h), tuple(quantiles) if quantiles else None)
+        return self._admit("forecast", (key, req), deadline_ms)
+
+    def submit_scenarios(self, n, h, seed=0, deadline_ms=None, *,
+                         key=None) -> int:
+        if key is None:
+            raise ServingError("admission", "sharded scenarios need key=")
+        return self._admit("scenarios",
+                           (key, ScenarioRequest(int(n), int(h), int(seed))),
+                           deadline_ms)
+
+    # ---- shard-routed dispatch -------------------------------------------
+
+    def _dispatch_updates(self, reqs: List[_Pending]) -> None:
+        if not reqs:
+            return
+        chaos.maybe_delay("slow_update", self.slow_update_s)
+        store = self.store
+        with store.timer.stage("update"):
+            outs = store.update_batch(
+                [(r.payload[0], r.payload[2]) for r in reqs],
+                dates=[r.payload[1] for r in reqs])
+        for req, out in zip(reqs, outs):
+            if "error" in out:
+                self.counters.errors += 1
+                self._finish(req.ticket, out)
+            elif out.get("degraded"):
+                self.counters.degraded += 1
+                self._finish(req.ticket, {"kind": "update", **out})
+            else:
+                self.counters.completed += 1
+                self._finish(req.ticket, {"kind": "update", **out})
+
+    def _submit_read(self, req: _Pending) -> int:
+        key, payload = req.payload
+        return self.store.batcher.submit(self.store.snapshot_of(key), payload)
+
+    def _degraded_answer(self, req: _Pending, reason: str) -> dict:
+        key = req.payload[0]
+        try:
+            snap = self.store.last_good_snapshot_of(key)
+        except ServingError as e:
+            # unknown/evicted key: the degraded answer itself must never
+            # raise out of the pump (worker-isolation contract — a raise
+            # here would strand the batch's tickets and kill the worker
+            # thread); THIS ticket gets the structured error instead
+            self.counters.errors += 1
+            return {"error": e}
+        self.counters.degraded += 1
+        return {"kind": req.kind, "key": key, "degraded": True, "stale": True,
+                "reason": reason, "version": snap.meta.version,
+                "beta": np.asarray(snap.beta), "P": np.asarray(snap.P)}

@@ -215,6 +215,26 @@ def _check_engine(engine: str) -> None:
             f"unknown online engine {engine!r}; pick from {ONLINE_ENGINES}")
 
 
+def factor_cov(P, engine: str, dtype):
+    """The engine's covariance REPRESENTATION of filtered moments P:
+    P itself for the univariate engine (copied — the donated update kernels
+    consume the live buffer, so it must never alias a frozen record), the
+    lower Cholesky factor S with P = S Sᵀ for the sqrt engine.  Raises
+    ``ValueError`` (trace-time validation class) on a non-PSD P under the
+    sqrt factorization — the driver layers (service/store) convert that into
+    their structured error."""
+    cov = jnp.asarray(P, dtype=dtype)
+    if engine == "sqrt":
+        Ms = cov.shape[0]
+        sym = 0.5 * (cov + cov.T) + 1e-12 * jnp.eye(Ms, dtype=cov.dtype)
+        cov = jnp.linalg.cholesky(sym)
+        if not bool(jnp.all(jnp.isfinite(cov))):
+            raise ValueError("filtered covariance is not PSD — cannot start "
+                             "the sqrt engine")
+        return cov
+    return jnp.array(cov, copy=True)
+
+
 @register_engine_cache
 @lru_cache(maxsize=64)
 def _jitted_update(spec: ModelSpec, engine: str, donate: bool = False):
@@ -284,6 +304,91 @@ def _jitted_update_k(spec: ModelSpec, engine: str, kb: int,
         return b, c, lls, oks, codes
 
     return jax.jit(many, donate_argnums=(1, 2) if donate else ())
+
+
+@register_engine_cache
+@lru_cache(maxsize=64)
+def _jitted_shard_update(spec: ModelSpec, engine: str, capacity: int,
+                         bucket: int, donate: bool = True):
+    """ONE shard's micro-batch update program (docs/DESIGN.md §16): the
+    shard's mesh-resident state — ``params`` (P, C), ``beta`` (Ms, C),
+    ``cov`` (Ms, Ms, C), ``version`` (C,), slot axis LAST per the lane rule
+    — plus a padded request batch ``Y`` (N, B), ``slots`` (B,), ``valid``
+    (B,) → the updated resident state and the per-REQUEST curve outputs
+    (ll, ok, code, version, β′, cov′ at the requested slots).
+
+    Requests are scattered onto the slot axis (padding rows scatter out of
+    bounds and are DROPPED — they can never clobber a live slot), then every
+    slot advances through :func:`filter_step` in lanes, masked: unselected
+    slots are exact pass-throughs, and a selected slot whose step FAILED
+    (``ok`` false) also keeps its resident state — "keep the last good
+    version" happens in-program, no host restore dance.  Failures stay
+    sentinels riding the batch (NaN candidate state, taxonomy bits); the
+    driver (serving/store.py) decodes the per-request codes.
+
+    ``donate=True`` donates all four state buffers; each is carried to an
+    identically-shaped output (params passes through as the first output —
+    the §14 aliasing invariant), so the resident store allocates nothing per
+    micro-batch and the only host traffic is O(batch), never O(capacity).
+    One compiled program per (engine, capacity, bucket): mesh size never
+    appears in the key, so a 1→2→4→8 device sweep at fixed shard capacity
+    reuses one trace (pinned in tests/test_store.py)."""
+    _check_engine(engine)
+
+    def many(params, beta, cov, ver, Y, slots, valid):
+        note_trace("store_update")
+        # padding rows target slot `capacity` (out of bounds): mode="drop"
+        # discards them, so a duplicated padding index can never mask or
+        # NaN-out a live slot's scattered curve
+        safe = jnp.where(valid, slots, capacity)
+        sel = jnp.zeros((capacity,), dtype=bool).at[safe].set(
+            True, mode="drop")
+        Yfull = jnp.full((spec.N, capacity), jnp.nan, dtype=beta.dtype)
+        Yfull = Yfull.at[:, safe].set(Y, mode="drop")
+
+        def one(p, b, c, y):
+            kp = unpack_kalman(spec, p)
+            st, ll, ok, code = filter_step(spec, kp, OnlineState(b, c), y,
+                                           engine)
+            return st.beta, st.cov, ll, ok, code
+
+        nb, nc, ll, ok, code = jax.vmap(
+            one, in_axes=(-1, -1, -1, -1),
+            out_axes=(-1, -1, -1, -1, -1))(params, beta, cov, Yfull)
+        accept = sel & ok
+        beta_o = jnp.where(accept[None, :], nb, beta)
+        cov_o = jnp.where(accept[None, None, :], nc, cov)
+        ver_o = ver + accept.astype(ver.dtype)
+        # per-request gathers — the ONLY outputs that cross to host
+        gs = jnp.minimum(slots, capacity - 1)
+        return (params, beta_o, cov_o, ver_o,
+                jnp.where(valid, ll[gs], 0.0),
+                ok[gs] | ~valid,
+                jnp.where(valid, code[gs], jnp.int32(0)),
+                ver_o[gs],
+                beta_o[:, gs], cov_o[:, :, gs])
+
+    return jax.jit(many, donate_argnums=(0, 1, 2, 3) if donate else ())
+
+
+@register_engine_cache
+@lru_cache(maxsize=32)
+def _jitted_slot_write(spec: ModelSpec, capacity: int, donate: bool = True):
+    """Single-slot rewrite program: scatter (p, β, cov-rep, version) into one
+    slot of a shard's resident arrays WITHOUT gathering the shard — the
+    register/evict/heal path (docs/DESIGN.md §16 slot lifecycle).  All four
+    state buffers are donated and carried to identically-shaped outputs, so
+    a rebuild touches O(slot) memory, not O(capacity)."""
+    del spec  # shapes ride the arguments; the key keeps specs apart
+
+    def write(params, beta, cov, ver, slot, p, b, c, v):
+        note_trace("slot_write")
+        return (params.at[:, slot].set(p),
+                beta.at[:, slot].set(b),
+                cov.at[:, :, slot].set(c),
+                ver.at[slot].set(v))
+
+    return jax.jit(write, donate_argnums=(0, 1, 2, 3) if donate else ())
 
 
 @register_engine_cache

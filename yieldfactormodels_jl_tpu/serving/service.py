@@ -48,7 +48,7 @@ from ..utils.profiling import StageTimer
 from .batcher import (BucketLattice, ForecastRequest, MicroBatcher,
                       ScenarioRequest)
 from .online import (OnlineState, _check_engine, _jitted_refilter,
-                     _jitted_update, update_k)
+                     _jitted_update, factor_cov, update_k)
 from .snapshot import ServingError, ServingSnapshot, SnapshotRegistry
 
 
@@ -133,22 +133,18 @@ class YieldCurveService:
     def _set_snapshot(self, snapshot: ServingSnapshot) -> None:
         self.snapshot = snapshot
         dtype = snapshot.spec.dtype
-        cov = jnp.asarray(snapshot.P, dtype=dtype)
-        if self.engine == "sqrt":
-            # factor once per (re)load; afterwards the sqrt kernel propagates
-            # the factor itself and P is re-formed only for the snapshot record
-            Ms = cov.shape[0]
-            sym = 0.5 * (cov + cov.T) + 1e-12 * jnp.eye(Ms, dtype=cov.dtype)
-            cov = jnp.linalg.cholesky(sym)
-            if not bool(jnp.all(jnp.isfinite(cov))):
-                raise ServingError("snapshot", "filtered covariance is not "
-                                   "PSD — cannot start the sqrt engine",
-                                   version=snapshot.meta.version)
-        else:
-            # the LIVE state must never alias the snapshot record: the
-            # donated update kernels consume the state buffers, and a shared
+        try:
+            # factor once per (re)load (sqrt engine: afterwards the kernel
+            # propagates the factor itself and P is re-formed only for the
+            # snapshot record); either representation is a fresh buffer — the
+            # LIVE state must never alias the snapshot record, because the
+            # donated update kernels consume the state buffers and a shared
             # buffer would take the frozen snapshot down with them
-            cov = jnp.array(cov, copy=True)
+            cov = factor_cov(snapshot.P, self.engine, dtype)
+        except ValueError:
+            raise ServingError("snapshot", "filtered covariance is not "
+                               "PSD — cannot start the sqrt engine",
+                               version=snapshot.meta.version)
         self._state = OnlineState(
             jnp.array(jnp.asarray(snapshot.beta, dtype=dtype), copy=True),
             cov)

@@ -22,11 +22,15 @@ inside the jitted kernels the same failures stay sentinels.
 from __future__ import annotations
 
 import dataclasses
+import threading
+from functools import lru_cache
 from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
+from ..config import register_engine_cache
 from ..models.specs import ModelSpec
 from ..persistence.database import read_all_task_params, read_task_params
 
@@ -129,6 +133,99 @@ def freeze_snapshot(spec: ModelSpec, params, data, start: int = 0,
                            outs["P_upd"][-1], meta)
 
 
+@register_engine_cache
+@lru_cache(maxsize=32)
+def _jitted_freeze_batch(spec: ModelSpec, T: int, engine: str, B: int):
+    """One vmapped warm-boot freeze program: (params (B, P), data (N, T),
+    ends (B,)) → per-task final filtered (β, P) moments at each task's OWN
+    conditioning end, plus the per-task ok flag.
+
+    The trick that lets tasks with DIFFERENT window ends share one program:
+    the Kalman recursion is causal, so the filtered state after step e−1 of
+    a T-long pass equals the final state of an e-long pass — every task runs
+    the same full-length filter and gathers its own (β_{e−1|e−1},
+    P_{e−1|e−1}) in-program.  One trace replaces the serial boot's
+    one-compile-per-distinct-end loop (the warm-boot wall measured in
+    tests/test_serving.py)."""
+    from ..ops.smoother import forward_moments
+
+    def one(params, data, e):
+        _, outs = forward_moments(spec, params, data, 0, T, engine)
+        beta = outs["beta_upd"][e - 1]
+        P = outs["P_upd"][e - 1]
+        conditioned = jnp.arange(T) < e
+        ok = jnp.all(jnp.where(conditioned, outs["ll"], 0.0) > -jnp.inf) \
+            & jnp.all(jnp.isfinite(beta)) & jnp.all(jnp.isfinite(P))
+        return beta, P, ok
+
+    return jax.jit(jax.vmap(one, in_axes=(0, None, 0)))
+
+
+def freeze_snapshots_batch(spec: ModelSpec, params_by_task: Dict[int, object],
+                           data, window_type: str = "expanding",
+                           engine=None):
+    """Freeze one snapshot per task through ONE vmapped filter pass —
+    the warm-boot batch path behind :meth:`SnapshotRegistry.load_all`.
+
+    Returns ``(snapshots, errors)``: malformed rows (wrong params length,
+    empty conditioning window) and tasks whose filter pass failed (−Inf
+    sentinel) are quarantined into ``errors`` with a structural
+    :class:`ServingError`, never taking the healthy tasks down — the
+    serial-loop semantics, minus the per-task compile."""
+    if not spec.is_kalman:
+        raise ServingError(
+            "snapshot", f"online serving needs a Kalman family with a state "
+            f"posterior; {spec.family!r} has no filtered covariance",
+            model=spec.model_string)
+    from .. import config
+
+    if engine is None and config.kalman_engine() not in ("joint",
+                                                         "univariate"):
+        engine = "univariate"  # loglik-only engines have no moments path
+    data = jnp.asarray(data, dtype=spec.dtype)
+    T = int(data.shape[1])
+    errors: Dict[int, Exception] = {}
+    staged = []
+    for task_id in sorted(params_by_task):
+        end = min(int(task_id), T)
+        p = np.asarray(params_by_task[task_id], dtype=np.float64).reshape(-1)
+        if p.shape[0] != spec.n_params:
+            errors[int(task_id)] = ServingError(
+                "snapshot", f"params row has {p.shape[0]} entries, spec "
+                f"needs {spec.n_params}", task_id=int(task_id))
+            continue
+        if end < 1:
+            errors[int(task_id)] = ServingError(
+                "snapshot", f"empty conditioning window (end={end})",
+                task_id=int(task_id))
+            continue
+        staged.append((int(task_id), end, p))
+    snapshots = []
+    if staged:
+        t_max = max(e for _, e, _ in staged)
+        runner = _jitted_freeze_batch(spec, t_max, engine, len(staged))
+        betas, Ps, oks = runner(
+            jnp.asarray(np.stack([p for _, _, p in staged]),
+                        dtype=spec.dtype),
+            data[:, :t_max],
+            jnp.asarray([e for _, e, _ in staged], dtype=jnp.int32))
+        oks = np.asarray(oks)
+        for i, (task_id, end, p) in enumerate(staged):
+            if not oks[i]:
+                errors[task_id] = ServingError(
+                    "snapshot", "filter pass failed (−Inf loglik sentinel) — "
+                    "params invalid for this panel",
+                    model=spec.model_string, end=end)
+                continue
+            meta = SnapshotMeta(model_string=spec.model_string,
+                                window_type=window_type, task_id=task_id,
+                                n_obs=end)
+            snapshots.append(ServingSnapshot(
+                spec, jnp.asarray(p, dtype=spec.dtype), betas[i], Ps[i],
+                meta))
+    return snapshots, errors
+
+
 def load_snapshot(db_path: str, spec: ModelSpec, task_id: int, data,
                   window_type: str = "expanding", engine=None
                   ) -> ServingSnapshot:
@@ -149,40 +246,63 @@ class SnapshotRegistry:
     """In-process registry of live snapshots, keyed (model_string, task_id).
 
     ``load_all`` bulk-loads every task in a merged DB with ONE query
-    (``read_all_task_params``) and one filter freeze per task — the serving
-    warm-boot path, no per-task SELECT loop."""
+    (``read_all_task_params``) and ONE vmapped filter freeze across the
+    tasks (:func:`freeze_snapshots_batch`) — the serving warm-boot path: no
+    per-task SELECT loop, no per-task compile.
+
+    Thread-safe: ``put``/``get``/``load_all`` are called concurrently from
+    the gateway worker thread and the health-rebuild path
+    (service._rebuild_source), so every map access holds a lock — a
+    half-registered snapshot must never be observable."""
 
     def __init__(self):
         self._snaps: Dict[Tuple[str, int], ServingSnapshot] = {}
         self.last_errors: Dict[int, Exception] = {}
+        self._lock = threading.RLock()
 
     def __len__(self) -> int:
-        return len(self._snaps)
+        with self._lock:
+            return len(self._snaps)
 
     def keys(self):
-        return sorted(self._snaps)
+        with self._lock:
+            return sorted(self._snaps)
 
     def put(self, snap: ServingSnapshot) -> Tuple[str, int]:
         key = (snap.meta.model_string, snap.meta.task_id)
-        self._snaps[key] = snap
+        with self._lock:
+            self._snaps[key] = snap
         return key
 
     def get(self, model_string: str, task_id: int = -1) -> ServingSnapshot:
         key = (model_string, task_id)
-        if key not in self._snaps:
-            raise ServingError("snapshot", f"no snapshot registered for {key}",
-                               known=self.keys())
-        return self._snaps[key]
+        with self._lock:
+            if key not in self._snaps:
+                raise ServingError("snapshot",
+                                   f"no snapshot registered for {key}",
+                                   known=sorted(self._snaps))
+            return self._snaps[key]
 
     def load_all(self, db_path: str, spec: ModelSpec, data,
-                 window_type: str = "expanding", engine=None):
+                 window_type: str = "expanding", engine=None,
+                 batch: bool = True):
         """Freeze one snapshot per task found in ``db_path``; returns the
         registered keys.  Tasks whose freeze fails are skipped with their
         errors collected on ``self.last_errors`` (a dead task must not take
-        the whole registry down — including malformed params rows, which
-        raise shape errors from unpack, not ServingError)."""
+        the whole registry down).  ``batch=True`` (default) runs ONE vmapped
+        freeze across every well-formed row (one compile per boot instead of
+        one per distinct window end); ``batch=False`` keeps the serial
+        per-task loop — the reference path the batch is pinned against in
+        tests/test_serving.py."""
         all_params = read_all_task_params(db_path)
         keys, errors = [], {}
+        if batch and spec.is_kalman:
+            snaps, errors = freeze_snapshots_batch(
+                spec, all_params, data, window_type=window_type,
+                engine=engine)
+            keys = [self.put(s) for s in snaps]
+            self.last_errors = errors
+            return keys
         for task_id in sorted(all_params):
             meta = SnapshotMeta(model_string=spec.model_string,
                                 window_type=window_type, task_id=int(task_id))

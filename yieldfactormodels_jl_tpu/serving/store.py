@@ -1,0 +1,556 @@
+"""Mesh-resident serving state: the sharded snapshot registry (DESIGN §16).
+
+The single-service stack keeps every live filter state host-side — a dict of
+per-task pytrees (`SnapshotRegistry`), gathered to host and re-staged to
+device on every online update.  That is O(registry) host traffic per request
+and caps serving at one state per `YieldCurveService`.  This module is the
+device-scale replacement the ROADMAP's millions-of-users north star needs:
+
+- **State lives on the mesh.**  A :class:`ShardedStateStore` holds the live
+  per-user filter states — params, β, the covariance representation (P, or
+  its square-root factor for the sqrt engine), and version counters — as
+  device-RESIDENT arrays with the slot axis LAST (the lane rule), one shard
+  per mesh device (`parallel/mesh.make_mesh`).  ``global_view()`` assembles
+  the shards into batch-last ``NamedSharding`` global arrays
+  (`parallel/mesh.batch_last_sharding`) — the store IS the
+  ``P(None, batch)``-sharded registry, realized as per-device resident
+  slices so a micro-batch launches on exactly the shard that owns it.
+- **Slot management stays host-side and plain.**  A free-list per shard plus
+  a ``(model_string, task_id) → (shard, slot)`` map; registering writes one
+  slot through a donated scatter program (`online._jitted_slot_write`),
+  never touching the rest of the shard.  Eviction and the health-rebuild
+  path rewrite slots the same way — O(slot), not O(capacity).
+- **Updates are shard-routed micro-batches.**  ``update_batch`` groups
+  requests by owning shard, pads each group onto the lattice's
+  ``update_batch_sizes`` buckets, and runs ONE donated, compile-once SPMD
+  program per (shard, bucket) — `online._jitted_shard_update`, the
+  ``filter_step`` core in lanes over the whole shard with scatter-selected
+  slots.  A failed step keeps its resident slot in-program (sentinel NaN
+  candidate + taxonomy bits ride the batch); only the per-request curve
+  outputs return to host — O(batch) transfer, never O(registry).
+- **Snapshot banking keeps the host-copy last-good semantics.**  Every
+  accepted-and-healthy update banks host copies (β, cov-rep) per key; the
+  health watch (robustness/health.py) checks each accepted update's
+  returned moments, and a watch failure (or a fired ``nan_curve``/
+  ``nonpsd_cov`` chaos seam) rebuilds the slot from the bank — the §11
+  self-heal ladder at per-slot granularity.
+
+Driver-layer error policy (CLAUDE.md): the kernels only sentinel; THIS
+module decodes per-request taxonomy codes, and raises structured
+:class:`~.snapshot.ServingError` only for structural failures (unknown key,
+capacity exhausted, bad curve shape) — per-request numeric failures come
+back as degraded result dicts so one poisoned curve never fails its batch.
+
+Threading: the slot tables are lock-protected (the gateway worker thread
+and a health/ops thread may both mutate them); the device arrays themselves
+are single-writer — route all updates through one
+:class:`~.gateway.ShardedGateway` pump (which serializes), or serialize
+``update_batch`` calls yourself.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..orchestration import chaos
+from ..parallel import mesh as pmesh
+from ..robustness import health as rh
+from ..robustness import taxonomy as tax
+from ..utils.profiling import StageTimer
+from .batcher import BucketLattice, MicroBatcher
+from .online import (_check_engine, _jitted_shard_update, _jitted_slot_write,
+                     factor_cov)
+from .service import RequestCounters
+from .snapshot import (ServingError, ServingSnapshot, SnapshotMeta,
+                       SnapshotRegistry)
+
+Key = Tuple[str, int]
+
+
+def _route_waves(items, slot_map) -> List[Dict[int, list]]:
+    """Group an update micro-batch by OWNING SHARD — the routing step of the
+    request path (DESIGN §16 state machine), pure host dict/list work: no
+    device transfer may happen here (enforced by graftlint YFM008's
+    routing-path scan).  Returns a list of WAVES; each wave maps
+    ``shard → [(position, slot), ...]`` with at most one request per slot
+    (two updates for the same key in one batch commute through successive
+    waves, never through one scatter whose duplicate order is undefined).
+    Unknown keys land in pseudo-shard ``-1`` of the first wave."""
+    waves: List[Dict[int, list]] = []
+    remaining = list(enumerate(items))
+    first = True
+    while remaining:
+        seen, now, later = set(), {}, []
+        for pos, (key, y) in remaining:
+            loc = slot_map.get(key)
+            if loc is None:
+                if first:
+                    now.setdefault(-1, []).append((pos, -1))
+            elif key in seen:
+                later.append((pos, (key, y)))
+            else:
+                seen.add(key)
+                now.setdefault(loc[0], []).append((pos, loc[1]))
+        waves.append(now)
+        remaining, first = later, False
+    return waves
+
+
+class ShardedStateStore:
+    """Mesh-resident registry of live per-user filter states.
+
+    ``shard_capacity`` is PER SHARD (total capacity = shards × capacity), so
+    a mesh sweep at fixed shard capacity reuses one compiled program per
+    update bucket — mesh size never enters a program key.  ``engine`` picks
+    the per-slot recursion exactly as in :class:`~.service.YieldCurveService`
+    (``"univariate"`` propagates P, ``"sqrt"`` a square-root factor).
+
+    The store exposes the same operator surface as a service — ``counters``
+    / ``timer`` / ``batcher`` / ``health()`` / ``latency_summary()`` — so a
+    :class:`~.gateway.ShardedGateway` can sit in front of it unchanged and
+    the load harness reads ONE report (DESIGN §12 discipline).
+    """
+
+    def __init__(self, spec, *, mesh=None, n_shards: Optional[int] = None,
+                 shard_capacity: int = 64, engine: str = "univariate",
+                 lattice: Optional[BucketLattice] = None,
+                 registry: Optional[SnapshotRegistry] = None,
+                 donate: bool = True, timer: Optional[StageTimer] = None,
+                 axis_name: str = "batch"):
+        _check_engine(engine)
+        if shard_capacity < 1:
+            raise ValueError(f"shard_capacity must be >= 1, "
+                             f"got {shard_capacity}")
+        self.spec = spec
+        self.engine = engine
+        self.mesh = mesh if mesh is not None \
+            else pmesh.make_mesh(n_shards, axis_name=axis_name)
+        self._axis_name = axis_name
+        self._devices = pmesh.shard_devices(self.mesh)
+        self.n_shards = len(self._devices)
+        self.shard_capacity = int(shard_capacity)
+        self.lattice = lattice if lattice is not None else BucketLattice()
+        self.registry = registry
+        self._donate = bool(donate)
+        self.timer = timer if timer is not None else StageTimer()
+        self.counters = RequestCounters()
+        self.batcher = MicroBatcher(self.lattice)
+        self.rebuilds = 0
+        self.last_update = None
+        self._last_code = 0
+        self._lock = threading.Lock()
+        self._slot: Dict[Key, Tuple[int, int]] = {}
+        self._free: List[List[int]] = [list(range(self.shard_capacity))
+                                       for _ in range(self.n_shards)]
+        self._meta: Dict[Key, SnapshotMeta] = {}
+        self._bank: Dict[Key, Tuple[np.ndarray, np.ndarray]] = {}
+        self._stale: set = set()
+        dtype = spec.dtype
+        Pn, Ms, Cs = spec.n_params, spec.state_dim, self.shard_capacity
+        self._shards = []
+        for d in self._devices:
+            self._shards.append({
+                "params": jax.device_put(jnp.zeros((Pn, Cs), dtype=dtype), d),
+                "beta": jax.device_put(jnp.zeros((Ms, Cs), dtype=dtype), d),
+                "cov": jax.device_put(jnp.zeros((Ms, Ms, Cs), dtype=dtype),
+                                      d),
+                "ver": jax.device_put(jnp.zeros((Cs,), dtype=jnp.int32), d),
+            })
+
+    # ---- introspection ----------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._slot)
+
+    def __contains__(self, key: Key) -> bool:
+        with self._lock:
+            return key in self._slot
+
+    @property
+    def capacity(self) -> int:
+        return self.n_shards * self.shard_capacity
+
+    def keys(self):
+        with self._lock:
+            return sorted(self._slot)
+
+    def shard_of(self, key: Key) -> int:
+        with self._lock:
+            if key not in self._slot:
+                raise ServingError("store", f"no state registered for {key}")
+            return self._slot[key][0]
+
+    def global_view(self) -> dict:
+        """The store as batch-last mesh-sharded GLOBAL arrays — zero-copy
+        assembly of the per-device resident shards under
+        ``NamedSharding(mesh, P(None, ..., axis))``.  Introspection/export
+        only: mutation goes through the per-shard donated programs."""
+        out = {}
+        for name, nd in (("params", 2), ("beta", 2), ("cov", 3), ("ver", 1)):
+            shards = [self._shards[s][name] for s in range(self.n_shards)]
+            gshape = tuple(shards[0].shape[:-1]) + (self.capacity,)
+            sharding = pmesh.batch_last_sharding(self.mesh, nd,
+                                                 self._axis_name)
+            out[name] = jax.make_array_from_single_device_arrays(
+                gshape, sharding, shards)
+        return out
+
+    # ---- slot lifecycle ---------------------------------------------------
+
+    def _write_state(self, s: int, sl: int, beta, cov, ver: int,
+                     params=None) -> None:
+        """Rewrite ONE slot of shard ``s`` through the donated scatter
+        program — O(slot) work, the shard is never gathered."""
+        sh = self._shards[s]
+        dtype = self.spec.dtype
+        p = sh["params"][:, sl] if params is None \
+            else jnp.asarray(params, dtype=dtype).reshape(-1)
+        writer = _jitted_slot_write(self.spec, self.shard_capacity,
+                                    self._donate)
+        sh["params"], sh["beta"], sh["cov"], sh["ver"] = writer(
+            sh["params"], sh["beta"], sh["cov"], sh["ver"],
+            jnp.asarray(sl, dtype=jnp.int32), p,
+            jnp.asarray(beta, dtype=dtype),
+            jnp.asarray(cov, dtype=dtype),
+            jnp.asarray(ver, dtype=jnp.int32))
+
+    def register(self, snapshot: ServingSnapshot) -> Key:
+        """Admit one frozen snapshot: allocate a slot on the least-loaded
+        shard, factor the covariance into the engine representation, write
+        the slot (donated scatter), bank the host-copy last-good."""
+        key = (snapshot.meta.model_string, snapshot.meta.task_id)
+        try:
+            cov = factor_cov(snapshot.P, self.engine, self.spec.dtype)
+        except ValueError:
+            raise ServingError("store", "filtered covariance is not PSD — "
+                               "cannot start the sqrt engine", key=key)
+        with self._lock:
+            if key in self._slot:
+                raise ServingError("store", f"key {key} already registered — "
+                                   "evict it first", key=key)
+            frees = [len(f) for f in self._free]
+            s = int(np.argmax(frees))
+            if frees[s] == 0:
+                raise ServingError(
+                    "store", f"capacity exhausted ({self.capacity} slots on "
+                    f"{self.n_shards} shards) — widen shard_capacity or the "
+                    f"mesh", key=key)
+            sl = self._free[s].pop()
+            self._write_state(s, sl, snapshot.beta, cov,
+                              snapshot.meta.version, params=snapshot.params)
+            self._slot[key] = (s, sl)
+            self._meta[key] = snapshot.meta
+            self._bank[key] = (np.asarray(snapshot.beta, dtype=np.float64),
+                               np.asarray(cov, dtype=np.float64))
+        return key
+
+    def register_many(self, snapshots) -> List[Key]:
+        """Bulk warm-boot registration.  On an EMPTY store the shards are
+        assembled host-side and shipped with ONE placement per shard array
+        (no per-slot programs — the warm-boot path must not pay thousands of
+        scatter launches); on a non-empty store it falls back to per-slot
+        :meth:`register` so resident state is never gathered."""
+        snapshots = list(snapshots)
+        dtype = self.spec.dtype
+        # validate + factor EVERYTHING before touching any table or shard:
+        # a mid-list failure must leave the store exactly as it was, never
+        # half-registered (review finding: a partial bulk boot would alias
+        # later tenants onto zero-state slots)
+        if len(snapshots) > self.capacity:
+            raise ServingError(
+                "store", f"{len(snapshots)} snapshots exceed capacity "
+                f"{self.capacity} ({self.n_shards} shards × "
+                f"{self.shard_capacity})")
+        staged = []
+        seen = set()
+        for snap in snapshots:
+            key = (snap.meta.model_string, snap.meta.task_id)
+            if key in seen:
+                raise ServingError("store", f"key {key} appears twice in "
+                                   "the bulk registration", key=key)
+            seen.add(key)
+            try:
+                cov = np.asarray(factor_cov(snap.P, self.engine, dtype))
+            except ValueError:
+                raise ServingError("store", "filtered covariance is not "
+                                   "PSD — cannot start the sqrt engine",
+                                   key=key)
+            staged.append((key, snap, cov))
+        with self._lock:
+            if self._slot:
+                empty = False
+            else:
+                empty = True
+                Pn, Ms, Cs = self.spec.n_params, self.spec.state_dim, \
+                    self.shard_capacity
+                staging = [{"params": np.zeros((Pn, Cs)),
+                            "beta": np.zeros((Ms, Cs)),
+                            "cov": np.zeros((Ms, Ms, Cs)),
+                            "ver": np.zeros((Cs,), dtype=np.int32)}
+                           for _ in range(self.n_shards)]
+                keys = []
+                for i, (key, snap, cov) in enumerate(staged):
+                    s, sl = i % self.n_shards, i // self.n_shards
+                    st = staging[s]
+                    st["params"][:, sl] = np.asarray(snap.params).reshape(-1)
+                    st["beta"][:, sl] = np.asarray(snap.beta)
+                    st["cov"][:, :, sl] = cov
+                    st["ver"][sl] = snap.meta.version
+                    self._slot[key] = (s, sl)
+                    self._meta[key] = snap.meta
+                    self._bank[key] = (
+                        np.asarray(snap.beta, dtype=np.float64),
+                        np.asarray(cov, dtype=np.float64))
+                    keys.append(key)
+                for s, (st, d) in enumerate(zip(staging, self._devices)):
+                    taken = {sl for (sh, sl) in self._slot.values()
+                             if sh == s}
+                    self._free[s] = [sl for sl in range(Cs)
+                                     if sl not in taken]
+                    self._shards[s] = {
+                        name: jax.device_put(
+                            jnp.asarray(st[name], dtype=dtype)
+                            if name != "ver" else jnp.asarray(st[name]), d)
+                        for name in ("params", "beta", "cov", "ver")}
+        if not empty:
+            # non-empty store: per-slot path (resident state never gathered,
+            # and nothing was mutated above beyond the validation pass)
+            return [self.register(s) for s in snapshots]
+        return keys
+
+    def evict(self, key: Key) -> None:
+        """Free a key's slot (zeroed through the scatter program so a stale
+        state can never be read back by a later tenant)."""
+        with self._lock:
+            if key not in self._slot:
+                raise ServingError("store", f"no state registered for {key}")
+            s, sl = self._slot.pop(key)
+            Ms = self.spec.state_dim
+            self._write_state(s, sl, np.zeros(Ms), np.zeros((Ms, Ms)), 0,
+                              params=np.zeros(self.spec.n_params))
+            self._free[s].append(sl)
+            self._meta.pop(key, None)
+            self._bank.pop(key, None)
+            self._stale.discard(key)
+
+    def _rebuild_slot(self, key: Key, s: int, sl: int) -> None:
+        """The §11 heal path at slot granularity: rewrite the slot from the
+        banked last-good host copies, falling back to the frozen registry
+        entry when even the bank fails the watch.  Never gathers the shard."""
+        beta, cov = self._bank[key]
+        if rh.state_health(beta, cov, self.engine)["code"] != tax.OK \
+                and self.registry is not None:
+            try:
+                snap = self.registry.get(*key)
+                cov = np.asarray(factor_cov(snap.P, self.engine,
+                                            self.spec.dtype))
+                beta = np.asarray(snap.beta, dtype=np.float64)
+                self._bank[key] = (beta, cov)
+            except (ServingError, ValueError):
+                pass  # bank is still the best available source
+        self._write_state(s, sl, beta, cov, self._meta[key].version)
+        self.rebuilds += 1
+
+    # ---- the update path --------------------------------------------------
+
+    def update_batch(self, items, dates=None) -> List[dict]:
+        """Advance many keys' states by one observed curve each, routed to
+        the shards that own them.  ``items`` is ``[(key, yields), ...]``;
+        returns one result dict per item IN ORDER: ``{"ll", "version",
+        "stale"}`` on success, ``{"ll": nan, "degraded": True, ...}`` on a
+        per-request numeric failure (state kept / rebuilt per §11), or
+        ``{"error": ServingError}`` for structural failures — one poisoned
+        request never fails its batch (worker-isolation contract)."""
+        res: List[Optional[dict]] = [None] * len(items)
+        staged = []
+        N = self.spec.N
+        for pos, (key, y) in enumerate(items):
+            y = np.asarray(y, dtype=np.float64).reshape(-1)
+            if y.shape[0] != N:
+                res[pos] = {"error": ServingError(
+                    "update", f"curve has {y.shape[0]} maturities, spec has "
+                    f"{N}", key=key)}
+                continue
+            staged.append((pos, key, y))
+        routed = [(k, y) for _, k, y in staged]
+        with self._lock:
+            waves = _route_waves(routed, self._slot)
+        bmax = self.lattice.update_batch_sizes[-1]
+        for wave in waves:
+            for s, group in sorted(wave.items()):
+                if s < 0:
+                    for gpos, _ in group:
+                        pos, key, _ = staged[gpos]
+                        res[pos] = {"error": ServingError(
+                            "update", f"no state registered for {key}",
+                            key=key)}
+                    continue
+                for lo in range(0, len(group), bmax):
+                    self._launch_chunk(s, group[lo:lo + bmax], staged, dates,
+                                       res)
+        return res  # every position filled: staged ∪ shape-rejected
+
+    def _launch_chunk(self, s: int, chunk, staged, dates, res) -> None:
+        """One (shard, bucket) donated launch + host-side collection.  The
+        padded request arrays go in as plain host buffers (jit stages them
+        onto the owning shard's device alongside the committed resident
+        state — no per-input device_put dispatches on the hot path)."""
+        bb = self.lattice.update_bucket(len(chunk))
+        N = self.spec.N
+        Y = np.full((N, bb), np.nan, dtype=self.spec.dtype)
+        slots = np.zeros((bb,), dtype=np.int32)
+        valid = np.zeros((bb,), dtype=bool)
+        for j, (gpos, sl) in enumerate(chunk):
+            Y[:, j] = staged[gpos][2]
+            slots[j], valid[j] = sl, True
+        sh = self._shards[s]
+        runner = _jitted_shard_update(self.spec, self.engine,
+                                      self.shard_capacity, bb, self._donate)
+        outs = runner(sh["params"], sh["beta"], sh["cov"], sh["ver"],
+                      Y, slots, valid)
+        sh["params"], sh["beta"], sh["cov"], sh["ver"] = outs[:4]
+        self._collect(s, chunk, staged, dates, outs[4:], res)
+
+    def _collect(self, s: int, chunk, staged, dates, curve_outs, res) -> None:
+        """The RESPONSE BOUNDARY: the per-request curve outputs (O(batch))
+        come to host here — one fetch — and nowhere earlier on the update
+        path; then each request gets the driver-layer verdict: taxonomy
+        decode, batched health watch, chaos seams, slot rebuild, last-good
+        banking."""
+        lls, oks, codes, vers, betas, covs = jax.device_get(curve_outs)
+        watch = rh.state_health_batch(betas, covs, self.engine)
+        for j, (gpos, sl) in enumerate(chunk):
+            pos, key, _ = staged[gpos]
+            ok, code = bool(oks[j]), int(codes[j])
+            b_h = np.asarray(betas[:, j], dtype=np.float64)
+            c_h = np.asarray(covs[:, :, j], dtype=np.float64)
+            injected = False
+            if ok and chaos.should_inject("nan_curve"):
+                # numeric chaos (DESIGN §11): poison that made it INTO the
+                # accepted resident slot — written to device so the rebuild
+                # genuinely repairs corrupted mesh state, not a host mirage
+                b_h = np.full_like(b_h, np.nan)
+                c_h = np.full_like(c_h, np.nan)
+                self._write_state(s, sl, b_h, c_h, int(vers[j]))
+                code |= tax.NAN_STATE
+                injected = True
+            if ok and chaos.should_inject("nonpsd_cov"):
+                c_h = c_h - 2.0 * np.eye(c_h.shape[0])
+                self._write_state(s, sl, b_h, c_h, int(vers[j]))
+                code |= tax.NONPSD_COV
+                injected = True
+            if ok and not injected:
+                code |= int(watch[j])
+            if ok and not injected and code == 0:
+                # accepted and healthy: bank host copies, sync the meta
+                with self._lock:
+                    self._meta[key] = self._meta[key].bump()
+                    self._bank[key] = (b_h, c_h)
+                    self._stale.discard(key)
+                if dates is not None:
+                    self.last_update = dates[pos]
+                res[pos] = {"ll": float(lls[j]),
+                            "version": int(vers[j]), "stale": False}
+                continue
+            # degraded: kernel reject (state untouched in-program) needs no
+            # rebuild; an accepted-then-unhealthy/chaos-corrupted slot does
+            if ok:
+                with self.timer.stage("rebuild"):
+                    with self._lock:
+                        self._rebuild_slot(key, s, sl)
+            with self._lock:
+                self._stale.add(key)
+            self._last_code = code
+            res[pos] = {"ll": float("nan"), "degraded": True, "stale": True,
+                        "version": self._meta[key].version,
+                        "code": tax.describe(code)}
+
+    # ---- read-side snapshots ---------------------------------------------
+
+    def snapshot_of(self, key: Key) -> ServingSnapshot:
+        """The key's LIVE state as a snapshot with DEVICE leaves (params, β,
+        P) — slot-sized device slices, no host transfer: forecast/scenario
+        requests ride these through the shared micro-batcher and only the
+        batcher's outputs cross to host (the response boundary)."""
+        with self._lock:
+            if key not in self._slot:
+                raise ServingError("store", f"no state registered for {key}")
+            s, sl = self._slot[key]
+            meta = self._meta[key]
+        sh = self._shards[s]
+        c = sh["cov"][:, :, sl]
+        P = c @ c.T if self.engine == "sqrt" else c
+        return ServingSnapshot(self.spec, sh["params"][:, sl],
+                               sh["beta"][:, sl], P, meta)
+
+    def last_good_snapshot_of(self, key: Key) -> ServingSnapshot:
+        """The banked last-good state (host copies) as a snapshot — what a
+        deadline-degraded answer is served from (DESIGN §12)."""
+        with self._lock:
+            if key not in self._bank:
+                raise ServingError("store", f"no state registered for {key}")
+            beta, cov = self._bank[key]
+            meta = self._meta[key]
+        P = cov @ cov.T if self.engine == "sqrt" else cov
+        return ServingSnapshot(self.spec, None, beta, P, meta)
+
+    # ---- observability / warmup ------------------------------------------
+
+    def health(self) -> dict:
+        with self._lock:
+            live, stale = len(self._slot), len(self._stale)
+            free = sum(len(f) for f in self._free)
+        return {
+            "status": "stale" if stale else "ok",
+            "engine": self.engine,
+            "shards": self.n_shards,
+            "shard_capacity": self.shard_capacity,
+            "live": live,
+            "free": free,
+            "stale_keys": stale,
+            "rebuilds": self.rebuilds,
+            "last_code": self._last_code,
+            "last_code_names": tax.decode(self._last_code),
+            "requests": self.counters.to_dict(),
+        }
+
+    def latency_summary(self) -> dict:
+        return {**self.timer.summary(), "counters": self.counters.to_dict()}
+
+    def warmup(self, horizons=None, batch_sizes=(1,),
+               scenario_counts=()) -> int:
+        """Pre-trace every shard-update bucket program ON EVERY SHARD (an
+        all-padding launch is an exact no-op: ``valid`` all false, every slot
+        passes through) plus the read-path bucket programs for one registered
+        snapshot.  Returns programs touched."""
+        n = 0
+        with self.timer.stage("warmup"):
+            for bb in self.lattice.update_batch_sizes:
+                runner = _jitted_shard_update(self.spec, self.engine,
+                                              self.shard_capacity, bb,
+                                              self._donate)
+                # request arrays staged EXACTLY like _launch_chunk's (plain
+                # host buffers): a different staging signature here would
+                # compile a second executable per (device, bucket) and the
+                # first live request would pay it on the hot path
+                Y = np.full((self.spec.N, bb), np.nan, dtype=self.spec.dtype)
+                slots = np.zeros((bb,), dtype=np.int32)
+                valid = np.zeros((bb,), dtype=bool)
+                for sh in self._shards:
+                    outs = runner(sh["params"], sh["beta"], sh["cov"],
+                                  sh["ver"], Y, slots, valid)
+                    sh["params"], sh["beta"], sh["cov"], sh["ver"] = outs[:4]
+                    n += 1
+            keys = self.keys()
+            if keys:
+                n += self.batcher.warmup(self.snapshot_of(keys[0]),
+                                         horizons=horizons,
+                                         batch_sizes=batch_sizes,
+                                         scenario_counts=scenario_counts)
+        return n
