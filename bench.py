@@ -588,6 +588,31 @@ def main():
         except Exception as e:  # never kill the bench line
             scen_ctx = f"; scen bench failed ({type(e).__name__}: {e})"
 
+    # ---- second-order multi-start MLE (opt-in: BENCH_NEWTON=1) ----
+    # LBFGS-only vs the coarse-LBFGS -> trust-region-Newton cascade
+    # (ops/newton.py, docs/DESIGN.md §17) at matched g_tol on the
+    # config-2-shaped multi-start.  ALWAYS a CPU-pinned float64 subprocess
+    # (the comparison is an optimizer-convergence claim, not a device
+    # throughput claim; matched-tolerance convergence in f32 is
+    # noise-bound) — the main JSON's device_fallback stamp covers it.
+    newton_ctx = ""
+    if os.environ.get("BENCH_NEWTON", "0") not in ("0", ""):
+        try:
+            nenv = {**os.environ, "JAX_PLATFORMS": "cpu",
+                    "JAX_ENABLE_X64": "1"}
+            nenv.pop("PALLAS_AXON_POOL_IPS", None)
+            nenv.pop("JAX_COMPILATION_CACHE_DIR", None)
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--newton-bench"],
+                env=nenv, capture_output=True, text=True, timeout=3600)
+            tail = (proc.stdout.strip().splitlines() or ["no output"])[-1]
+            newton_ctx = (f"; {tail}" if "newton-bench" in tail else
+                          f"; newton-bench subprocess failed rc="
+                          f"{proc.returncode} ({tail[:200]})")
+        except Exception as e:  # never kill the bench line
+            newton_ctx = f"; newton bench failed ({type(e).__name__}: {e})"
+
     # ---- robustness microbenchmark (opt-in: BENCH_ROBUST=1) ----
     # (a) healthy-path cost of the failure-taxonomy channel: the same jitted
     # batch evaluated through get_loss vs get_loss_coded — the codes ride
@@ -687,7 +712,8 @@ def main():
           f"| pallas {pallas_rate} evals/s; kernels agree: joint={agree} "
           f"pallas={pallas_agree}; finite: {n_finite}/{BATCH}; "
           f"cpu ll sample {ll_cpu:.2f}{grad_ctx}{ssd_ctx}{serving_ctx}"
-          f"{load_ctx}{orch_ctx}{longt_ctx}{scen_ctx}{robust_ctx}; "
+          f"{load_ctx}{orch_ctx}{longt_ctx}{scen_ctx}{newton_ctx}"
+          f"{robust_ctx}; "
           f"roofline: {flops_per_eval/1e6:.3f} MFLOP/eval -> "
           f"univariate {gflops(dev_evals_per_sec):.1f} | "
           f"joint {gflops(BATCH / t_joint):.1f} | "
@@ -828,6 +854,96 @@ def _longt_bench():
     """Subprocess mode for the CPU-fallback path (the caller exports
     JAX_PLATFORMS=cpu + the 8-virtual-device XLA flag before jax inits)."""
     print(_longt_line())
+    return 0
+
+
+def _newton_bench():
+    """Subprocess mode (CPU, float64 — exported by the caller before jax
+    inits): LBFGS-only vs the two-phase second-order cascade at matched
+    ``g_tol`` on the config-2-shaped multi-start (AFNS5, T=360,
+    ``BENCH_NEWTON_STARTS`` perturbed stationary starts).
+
+    The LBFGS-only side gets the REAL first-order budget
+    (``BENCH_NEWTON_ITERS``, default 400 — at matched ``g_tol`` it either
+    converges or demonstrably stalls on the penalty surface, which is the
+    workload the cascade replaces); the cascade side uses its own internal
+    coarse budget (optimize._NEWTON_COARSE_ITERS) plus the polish.  With
+    ``BENCH_NEWTON_REPS=1`` (the default) the two sides compare COLD —
+    compile cost included on both, conservative for the cascade since it
+    compiles strictly more programs; ``reps>1`` warms both once and
+    reports p50 over interleaved warm rounds (1-core contention drifts
+    into both equally).
+
+    Filter-pass eval-equivalent convention: value pass = 1, value+grad =
+    3 (forward + reverse ≈ 2 value passes), backtracking probe = 1 (so an
+    L-BFGS iteration ≥ 4 — an undercount when the 80-probe backtracking
+    budget is burning, which favors the baseline), and one dense
+    trust-region attempt = 6: the P-direction curvature sweep rides ONE
+    vectorized ``jax.linearize`` scan (measured ≈2 value-pass cost at
+    P≈33 on this box — NOT P separate passes) + value+grad (3) + trial
+    probe (1).  The acceptance figure is ISSUE 12's: >=2x fewer
+    eval-equivalents or >=1.5x lower wall p50 at matched ``g_tol``, final
+    best losses matching within 1e-6 or better on the cascade side."""
+    import jax
+    import numpy as np
+
+    from yieldfactormodels_jl_tpu import create_model
+    from yieldfactormodels_jl_tpu.estimation import optimize as opt
+
+    import jax.numpy as jnp
+
+    from yieldfactormodels_jl_tpu.models import api
+
+    S = int(os.environ.get("BENCH_NEWTON_STARTS", "4"))
+    reps = int(os.environ.get("BENCH_NEWTON_REPS", "1"))
+    max_iters = int(os.environ.get("BENCH_NEWTON_ITERS", "400"))
+    g_tol = float(os.environ.get("BENCH_NEWTON_GTOL", "1e-5"))
+    spec, _ = create_model("AFNS5", tuple(MATURITIES), float_type="float64")
+    batch = np.asarray(make_param_batch(spec, S), dtype=np.float64)
+    # the panel is simulated FROM the model at the batch's base point: the
+    # matched-tolerance comparison needs an optimum both optimizers can
+    # actually approach (make_panel()'s DGP offset parks every start in
+    # linesearch-death at useless points — measured)
+    data = np.asarray(api.simulate(spec, jnp.asarray(batch[0]), T_MONTHS,
+                                   jax.random.PRNGKey(9))["data"])
+    # make_param_batch returns CONSTRAINED stationary draws (S, P) -> (P, S)
+    starts = batch.T
+    Pn = spec.n_params
+
+    def run(second_order):
+        _, ll, _, _ = opt.estimate(spec, data, starts, max_iters=max_iters,
+                                   g_tol=g_tol, f_abstol=1e-8,
+                                   second_order=second_order)
+        return ll, opt.last_multistart_report()
+
+    if reps > 1:  # warm/compile both paths once, then interleave timed reps
+        run(False), run("fisher")
+    w_base, w_so = [], []
+    for _ in range(reps):
+        t0 = time.perf_counter(); ll_base, rep_base = run(False)
+        w_base.append(time.perf_counter() - t0)
+        t0 = time.perf_counter(); ll_so, rep_so = run("fisher")
+        w_so.append(time.perf_counter() - t0)
+    p50_base = float(np.median(w_base))
+    p50_so = float(np.median(w_so))
+    # eval-equivalent accounting (convention in the docstring)
+    evals_base = 4.0 * sum(rep_base["iters"])
+    n = rep_so["newton"] or {"iters": [0] * S, "cg_iters": [0] * S}
+    coarse_iters = sum(rep_so["iters"]) - sum(n["iters"])
+    # cg_iters counts curvature sweeps: P per dense-TR attempt; the sweep
+    # itself is ONE vectorized linearize scan (≈2 value passes), not P
+    attempts = sum(n["cg_iters"]) / max(Pn, 1)
+    evals_so = 4.0 * coarse_iters + attempts * (2.0 + 3 + 1)
+    match = abs(ll_base - ll_so) <= 1e-6 or ll_so >= ll_base
+    print(f"newton-bench[AFNS5 f64 S={S} T={T_MONTHS} g_tol={g_tol:g}]: "
+          f"lbfgs-only {p50_base:.1f} s p50 ({sum(rep_base['iters'])} iters,"
+          f" {evals_base:.0f} pass-eq) vs cascade {p50_so:.1f} s p50 "
+          f"({coarse_iters} coarse + {sum(n['iters'])} newton iters, "
+          f"{evals_so:.0f} pass-eq) -> wall {p50_base / p50_so:.2f}x, "
+          f"evals {evals_base / max(evals_so, 1.0):.2f}x; "
+          f"best ll lbfgs {ll_base:.6f} vs cascade {ll_so:.6f} "
+          f"(match-or-better: {match}); conv "
+          f"{sum(rep_base['converged'])}/{S} vs {sum(rep_so['converged'])}/{S}")
     return 0
 
 
@@ -1126,6 +1242,8 @@ if __name__ == "__main__":
         sys.exit(_orch_bench())
     elif "--longt-bench" in sys.argv:
         sys.exit(_longt_bench())
+    elif "--newton-bench" in sys.argv:
+        sys.exit(_newton_bench())
     elif "--load-mesh-bench" in sys.argv:
         sys.exit(_load_mesh_bench())
     elif "--inner" in sys.argv:

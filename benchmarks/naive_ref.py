@@ -421,6 +421,54 @@ def unit_ssd_nns_pass():
     return time.perf_counter() - t0, "1 full-panel pass (FD inner score)"
 
 
+def unit_newton_iteration():
+    """Measured seconds for ONE naive second-order iteration at the DNS3
+    config: the reference-equivalent way to get a Newton step is a
+    finite-difference Hessian of the filter loglik — (P+1)² per-step NumPy
+    filter replays (FD-of-FD-gradient, the ForwardDiff-Hessian stand-in) —
+    plus the P+1-pass gradient it rides on.  This is the BENCH_NEWTON
+    cascade's naive denominator: ops/newton.py's dense Fisher solve prices
+    the same curvature at ~P linearized passes for the WHOLE start batch
+    in one program (docs/DESIGN.md §17)."""
+    from yieldfactormodels_jl_tpu import create_model
+
+    spec, _ = create_model("1C", tuple(common.MATURITIES),
+                           float_type="float32")
+    data = np.asarray(common.dns_panel(), dtype=np.float64)
+    p0 = np.asarray(common.dns_params(spec), dtype=np.float64)
+    codes = np.asarray(spec.transform_codes)
+    raw0 = _np_untransform(codes, p0)
+    P = raw0.shape[0]
+    npass = [0]
+
+    def nll(raw):
+        npass[0] += 1
+        Z, Phi, delta, Om, ov = _dns3_matrices(spec, _np_transform(codes, raw))
+        try:
+            ll = oracle.kalman_filter_loglik(Z, Phi, delta, Om, ov, data)
+        except np.linalg.LinAlgError:
+            return 1e12
+        return -ll if np.isfinite(ll) else 1e12
+
+    t0 = time.perf_counter()
+    eps = 1e-5 * np.maximum(1.0, np.abs(raw0))
+    g = np.zeros(P)
+    for i in range(P):  # forward-difference gradient: P+1 passes
+        e = np.zeros(P); e[i] = eps[i]
+        g[i] = (nll(raw0 + e) - nll(raw0)) / eps[i]
+    H = np.zeros((P, P))
+    for i in range(P):  # FD of the FD gradient: (P+1)·P more passes
+        e = np.zeros(P); e[i] = eps[i]
+        for j in range(P):
+            ej = np.zeros(P); ej[j] = eps[j]
+            H[i, j] = ((nll(raw0 + e + ej) - nll(raw0 + e))
+                       / eps[j] - g[j]) / eps[i]
+    np.linalg.solve(0.5 * (H + H.T) + 1e-8 * np.eye(P), -g)
+    wall = time.perf_counter() - t0
+    return wall, (f"{npass[0]} filter passes for one FD-Hessian Newton "
+                  f"iteration (P={P})")
+
+
 RUNNERS = {
     "dns3-mle": naive_dns3_mle,
     "afns5-sv-pf": naive_afns5_sv_pf,
@@ -429,6 +477,7 @@ RUNNERS = {
     "unit-longt-pass": unit_longt_pass,
     "unit-ssd-pass": unit_ssd_nns_pass,
     "scenario-fan": naive_scenario_fan,
+    "unit-newton-iteration": unit_newton_iteration,
 }
 
 

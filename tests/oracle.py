@@ -842,3 +842,31 @@ def crps_sample_naive(samples, y):
     t1 = np.mean([abs(xi - y) for xi in x])
     t2 = sum(abs(xi - xj) for xi in x for xj in x) / (2.0 * m * m)
     return float(t1 - t2)
+
+
+def fd_hessian(fun, x, eps=1e-4):
+    """Central-difference Hessian of a scalar callable — independent NumPy
+    loops, the second-order parity oracle (tests/test_newton.py pins the
+    HVP recursions of ops/newton.py against it at ``stable_1c_params`` /
+    ``stable_ns_params``).
+
+    H[i, j] = (f(x+e_i+e_j) - f(x+e_i-e_j) - f(x-e_i+e_j) + f(x-e_i-e_j))
+              / (4 eps_i eps_j)
+
+    with per-coordinate steps eps_i = eps * max(1, |x_i|); the result is
+    symmetrized.  ``fun`` must be float64-evaluable at every probe (pass a
+    penalty-clamped objective if the region is fragile).
+    """
+    x = np.asarray(x, dtype=np.float64)
+    P = x.shape[0]
+    h = eps * np.maximum(1.0, np.abs(x))
+    H = np.zeros((P, P))
+    for i in range(P):
+        for j in range(i, P):
+            ei = np.zeros(P); ei[i] = h[i]
+            ej = np.zeros(P); ej[j] = h[j]
+            H[i, j] = (fun(x + ei + ej) - fun(x + ei - ej)
+                       - fun(x - ei + ej) + fun(x - ei - ej)) \
+                / (4.0 * h[i] * h[j])
+            H[j, i] = H[i, j]
+    return H

@@ -429,6 +429,40 @@ def test_yfm007_engine_named_without_oracle_import_does_not_count(tmp_path):
     assert len(res.findings) == 2
 
 
+def _newton_engine_tree(tmp_path, tests_body):
+    cfgpath = tmp_path / PKG / "config.py"
+    cfgpath.parent.mkdir(parents=True, exist_ok=True)
+    cfgpath.write_text('KALMAN_ENGINES = ("univariate",)\n'
+                       'NEWTON_ENGINES = ("fisher", "exact")\n')
+    tdir = tmp_path / "tests"
+    tdir.mkdir(exist_ok=True)
+    (tdir / "test_parity.py").write_text(textwrap.dedent(tests_body))
+    (tmp_path / "CLAUDE.md").write_text("")
+    return LintConfig(root=str(tmp_path))
+
+
+def test_yfm007_fires_on_uncovered_newton_engine(tmp_path):
+    # the second-order registry rides the same parity contract as
+    # KALMAN_ENGINES: a NEWTON_ENGINES entry with no oracle-backed mention
+    # must fire
+    cfg = _newton_engine_tree(tmp_path, """\
+        from .oracle import fd_hessian
+        ENGINES = ("univariate", "fisher")  # 'exact' uncovered
+    """)
+    res = run_lint(cfg, files=[], rules=["YFM007"])
+    assert [f.rule for f in res.findings] == ["YFM007"]
+    assert "'exact'" in res.findings[0].message
+
+
+def test_yfm007_quiet_when_newton_engines_oracle_covered(tmp_path):
+    cfg = _newton_engine_tree(tmp_path, """\
+        from .oracle import fd_hessian
+        ENGINES = ("univariate", "fisher", "exact")
+    """)
+    res = run_lint(cfg, files=[], rules=["YFM007"])
+    assert not res.findings
+
+
 # ---------------------------------------------------------------------------
 # YFM008 — request-path hygiene
 # ---------------------------------------------------------------------------

@@ -1,0 +1,268 @@
+"""Second-order engine tests (ops/newton.py, docs/DESIGN.md §17).
+
+Parity chain for the HVP recursions, per the engine-parity convention
+(graftlint YFM007 — both ``config.NEWTON_ENGINES`` entries, "fisher" and
+"exact", are pinned here against tests/oracle.py):
+
+- the "exact" recursion (grad-of-directional-derivative, reverse over the
+  tangent scan) vs the independent finite-difference NumPy Hessian oracle
+  (``oracle.fd_hessian``) AND vs ``jax.jvp``-of-grad — the OPPOSITE
+  differentiation order, so agreement is a real check, not an identity;
+- the "fisher" matrix vs its own HVP composition, plus the structural
+  facts the trust-region solver relies on (symmetry, PSD);
+- the cascade: ``estimate(..., second_order=...)`` matches/beats the
+  first-order path on the seed configs, ``second_order=False`` reproduces
+  it bit-for-bit, and dead starts keep their sentinels.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.oracle import (fd_hessian, simulate_dns_panel, stable_1c_params,
+                          stable_ns_params)
+from yieldfactormodels_jl_tpu import create_model
+from yieldfactormodels_jl_tpu.estimation import optimize as opt
+from yieldfactormodels_jl_tpu.estimation.scenario import refit_column
+from yieldfactormodels_jl_tpu.models import api
+from yieldfactormodels_jl_tpu.models.params import untransform_params
+from yieldfactormodels_jl_tpu.ops import newton as NT
+from yieldfactormodels_jl_tpu.robustness import taxonomy as tax
+
+MATS = (3.0, 6.0, 12.0, 24.0, 36.0, 60.0, 84.0, 120.0, 240.0, 360.0)
+
+
+def _mats():
+    return tuple(m / 12.0 for m in MATS)
+
+
+def _raw_point(spec, p):
+    return jnp.asarray(
+        opt._sanitize(np.asarray(untransform_params(spec, jnp.asarray(p)))),
+        dtype=jnp.float64)
+
+
+@pytest.fixture(scope="module")
+def dns_setup():
+    spec, _ = create_model("1C", _mats(), float_type="float64")
+    p = stable_1c_params(spec, np.float64)
+    data = np.asarray(
+        api.simulate(spec, jnp.asarray(p), 60, jax.random.PRNGKey(3))["data"])
+    return spec, p, jnp.asarray(data)
+
+
+@pytest.fixture(scope="module")
+def ns_setup():
+    spec, _ = create_model("NS", _mats(), float_type="float64")
+    p = stable_ns_params(spec, np.float64)
+    rng = np.random.default_rng(7)
+    data = jnp.asarray(simulate_dns_panel(rng, np.asarray(_mats()), T=50))
+    return spec, p, data
+
+
+# ---------------------------------------------------------------------------
+# HVP parity: the "exact" recursion vs the FD oracle vs jvp-of-grad
+# ---------------------------------------------------------------------------
+
+def _exact_parity(spec, p, data):
+    T = data.shape[1]
+    x = _raw_point(spec, p)
+    rng = np.random.default_rng(0)
+    u = jnp.asarray(rng.standard_normal(x.shape[0]))
+
+    h_rec = np.asarray(NT.exact_hvp(spec, x, u, data, 0, T))
+    # the opposite differentiation order: forward over reverse
+    h_jg = np.asarray(jax.jvp(
+        jax.grad(lambda q: NT._nll(spec, q, data, 0, T)), (x,), (u,))[1])
+    scale = max(1.0, np.max(np.abs(h_rec)))
+    np.testing.assert_allclose(h_rec / scale, h_jg / scale, atol=1e-7)
+
+    # independent NumPy float64 FD Hessian of the same objective (the probe
+    # is jitted ONCE — hundreds of eager scan dispatches would otherwise
+    # accumulate XLA:CPU programs, the conftest segfault class)
+    probe = jax.jit(lambda q: NT._clamped_nll(spec, q, data, 0, T))
+    fun = lambda q: float(probe(jnp.asarray(q, dtype=jnp.float64)))
+    H_fd = fd_hessian(fun, np.asarray(x), eps=5e-5)
+    h_fd = H_fd @ np.asarray(u)
+    np.testing.assert_allclose(h_rec / scale, h_fd / scale, atol=5e-4)
+
+
+def test_exact_hvp_parity_1c(dns_setup):
+    _exact_parity(*dns_setup)
+
+
+def test_exact_hvp_parity_ns(ns_setup):
+    # the static NS family rides the family-generic "exact" recursion (the
+    # fisher engine resolves to it — resolve_mode below)
+    _exact_parity(*ns_setup)
+
+
+def test_fisher_matrix_matches_hvp_composition_and_is_psd(dns_setup):
+    spec, p, data = dns_setup
+    T = data.shape[1]
+    x = _raw_point(spec, p)
+    P = x.shape[0]
+    Hm = np.asarray(NT.fisher_matrix(spec, x, data, 0, T))
+    # the matrix assembled from the linearize sweep must act exactly like
+    # the jvp+vjp HVP composition (3 random directions keep this fast; the
+    # two paths share no code past the innovation function)
+    rng = np.random.default_rng(2)
+    for _ in range(3):
+        u = jnp.asarray(rng.standard_normal(P))
+        hu = np.asarray(NT.fisher_hvp(spec, x, u, data, 0, T))
+        scale = max(1.0, np.max(np.abs(hu)))
+        np.testing.assert_allclose((Hm @ np.asarray(u)) / scale, hu / scale,
+                                   atol=1e-9)
+    np.testing.assert_allclose(Hm, Hm.T, rtol=1e-12)
+    assert np.linalg.eigvalsh(Hm).min() > 0  # "fisher" is PSD by construction
+
+
+def test_resolve_mode_downgrades_fisher_for_non_kalman(ns_setup):
+    spec, _, _ = ns_setup
+    assert NT.resolve_mode(spec, "fisher") == "exact"
+    with pytest.raises(ValueError):
+        NT.resolve_mode(spec, "nonsense")
+
+
+# ---------------------------------------------------------------------------
+# the cascade: Newton-vs-LBFGS final losses, bit-for-bit off switch
+# ---------------------------------------------------------------------------
+
+def _starts(p, n, scale=0.1, seed=0):
+    rng = np.random.default_rng(seed)
+    return np.stack([p * (1 + scale * rng.standard_normal(p.shape))
+                     for _ in range(n)], axis=1)
+
+
+@pytest.mark.slow
+def test_newton_polish_matches_lbfgs_optimum_1c(dns_setup):
+    spec, p, data = dns_setup
+    starts = _starts(p, 2)
+    _, ll_base, best_base, _ = opt.estimate(
+        spec, data, starts, max_iters=800, second_order=False)
+    _, ll_so, best_so, conv = opt.estimate(
+        spec, data, starts, max_iters=800, second_order="fisher")
+    rep = opt.last_multistart_report()
+    # the polish must reach at least the first-order optimum (it is allowed
+    # to beat a stalled L-BFGS — measured on the seed configs it does)
+    assert ll_so >= ll_base - 1e-6
+    assert any(ph == "newton" for ph in rep["phase"])
+    assert rep["newton"] is not None and sum(rep["newton"]["iters"]) > 0
+    assert len(rep["iters"]) == 2 and len(rep["converged"]) == 2
+
+
+def test_second_order_false_is_bit_for_bit(dns_setup):
+    spec, p, data = dns_setup
+    starts = _starts(p, 2)
+    r1 = opt.estimate(spec, data, starts, max_iters=40, second_order=False)
+    r2 = opt.estimate(spec, data, starts, max_iters=40)  # env knob unset
+    np.testing.assert_array_equal(r1[2], r2[2])
+    assert r1[1] == r2[1]
+    assert "newton" not in opt.last_multistart_report()
+
+
+def test_yfm_newton_env_knob_arms_cascade(dns_setup, monkeypatch):
+    spec, p, data = dns_setup
+    starts = _starts(p, 2)
+    monkeypatch.setenv("YFM_NEWTON", "fisher")
+    opt.estimate(spec, data, starts, max_iters=100)
+    rep = opt.last_multistart_report()
+    assert rep["newton"] is not None
+    # explicit False overrides the knob — the historical path
+    opt.estimate(spec, data, starts, max_iters=100, second_order=False)
+    assert "newton" not in opt.last_multistart_report()
+    monkeypatch.setenv("YFM_NEWTON", "bogus")
+    with pytest.raises(ValueError):
+        opt.estimate(spec, data, starts, max_iters=10)
+
+
+def test_dead_start_stays_on_first_order_path(dns_setup):
+    """Sentinel discipline: a start whose loss is -Inf everywhere near it
+    is frozen by the polish at entry (done, not converged) and keeps the
+    first-order result — no NaN leaks into the report."""
+    spec, p, data = dns_setup
+    # heavy off-diagonal Φ (spectral radius > 1): the kron-solve P₀ is
+    # indefinite and the filter dies — the tests/test_robustness dead-start
+    # construction, which survives the raw-space sanitize round-trip
+    bad = p.copy()
+    a, b = spec.layout["phi"]
+    Phi = 0.9 * np.eye(3)
+    Phi[0, 1] = Phi[1, 0] = Phi[0, 2] = Phi[2, 0] = Phi[1, 2] = Phi[2, 1] = 0.8
+    bad[a:b] = Phi.reshape(-1)
+    starts = np.stack([p, bad], axis=1)
+    _, ll, _, _ = opt.estimate(spec, data, starts, max_iters=60,
+                               second_order="fisher")
+    rep = opt.last_multistart_report()
+    assert np.isfinite(ll)
+    # dead row stayed on the penalty plateau (−penalty, the historical
+    # first-order sentinel) — the polish froze it at entry
+    assert rep["lls"][1] <= -opt._PENALTY_THRESH
+    assert rep["newton"]["iters"][1] == 0          # polish never moved it
+    assert rep["phase"][1] == "lbfgs"
+
+
+def test_nonpsd_hessian_code_reaches_report(dns_setup):
+    """The exact engine far from the optimum sees an indefinite Hessian;
+    the damped fallback must both still descend and raise the
+    NONPSD_HESSIAN taxonomy bit into the report counters."""
+    spec, p, data = dns_setup
+    starts = _starts(p, 2, scale=0.6, seed=5)
+    opt.estimate(spec, data, starts, max_iters=90, second_order="exact")
+    rep = opt.last_multistart_report()
+    codes = rep["newton"]["code"]
+    assert any(c & tax.NONPSD_HESSIAN for c in codes)
+    assert tax.describe(tax.NONPSD_HESSIAN) == "NONPSD_HESSIAN"
+
+
+@pytest.mark.slow
+def test_estimate_steps_second_order_polish(ns_setup):
+    """estimate_steps gains a joint full-vector polish after the
+    block-coordinate cascade; accept-if-improved keeps it monotone."""
+    spec, p, data = ns_setup
+    groups = list(api.get_param_groups(spec))
+    start = p.copy()
+    start[0] += 0.2
+    start[1:4] += 0.05
+    r_off = opt.estimate_steps(spec, data, start[:, None], groups,
+                               max_group_iters=3, second_order=False)
+    r_on = opt.estimate_steps(spec, data, start[:, None], groups,
+                              max_group_iters=3, second_order="exact")
+    assert r_on[1] >= r_off[1] - 1e-9
+    rep = opt.last_multistart_report()
+    assert rep["phase"][rep["best"]] in ("newton", "lbfgs")
+
+
+@pytest.mark.slow
+def test_estimate_windows_second_order(dns_setup):
+    spec, p, data = dns_setup
+    T = int(data.shape[1])
+    raw = np.asarray(_raw_point(spec, p))[None]
+    ws = np.asarray([0, 0])
+    we = np.asarray([T - 10, T])
+    xs0, lls0 = opt.estimate_windows(spec, data, raw, ws, we, max_iters=60,
+                                     second_order=False)
+    xs1, lls1 = opt.estimate_windows(spec, data, raw, ws, we, max_iters=60,
+                                     second_order="fisher")
+    assert np.all(np.asarray(lls1) >= np.asarray(lls0) - 1e-6)
+
+
+@pytest.mark.slow
+def test_refit_column_second_order(dns_setup):
+    """The scenario lattice's refit column: per-resample re-estimation with
+    the cascade armed matches/beats the first-order refit per resample."""
+    spec, p, data = dns_setup
+    T = int(data.shape[1])
+    rng = np.random.default_rng(1)
+    idx = np.stack([rng.integers(0, T, size=T) for _ in range(2)])  # (R, T)
+    raw = np.asarray(_raw_point(spec, p))[None]
+    xs0, lls0 = refit_column(spec, data, idx, raw, max_iters=60,
+                             second_order=False)
+    xs1, lls1 = refit_column(spec, data, idx, raw, max_iters=60,
+                             second_order="fisher")
+    assert np.asarray(xs1).shape == (2, 1, spec.n_params)
+    assert np.all(np.asarray(lls1) >= np.asarray(lls0) - 1e-6)
+    with pytest.raises(ValueError):
+        refit_column(spec, data, idx[:, :-1], raw)

@@ -426,23 +426,34 @@ def yfm006_env_knob_docs(mod: SourceModule,
 # YFM007 — every registered engine has oracle-backed parity coverage
 # ---------------------------------------------------------------------------
 
+#: engine registries in config.py whose every entry must be oracle-backed —
+#: the Kalman loglik engines and the second-order (Newton HVP) engines share
+#: one parity contract
+_ENGINE_REGISTRIES = ("KALMAN_ENGINES", "NEWTON_ENGINES")
+
+
 def kalman_engines_static(config: LintConfig):
     """(engines tuple, lineno) parsed from config.py's AST — the linter must
-    not import the package (that would pull jax)."""
+    not import the package (that would pull jax).  Collects every registry
+    named in ``_ENGINE_REGISTRIES`` (a missing registry contributes
+    nothing, so older trees still lint)."""
     path = config.abspath(config.config_module)
     if not os.path.isfile(path):
         return (), 1
     with open(path, encoding="utf-8") as fh:
         tree = ast.parse(fh.read(), filename=path)
+    engines: list = []
+    lineno = 1
     for node in ast.walk(tree):
         if isinstance(node, ast.Assign) and any(
-                isinstance(t, ast.Name) and t.id == "KALMAN_ENGINES"
+                isinstance(t, ast.Name) and t.id in _ENGINE_REGISTRIES
                 for t in node.targets):
             if isinstance(node.value, (ast.Tuple, ast.List)):
-                vals = tuple(el.value for el in node.value.elts
-                             if isinstance(el, ast.Constant))
-                return vals, node.lineno
-    return (), 1
+                engines.extend(el.value for el in node.value.elts
+                               if isinstance(el, ast.Constant))
+                if lineno == 1:
+                    lineno = node.lineno
+    return tuple(engines), lineno
 
 
 def oracle_backed_test_strings(config: LintConfig):

@@ -23,6 +23,15 @@ _DEFAULT_DTYPE = jnp.float32
 KALMAN_ENGINES = ("univariate", "sqrt", "joint", "assoc")
 _KALMAN_ENGINE = "univariate"
 
+#: second-order (Newton-polish) HVP engines used by ``ops/newton.py`` /
+#: ``estimate(..., second_order=...)``:
+#:   "fisher"  Gauss–Newton/Fisher curvature via the innovation tangent
+#:             recursion (PSD, ≈3 filter passes/HVP — the cheap default)
+#:   "exact"   true HVP as grad-of-directional-derivative through the scan
+#: Every entry must have oracle-backed parity coverage — graftlint YFM007,
+#: the same contract as KALMAN_ENGINES.
+NEWTON_ENGINES = ("fisher", "exact")
+
 # lru-cached builders of jitted losses register here (at import time) so an
 # engine switch can invalidate every cache that traced api.get_loss — no
 # hand-maintained list of distant private names

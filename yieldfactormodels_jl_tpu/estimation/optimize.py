@@ -38,6 +38,7 @@ from ..models import api
 from ..models.params import transform_params, untransform_params, get_new_initial_params
 from ..models.specs import ModelSpec
 from ..config import register_engine_cache
+from ..ops import newton as _newton_const
 from ..orchestration import chaos as _chaos
 from ..robustness import ladder as _ladder
 from .batched_lbfgs import batched_lbfgs
@@ -52,12 +53,15 @@ from .neldermead import nelder_mead, nelder_mead_batched
 #: THREAD: the orchestrated supervisor runs one estimation per worker thread
 #: (orchestration/supervisor.py), and a process-global here would let worker
 #: B's report overwrite worker A's between A's estimate and A's
-#: SentinelFailure, mislabeling quarantine rows.  Contents: final loglik per
-#: start, ladder traces (codes + rungs) for every escalated start
-#: (robustness/ladder.py; empty unless YFM_ESCALATE armed and starts died),
-#: and the winning index.
+#: SentinelFailure, mislabeling quarantine rows.  Contents: final loglik,
+#: iteration count, convergence flag and phase per start ("lbfgs" /
+#: "newton" / "ladder:<rung>" — so the bench and quarantine diagnoses can
+#: attribute wall-clock to phases), ladder traces (codes + rungs) for every
+#: escalated start (robustness/ladder.py; empty unless YFM_ESCALATE armed
+#: and starts died), optional second-order counters, and the winning index.
 _REPORT_TLS = threading.local()
-_EMPTY_REPORT: Dict = {"lls": [], "ladder": [], "best": -1}
+_EMPTY_REPORT: Dict = {"lls": [], "iters": [], "converged": [], "phase": [],
+                       "ladder": [], "best": -1}
 
 
 def last_multistart_report() -> Dict:
@@ -65,12 +69,26 @@ def last_multistart_report() -> Dict:
     return getattr(_REPORT_TLS, "report", _EMPTY_REPORT)
 
 
-def _record_report(lls, ladder_traces, best: int) -> None:
-    _REPORT_TLS.report = {
-        "lls": [float(v) for v in np.asarray(lls).ravel()],
+def _record_report(lls, ladder_traces, best: int, iters=None, converged=None,
+                   phase=None, newton=None) -> None:
+    lls = np.asarray(lls).ravel()
+    S = lls.shape[0]
+    report = {
+        "lls": [float(v) for v in lls],
+        "iters": [int(v) for v in (np.zeros(S, np.int64) if iters is None
+                                   else np.asarray(iters).ravel())],
+        "converged": [bool(v) for v in (np.zeros(S, bool) if converged is None
+                                        else np.asarray(converged).ravel())],
+        "phase": list(phase) if phase is not None else ["lbfgs"] * S,
         "ladder": [t.as_dict() for t in ladder_traces],
         "best": int(best),
     }
+    if newton is not None:
+        # second-order counters: per-start Newton iterations and total CG
+        # (HVP) iterations — the eval-equivalent accounting BENCH_NEWTON uses
+        report["newton"] = {k: [int(x) for x in np.asarray(v).ravel()]
+                            for k, v in newton.items()}
+    _REPORT_TLS.report = report
 
 
 def _apply_ladder(spec, data, rows_raw, fallback_raw, lls, start, end):
@@ -125,7 +143,10 @@ def compute_loss(spec: ModelSpec, data, raw_params, start=0, end=None):
 #: objective values at/above this sit on the non-finite-loss penalty plateau.
 #: Strictly below the 1e12 penalty because float32 rounds 1e12 down to
 #: 999_999_995_904 — comparing against 1e12 exactly would never fire in f32.
-_PENALTY_THRESH = 0.999e12
+#: Canonical home is ops/newton.py (the polish's entry-validity check and
+#: this layer's plateau tests MUST agree, or a start one phase treats as
+#: dead would move in the other) — aliased here, one definition.
+_PENALTY_THRESH = _newton_const.PENALTY_THRESH
 
 
 def _fused_check_mode() -> str:
@@ -265,19 +286,26 @@ def _run_adam(fun, x0, max_iters: int, lr: float, g_tol: float = 1e-8):
     opt = optax.adam(lr)
 
     def step(carry):
-        x, state, it, gnorm = carry
+        x, state, it, gnorm, _ = carry
         f, grad = jax.value_and_grad(fun)(x)
         grad = jnp.where(jnp.isfinite(grad), grad, 0.0)
         updates, state = opt.update(grad, state, x)
         x = optax.apply_updates(x, updates)
-        return x, state, it + 1, jnp.max(jnp.abs(grad))
+        return x, state, it + 1, jnp.max(jnp.abs(grad)), f
 
     def cont(carry):
-        x, state, it, gnorm = carry
+        x, state, it, gnorm, _ = carry
         return (it < max_iters) & (gnorm > g_tol)
 
-    x, _, it, _ = jax.lax.while_loop(cont, step, (x0, opt.init(x0), 0, jnp.inf))
-    return x, fun(x), it, it < max_iters
+    # the last in-loop objective value rides the carry instead of a whole
+    # re-evaluation pass after the loop — it is the value at the final
+    # iteration's PRE-update point (one step stale, within the ΔLL tolerance
+    # any converged run satisfies), and downstream consumers re-evaluate the
+    # returned x anyway (estimate_steps' batch_loss convergence pass)
+    x, _, it, _, f_last = jax.lax.while_loop(
+        cont, step, (x0, opt.init(x0), 0, jnp.inf,
+                     jnp.asarray(jnp.inf, dtype=x0.dtype)))
+    return x, f_last, it, it < max_iters
 
 
 def _run_neldermead(fun, x0, max_iters: int, f_tol: float = 1e-8):
@@ -358,6 +386,128 @@ def try_initializations(spec: ModelSpec, best_params, data, max_tries: int = 0,
             cols.append(get_new_initial_params(spec, best_params, trial))
         return np.stack(cols, axis=1)
     return best_params[:, None]
+
+
+# ---------------------------------------------------------------------------
+# second-order polish: trust-region Newton-CG cascade (docs/DESIGN.md §17)
+# ---------------------------------------------------------------------------
+
+#: coarse-phase budget for the two-phase cascade: enough first-order
+#: iterations to reach the basin, not to grind out the tail — the Newton
+#: polish owns the tail at quadratic rate.  Only the ITERATION budget is
+#: capped and the GRADIENT tolerance loosened; the caller's f_abstol is
+#: kept as-is — loosening it makes the backtracking L-BFGS stall on the
+#: first plateau stretch far from the basin (measured: f_abstol 1e-5
+#: parked config-2-shaped starts at NLL +10.8k where the 1e-6 baseline
+#: reaches −2.1k), and no polish can recover a basin never reached.
+_NEWTON_COARSE_ITERS = 80
+_NEWTON_COARSE_G_TOL = 1e-4
+#: polish-phase budget: outer trust-region iterations and the per-iteration
+#: Steihaug CG (= HVP) cap
+_NEWTON_POLISH_ITERS = 40
+_NEWTON_MAX_CG = 20
+
+
+def _resolve_second_order(second_order) -> str:
+    """The cascade arm switch → an HVP engine name, or "" for off.
+
+    ``second_order=None`` (the default everywhere) defers to the
+    ``YFM_NEWTON`` env knob: unset/"0" off, "1" = the "fisher" default, or
+    an explicit engine name from ``config.NEWTON_ENGINES``.  ``True`` /
+    ``False`` / an engine name override the knob per call — ``False`` is
+    the bit-for-bit historical path (no second-order code runs at all)."""
+    from .. import config as _config
+
+    if second_order is None:
+        env = os.environ.get("YFM_NEWTON", "0")
+        if env in ("", "0"):
+            return ""
+        second_order = env
+    if second_order is False or second_order == "":
+        return ""
+    if second_order is True or second_order == "1":
+        return "fisher"
+    if second_order not in _config.NEWTON_ENGINES:
+        raise ValueError(f"unknown second_order engine {second_order!r}; "
+                         f"pick from {_config.NEWTON_ENGINES} (or "
+                         f"True/False)")
+    return second_order
+
+
+@register_engine_cache
+@lru_cache(maxsize=64)
+def _jitted_newton_polish(spec: ModelSpec, T: int, max_iters: int,
+                          g_tol: float, f_abstol: float, mode: str):
+    """The polish phase as one jitted program over the whole (S, P) start
+    matrix (ops/newton.polish — batched trust-region Newton-CG whose every
+    value/gradient/HVP evaluation covers all S starts)."""
+    from ..ops import newton as _newton
+
+    def run(X0, data, start, end):
+        return _newton.polish(spec, X0, data, start, end,
+                              max_iters=max_iters, g_tol=g_tol,
+                              f_abstol=f_abstol, mode=mode,
+                              max_cg=_NEWTON_MAX_CG)
+
+    return jax.jit(run)
+
+
+@register_engine_cache
+@lru_cache(maxsize=64)
+def _jitted_window_newton_polish(spec: ModelSpec, T: int, max_iters: int,
+                                 g_tol: float, f_abstol: float, mode: str):
+    """Rolling-window twin: the same polish vmapped over the window axis
+    (per-window start/end bounds, shared data panel)."""
+    from ..ops import newton as _newton
+
+    def run_one(X0, data, start, end):
+        return _newton.polish(spec, X0, data, start, end,
+                              max_iters=max_iters, g_tol=g_tol,
+                              f_abstol=f_abstol, mode=mode,
+                              max_cg=_NEWTON_MAX_CG)
+
+    return jax.jit(jax.vmap(run_one, in_axes=(0, None, 0, 0)))
+
+
+def _apply_newton_polish(spec: ModelSpec, mode: str, xs_np, fs, its, convs,
+                         data, start, end, g_tol, f_abstol):
+    """Run the Newton polish on the first-order phase's (S, P) output and
+    merge results (driver-side half of the cascade).
+
+    The polish is monotone per start (only descent steps are accepted), so
+    its x/f replace the coarse-phase values wherever it RAN; a start that
+    was dead at entry (non-finite / penalty-plateau value) is frozen by the
+    polish itself and keeps its first-order point — the sentinel contract,
+    and the escalation ladder downstream sees exactly what it saw before.
+    Returns (xs, fs, its, convs, took, newton_iters, newton_cg,
+    newton_code) — ``took`` is the polish's OWN took-mask ((iters > 0) or
+    converged-at-entry), the only honest basis for a "newton" phase label:
+    the merged ``convs`` still carries phase-1 kernel flags for rows the
+    polish froze, and labeling those "newton" would skip the fused
+    trust-but-verify guard for exactly the silently-faulty-kernel winners
+    it exists to catch.
+    """
+    T = data.shape[1]
+    runner = _jitted_newton_polish(spec, T, _NEWTON_POLISH_ITERS, g_tol,
+                                   f_abstol, mode)
+    res = runner(jnp.asarray(xs_np, dtype=spec.dtype), data,
+                 jnp.asarray(start), jnp.asarray(end))
+    n_x = np.asarray(res.x, dtype=np.float64)
+    n_f = np.asarray(res.f, dtype=np.float64)
+    n_it = np.asarray(res.iters)
+    n_conv = np.asarray(res.converged)
+    n_cg = np.asarray(res.cg_iters)
+    n_code = np.asarray(res.code)
+    fs = np.asarray(fs, dtype=np.float64)
+    # the polish evaluates through the scan engine; a fused/ssd phase-1
+    # value can differ by engine rounding, so take the polished row exactly
+    # when the polish moved it or certified convergence at entry
+    took = (n_it > 0) | n_conv
+    xs = np.where(took[:, None], n_x, np.asarray(xs_np, dtype=np.float64))
+    fs = np.where(took, n_f, fs)
+    its = np.asarray(its) + n_it
+    convs = np.where(took, n_conv, np.asarray(convs, dtype=bool))
+    return xs, fs, its, convs, took, n_it, n_cg, n_code
 
 
 # ---------------------------------------------------------------------------
@@ -466,7 +616,8 @@ def _jitted_multistart_lbfgs(spec: ModelSpec, T: int, max_iters: int,
 
 def estimate(spec: ModelSpec, data, all_params, start=0, end=None,
              max_iters: int = 1000, g_tol: float = 1e-6, f_abstol: float = 1e-6,
-             printing: bool = False, objective: str = "auto"):
+             printing: bool = False, objective: str = "auto",
+             second_order=None):
     """Multi-start LBFGS MLE.  ``all_params``: (P, S) constrained starts.
 
     All S starts run simultaneously — either as a vmapped per-start LBFGS
@@ -480,6 +631,17 @@ def estimate(spec: ModelSpec, data, all_params, start=0, end=None,
     Independently of the objective, the loss ENGINE inside the vmap path
     follows ``config.set_kalman_engine`` / the ``YFM_LOGLIK_T_SWITCH``
     dispatch policy through ``api.get_loss``.
+
+    ``second_order`` arms the two-phase cascade (docs/DESIGN.md §17):
+    COARSE first-order iterations to the basin (the phase-1 budget is capped
+    and its tolerances floored), then the batched trust-region Newton-CG
+    polish (``ops/newton.py``) to the caller's ``g_tol``/``f_abstol`` —
+    fewer, better iterations at ~3 filter passes per HVP.  ``True``/"fisher"
+    = Gauss–Newton/Fisher curvature, "exact" = exact HVPs, ``None`` defers
+    to the ``YFM_NEWTON`` knob, ``False`` = the historical first-order path
+    bit-for-bit.  Sentinels throughout: a start that is dead at polish
+    entry keeps its first-order point, and the escalation ladder
+    (``YFM_ESCALATE=1``) rescues it exactly as before.
 
     Returns (init_params, ll, best_params, Convergence(converged, iterations))
     like the reference's estimate! — the last element carries the *actual*
@@ -496,25 +658,42 @@ def estimate(spec: ModelSpec, data, all_params, start=0, end=None,
         [_sanitize(np.asarray(untransform_params(spec, c))) for c in all_params.T], axis=0
     )  # (S, P)
     kind = _resolve_objective(spec, objective)
+    so_mode = _resolve_second_order(second_order)
+    if so_mode:
+        # phase-1 budget: coarse iterations to the basin only
+        p1_iters = min(max_iters, _NEWTON_COARSE_ITERS)
+        p1_g_tol = max(g_tol, _NEWTON_COARSE_G_TOL)
+        p1_f_abstol = f_abstol
+    else:
+        p1_iters, p1_g_tol, p1_f_abstol = max_iters, g_tol, f_abstol
     if kind == "time_sharded":
         from ..parallel.time_parallel import multistart_time_sharded
 
         xs, lls_ts, its, convs = multistart_time_sharded(
-            spec, data, raw, start, end, max_iters=max_iters, g_tol=g_tol,
-            f_abstol=f_abstol)
+            spec, data, raw, start, end, max_iters=p1_iters, g_tol=p1_g_tol,
+            f_abstol=p1_f_abstol)
         fs = -lls_ts
     else:
         if kind == "fused":
-            runner = _jitted_fused_multistart(spec, T, max_iters, g_tol,
-                                              f_abstol)
+            runner = _jitted_fused_multistart(spec, T, p1_iters, p1_g_tol,
+                                              p1_f_abstol)
         else:
-            runner = _jitted_multistart_lbfgs(spec, T, max_iters, g_tol,
-                                              f_abstol)
+            runner = _jitted_multistart_lbfgs(spec, T, p1_iters, p1_g_tol,
+                                              p1_f_abstol)
         xs, fs, its, convs = runner(jnp.asarray(raw, dtype=spec.dtype), data,
                                     jnp.asarray(start), jnp.asarray(end))
     fs = np.asarray(fs, dtype=np.float64)
-    lls = -fs
     xs_np = np.asarray(xs, dtype=np.float64)
+    phase = ["lbfgs"] * fs.shape[0]
+    newton_counters = None
+    if so_mode:
+        xs_np, fs, its, convs, n_took, n_it, n_cg, n_code = \
+            _apply_newton_polish(spec, so_mode, xs_np, fs, its, convs, data,
+                                 start, end, g_tol, f_abstol)
+        phase = ["newton" if n_took[i] else "lbfgs"
+                 for i in range(fs.shape[0])]
+        newton_counters = {"iters": n_it, "cg_iters": n_cg, "code": n_code}
+    lls = -fs
     traces = []
     recovered = np.zeros(lls.shape[0], dtype=bool)
     if _ladder.escalation_enabled():
@@ -531,7 +710,7 @@ def estimate(spec: ModelSpec, data, all_params, start=0, end=None,
         lls = np.where(recovered, dead, lls)
         fs = np.where(recovered, -dead, fs)
     j = int(np.nanargmax(np.where(np.isfinite(lls), lls, -np.inf)))
-    if kind == "fused" and not recovered[j]:
+    if kind == "fused" and not recovered[j] and phase[j] != "newton":
         # trust-but-verify the kernel-reported optimum: ONE scan-engine eval
         # of the winner.  Motivated by the round-3 window-1 anomaly (device
         # config-2 optimum collapsed 16,100 → −30,278 with the restructured
@@ -539,7 +718,8 @@ def estimate(spec: ModelSpec, data, all_params, start=0, end=None,
         # compiler fault must not corrupt results unnoticed.  Fallback by
         # default until the on-chip grad gates pass (_fused_check_mode).
         # A ladder-recovered winner is skipped: its loglik already came from
-        # a scan-engine (or sqrt) re-evaluation, not the fused kernel.
+        # a scan-engine (or sqrt) re-evaluation, not the fused kernel — and
+        # so is a Newton-polished one (the polish objective IS the scan).
         ll_scan = float(_jitted_loss(spec, T)(
             transform_params(spec, jnp.asarray(xs_np[j], dtype=spec.dtype)),
             data, jnp.asarray(start), jnp.asarray(end)))
@@ -547,8 +727,13 @@ def estimate(spec: ModelSpec, data, all_params, start=0, end=None,
             _warn_fused_disagreement("estimate()", lls[j], ll_scan)
             if _fused_check_mode() == "fallback":
                 return estimate(spec, data, all_params, start, end, max_iters,
-                                g_tol, f_abstol, printing, objective="vmap")
-    _record_report(lls, traces, j)
+                                g_tol, f_abstol, printing, objective="vmap",
+                                second_order=second_order)
+    for t in traces:
+        if t.recovered:
+            phase[t.start] = f"ladder:{t.rung}"
+    _record_report(lls, traces, j, iters=its, converged=convs, phase=phase,
+                   newton=newton_counters)
     if printing:
         print(f"✓ Best LL = {lls[j]} from starting point {j + 1}/{len(lls)}")
     best = transform_params(spec, jnp.asarray(xs_np[j], dtype=spec.dtype))
@@ -786,7 +971,8 @@ def estimate_steps(spec: ModelSpec, data, all_params, param_groups: Sequence[str
                    max_group_iters: int = 10, tol: float = 1e-8,
                    optimizers: Optional[Dict[str, Tuple[str, dict]]] = None,
                    start=0, end=None, max_tries: int = 0, printing: bool = False,
-                   _force_scan: bool = False, checkpoint=None):
+                   _force_scan: bool = False, checkpoint=None,
+                   second_order=None):
     """Block-coordinate estimation over parameter groups.
 
     Faithful to the reference control flow: improved initializations for the
@@ -796,6 +982,14 @@ def estimate_steps(spec: ModelSpec, data, all_params, param_groups: Sequence[str
     on the very first group iteration raises (the reference rethrows first-
     iteration errors); on later iterations the group loop aborts quietly.
     Returns (init_params, ll, best_params, Convergence(converged, iterations)).
+
+    ``second_order`` (None = defer to ``YFM_NEWTON``, as in :func:`estimate`)
+    appends a full-vector trust-region Newton-CG polish after the cascade
+    converges — the block-coordinate loop finds the basin group-by-group,
+    the polish takes joint second-order steps across ALL groups at once
+    (docs/DESIGN.md §17; non-Kalman families ride the family-generic
+    "exact" HVP recursion).  A polished start is accepted only when its
+    re-evaluated loglik improves, so the cascade's monotonicity survives.
 
     ``checkpoint`` (an ``orchestration.checkpoint.WindowCheckpoint``):
     persists the full lockstep state after every group iteration and, on a
@@ -958,6 +1152,32 @@ def estimate_steps(spec: ModelSpec, data, all_params, param_groups: Sequence[str
         for j in range(S):
             print(f"✓ LL = {prev_ll[j]} from start {j + 1}")
 
+    # second-order polish (docs/DESIGN.md §17): joint Newton-CG steps over
+    # the FULL parameter vector from the cascade's converged points — the
+    # block-coordinate loop optimizes groups in isolation and stalls on
+    # cross-group curvature; the polish sees it.  Accept-if-improved keeps
+    # the cascade monotone; dead starts stay dead for the ladder below.
+    so_mode = _resolve_second_order(second_order)
+    newton_took = np.zeros(S, dtype=bool)
+    newton_counters = None
+    if so_mode:
+        runner = _jitted_newton_polish(spec, T, _NEWTON_POLISH_ITERS,
+                                       1e-6, tol, so_mode)
+        res = runner(jnp.asarray(X, dtype=spec.dtype), data, _start_j, _end_j)
+        lls_new = -np.asarray(res.f, dtype=np.float64)
+        n_it = np.asarray(res.iters)
+        newton_took = ((n_it > 0) | np.asarray(res.converged)) \
+            & np.isfinite(lls_new) \
+            & (~np.isfinite(prev_ll) | (lls_new >= prev_ll))
+        X = jnp.where(jnp.asarray(newton_took)[:, None],
+                      jnp.asarray(np.asarray(res.x, dtype=np.float64),
+                                  dtype=spec.dtype), X)
+        prev_ll = np.where(newton_took, lls_new, prev_ll)
+        converged = converged | (newton_took & np.asarray(res.converged))
+        newton_counters = {"iters": n_it,
+                           "cg_iters": np.asarray(res.cg_iters),
+                           "code": np.asarray(res.code)}
+
     # escalation ladder (YFM_ESCALATE, robustness/ladder.py): starts whose
     # cascade came back non-finite are retried through scan → sqrt → jitter
     # → ×0.95 instead of being dropped; recovered starts re-enter the
@@ -981,14 +1201,15 @@ def estimate_steps(spec: ModelSpec, data, all_params, param_groups: Sequence[str
     X_np = np.asarray(X, dtype=np.float64)
     best = np.asarray(transform_params(spec, jnp.asarray(X_np[best_j], dtype=spec.dtype)))
     init = np.asarray(transform_params(spec, jnp.asarray(raw[:, best_j], dtype=spec.dtype)))
-    if use_ssd:
+    if use_ssd and not newton_took[best_j]:
         # trust-but-verify the kernel-reported winner, same contract as
         # estimate(): the convergence LLs above came from the fused SSD
         # kernel, and a silently-faulty kernel (the round-3 device anomaly
         # class) would otherwise own both the selection and the reported
         # optimum.  One scan-engine eval of the winner flags it; fallback
         # re-runs the whole estimation on the scan engine (threaded as a
-        # call argument, not process-global env state).
+        # call argument, not process-global env state).  A Newton-polished
+        # winner is skipped: its loglik already came from the scan engine.
         ll_scan = float(_loss(jnp.asarray(best, dtype=spec.dtype), data,
                               _start_j, _end_j))
         ll_kern = float(prev_ll[best_j])
@@ -1001,8 +1222,14 @@ def estimate_steps(spec: ModelSpec, data, all_params, param_groups: Sequence[str
                 return estimate_steps(spec, data, all_params, param_groups,
                                       max_group_iters, tol, optimizers,
                                       start, end, max_tries, printing,
-                                      _force_scan=True, checkpoint=checkpoint)
-    _record_report(prev_ll, ladder_traces, best_j)
+                                      _force_scan=True, checkpoint=checkpoint,
+                                      second_order=second_order)
+    phase = ["newton" if newton_took[j] else "lbfgs" for j in range(S)]
+    for t in ladder_traces:
+        if t.recovered:
+            phase[t.start] = f"ladder:{t.rung}"
+    _record_report(prev_ll, ladder_traces, best_j, iters=iters_done,
+                   converged=converged, phase=phase, newton=newton_counters)
     if printing:
         print(f"✓ Best overall LL = {prev_ll[best_j]} from start {best_j + 1}")
     return init, float(prev_ll[best_j]), best, Convergence(
@@ -1044,7 +1271,7 @@ def _jitted_fused_windows(spec: ModelSpec, T: int, max_iters: int,
 
 def estimate_windows(spec: ModelSpec, data, raw_starts, window_starts, window_ends,
                      max_iters: int = 1000, g_tol: float = 1e-6, f_abstol: float = 1e-6,
-                     objective: str = "auto"):
+                     objective: str = "auto", second_order=None):
     """Re-estimate over W rolling windows × S starts in ONE jitted program.
 
     Masked windows are exactly equivalent to truncation (see models/kalman.py
@@ -1054,12 +1281,39 @@ def estimate_windows(spec: ModelSpec, data, raw_starts, window_starts, window_en
     families) the whole (W·S) batch runs one natively-batched L-BFGS whose
     every eval is a single per-lane-windowed Pallas kernel launch.
 
+    ``second_order`` arms the same two-phase cascade as :func:`estimate`
+    (None defers to ``YFM_NEWTON``): the first-order phase runs with the
+    coarse budget, then ONE window-vmapped trust-region Newton-CG program
+    polishes every (window, start) cell to the caller's tolerances.
+
     Returns (params (W, S, P) unconstrained, logliks (W, S)) — higher is
     better; pick per-window starts with argmax.
     """
     data = jnp.asarray(data, dtype=spec.dtype)
     T = data.shape[1]
     kind = _resolve_objective(spec, objective)
+    so_mode = _resolve_second_order(second_order)
+    if so_mode:
+        p1 = (min(max_iters, _NEWTON_COARSE_ITERS),
+              max(g_tol, _NEWTON_COARSE_G_TOL), f_abstol)
+    else:
+        p1 = (max_iters, g_tol, f_abstol)
+
+    def _window_polish(xs, lls, ws, we):
+        """(W, S, P) raw + (W, S) lls → polished, via one vmapped program."""
+        if not so_mode:
+            return xs, lls
+        runner = _jitted_window_newton_polish(
+            spec, T, _NEWTON_POLISH_ITERS, g_tol, f_abstol, so_mode)
+        res = runner(jnp.asarray(xs, dtype=spec.dtype), data,
+                     jnp.asarray(ws), jnp.asarray(we))
+        took = (np.asarray(res.iters) > 0) | np.asarray(res.converged)
+        xs = np.where(took[:, :, None], np.asarray(res.x, dtype=np.float64),
+                      np.asarray(xs, dtype=np.float64))
+        lls = np.where(took, -np.asarray(res.f, dtype=np.float64),
+                       np.asarray(lls, dtype=np.float64))
+        return xs, lls
+
     if kind == "fused":
         raw_starts = jnp.asarray(raw_starts, dtype=spec.dtype)
         S, Pn = raw_starts.shape
@@ -1069,9 +1323,15 @@ def estimate_windows(spec: ModelSpec, data, raw_starts, window_starts, window_en
         X0 = jnp.tile(raw_starts[None], (W, 1, 1)).reshape(W * S, Pn)
         starts_vec = jnp.repeat(ws, S)
         ends_vec = jnp.repeat(we, S)
-        runner = _jitted_fused_windows(spec, T, max_iters, g_tol, f_abstol)
+        runner = _jitted_fused_windows(spec, T, *p1)
         xs, fs, its, convs = runner(X0, data, starts_vec, ends_vec)
         lls = -fs.reshape(W, S)
+        if so_mode:
+            xs_p, lls_p = _window_polish(
+                np.asarray(xs, dtype=np.float64).reshape(W, S, Pn),
+                np.asarray(lls, dtype=np.float64), ws, we)
+            xs = jnp.asarray(xs_p, dtype=spec.dtype).reshape(W * S, Pn)
+            lls = jnp.asarray(lls_p, dtype=jnp.float64)
         # trust-but-verify (same rationale as estimate()): ONE scan eval of
         # the first window's best start flags a silently-faulty kernel
         j0 = int(np.nanargmax(np.where(np.isfinite(np.asarray(lls[0])),
@@ -1086,13 +1346,16 @@ def estimate_windows(spec: ModelSpec, data, raw_starts, window_starts, window_en
             if _fused_check_mode() == "fallback":
                 return estimate_windows(spec, data, raw_starts, window_starts,
                                         window_ends, max_iters, g_tol,
-                                        f_abstol, objective="vmap")
+                                        f_abstol, objective="vmap",
+                                        second_order=second_order)
         return xs.reshape(W, S, Pn), lls
-    runner = _jitted_window_multistart(spec, T, max_iters, g_tol, f_abstol)
+    runner = _jitted_window_multistart(spec, T, *p1)
     xs, fs, its, convs = runner(
         jnp.asarray(raw_starts, dtype=spec.dtype),
         data,
         jnp.asarray(window_starts),
         jnp.asarray(window_ends),
     )
+    if so_mode:
+        return _window_polish(xs, -fs, window_starts, window_ends)
     return xs, -fs

@@ -450,3 +450,89 @@ def stress_fan(spec: ModelSpec, params, beta, P,
     return fn(jnp.asarray(params, dtype=spec.dtype),
               jnp.asarray(beta, dtype=spec.dtype),
               jnp.asarray(P, dtype=spec.dtype), jnp.asarray(key))
+
+
+# ---------------------------------------------------------------------------
+# the refit column: per-resample re-estimation (bootstrap-refit workload)
+# ---------------------------------------------------------------------------
+
+@register_engine_cache
+@lru_cache(maxsize=16)
+def _jitted_refit_column(spec: ModelSpec, T: int, max_iters: int,
+                         g_tol: float, f_abstol: float):
+    """(R, S)-batched multi-start LBFGS over resampled panels — every
+    resample's whole start batch optimizes in ONE jitted program (the
+    refit analogue of the lattice's evaluation plane)."""
+    from .optimize import _finite_objective, _run_lbfgs
+
+    def single(x0, panel):
+        fun = lambda p: _finite_objective(spec, panel, p, 0, T)
+        return _run_lbfgs(fun, x0, max_iters, g_tol, f_abstol)
+
+    over_starts = jax.vmap(single, in_axes=(0, None))      # starts
+    over_resamples = jax.vmap(over_starts, in_axes=(None, 0))  # resamples
+    return jax.jit(over_resamples)
+
+
+@register_engine_cache
+@lru_cache(maxsize=16)
+def _jitted_refit_polish(spec: ModelSpec, T: int, max_iters: int,
+                         g_tol: float, f_abstol: float, mode: str):
+    """Resample-vmapped trust-region Newton-CG polish for the refit column
+    (the cascade's second phase, ops/newton.polish)."""
+    from ..ops import newton as _newton
+
+    def one(X0, panel):
+        return _newton.polish(spec, X0, panel, 0, T, max_iters=max_iters,
+                              g_tol=g_tol, f_abstol=f_abstol, mode=mode)
+
+    return jax.jit(jax.vmap(one, in_axes=(0, 0)))
+
+
+def refit_column(spec: ModelSpec, data, resample_idx, raw_starts, *,
+                 max_iters: int = 100, g_tol: float = 1e-6,
+                 f_abstol: float = 1e-6, second_order=None):
+    """Re-ESTIMATE the model on every bootstrap resample — the lattice's
+    refit column (parameter-uncertainty CIs, vs the fixed-parameter loss
+    plane ``evaluate_lattice`` evaluates).
+
+    ``resample_idx`` (R, T) integer index sets (``moving_block_indices`` or
+    a recycled ``resample_idx`` output of :func:`evaluate_lattice`);
+    ``raw_starts`` (S, P) unconstrained starts shared by every resample.
+    All R×S optimizations run as one jitted program; ``second_order``
+    (None = the ``YFM_NEWTON`` knob, as in ``optimize.estimate``) arms the
+    coarse-LBFGS → Newton-polish cascade per resample.
+
+    Returns ``(params (R, S, P) unconstrained, logliks (R, S))`` — pick
+    per-resample winners with argmax, same contract as
+    ``optimize.estimate_windows``.
+    """
+    from .optimize import (_NEWTON_COARSE_G_TOL, _NEWTON_COARSE_ITERS,
+                           _NEWTON_POLISH_ITERS, _resolve_second_order)
+
+    data = jnp.asarray(data, dtype=spec.dtype)
+    T = data.shape[1]
+    idx = jnp.asarray(resample_idx, dtype=jnp.int32)
+    if idx.ndim != 2 or idx.shape[1] != T:
+        raise ValueError(f"resample_idx must be (R, T); got {idx.shape} "
+                         f"for T={T}")
+    panels = jnp.swapaxes(data[:, idx], 0, 1)  # (R, N, T)
+    X0 = jnp.asarray(raw_starts, dtype=spec.dtype)
+    so_mode = _resolve_second_order(second_order)
+    if so_mode:
+        p1 = (min(max_iters, _NEWTON_COARSE_ITERS),
+              max(g_tol, _NEWTON_COARSE_G_TOL), f_abstol)
+    else:
+        p1 = (max_iters, g_tol, f_abstol)
+    runner = _jitted_refit_column(spec, T, *p1)
+    xs, fs, its, convs = runner(X0, panels)
+    if so_mode:
+        polish = _jitted_refit_polish(spec, T, _NEWTON_POLISH_ITERS,
+                                      g_tol, f_abstol, so_mode)
+        res = polish(xs, panels)
+        took = np.asarray((res.iters > 0) | res.converged)
+        xs = np.where(took[:, :, None], np.asarray(res.x, dtype=np.float64),
+                      np.asarray(xs, dtype=np.float64))
+        return xs, np.where(took, -np.asarray(res.f, dtype=np.float64),
+                            -np.asarray(fs, dtype=np.float64))
+    return xs, -fs

@@ -74,7 +74,8 @@ def _lock_ttl(stale_lock_ttl: float | None) -> float | None:
 
 def _estimate_for_window(spec: ModelSpec, data, task_id: int, all_params,
                          param_groups, max_group_iters, group_tol,
-                         checkpoint: WindowCheckpoint | None = None):
+                         checkpoint: WindowCheckpoint | None = None,
+                         second_order=None):
     """run_estimation! equivalent on the expanding sample data[:, :task_id].
 
     ``checkpoint``: per-window multi-start resume state (orchestration
@@ -86,9 +87,12 @@ def _estimate_for_window(spec: ModelSpec, data, task_id: int, all_params,
             spec, data, all_params, param_groups,
             max_group_iters=max_group_iters, tol=group_tol,
             start=0, end=task_id, checkpoint=checkpoint,
+            second_order=second_order,
         )
     else:
-        _, loss, params, _ = opt.estimate(spec, data, all_params, start=0, end=task_id)
+        _, loss, params, _ = opt.estimate(spec, data, all_params, start=0,
+                                          end=task_id,
+                                          second_order=second_order)
     return loss, params
 
 
@@ -98,7 +102,7 @@ def run_single_window_task(
     all_params, *, param_groups=(), max_group_iters: int = 10,
     group_tol: float = 1e-8, reestimate: bool = True,
     timer: StageTimer | None = None, checkpoint_root: str | None = None,
-    sentinel_policy: str = "save",
+    sentinel_policy: str = "save", second_order=None,
 ) -> str:
     """ONE origin's estimate → forecast → shard write; returns the shard path.
 
@@ -128,7 +132,7 @@ def run_single_window_task(
               else nullcontext()):
             loss, params = _estimate_for_window(
                 spec, data, task_id, cur, param_groups, max_group_iters,
-                group_tol, checkpoint=ckpt)
+                group_tol, checkpoint=ckpt, second_order=second_order)
         if sentinel_policy == "retry" and not np.isfinite(loss):
             from .orchestration.retry import SentinelFailure
             from .robustness import taxonomy
@@ -198,11 +202,13 @@ def run_rolling_forecasts(
     reestimate: bool = True,
     batched: bool = False,
     stale_lock_ttl: float | None = None,
+    second_order=None,
 ) -> None:
     window_fn = run_forecast_window_batched if batched else run_forecast_window_database
     kw = dict(
         param_groups=param_groups, max_group_iters=max_group_iters,
         group_tol=group_tol, reestimate=reestimate, stale_lock_ttl=stale_lock_ttl,
+        second_order=second_order,
     )
     if window_type == "both":
         window_fn(spec, data, thread_id, in_sample_end, in_sample_start,
@@ -314,6 +320,7 @@ def run_forecast_window_database(
     reestimate: bool = True, printing: bool = True,
     stale_lock_ttl: float | None = None,
     checkpoint_root: str | None = None,
+    second_order=None,
 ) -> None:
     data = np.asarray(data, dtype=np.float64)
     T = data.shape[1]
@@ -359,7 +366,7 @@ def run_forecast_window_database(
                 in_sample_start, forecast_horizon, all_params,
                 param_groups=param_groups, max_group_iters=max_group_iters,
                 group_tol=group_tol, reestimate=reestimate, timer=timer,
-                checkpoint_root=checkpoint_root)
+                checkpoint_root=checkpoint_root, second_order=second_order)
             if printing and timer.counts["estimation"]:
                 print(f"Thread {thread_id}: {timer.counts['estimation']} estimations, "
                       f"avg {timer.mean('estimation'):.2f}s/task")
@@ -382,6 +389,7 @@ def run_forecast_window_batched(
     param_groups=(), max_group_iters: int = 10, group_tol: float = 1e-8,
     reestimate: bool = True, printing: bool = True,
     stale_lock_ttl: float | None = None,
+    second_order=None,
 ) -> None:
     """All missing origins re-estimated in ONE (windows × starts) device batch,
     then written through the identical shard/merge/export pipeline.
@@ -425,7 +433,8 @@ def run_forecast_window_batched(
             raw0[~np.isfinite(raw0)] = 0.0
             w_ends = np.asarray(claimed)
             w_starts = np.zeros_like(w_ends)  # estimation quirk: expanding sample
-            xs, lls = opt.estimate_windows(spec, data, raw0, w_starts, w_ends)
+            xs, lls = opt.estimate_windows(spec, data, raw0, w_starts, w_ends,
+                                           second_order=second_order)
             xs = np.asarray(xs)    # (W, S, P)
             lls = np.asarray(lls)  # (W, S)
             best = np.nanargmax(np.where(np.isfinite(lls), lls, -np.inf), axis=1)
@@ -474,6 +483,7 @@ def run_forecast_no_window_database(
     forecast_horizon: int, window_type: str, init_params,
     param_groups=(), max_group_iters: int = 10, group_tol: float = 1e-8,
     reestimate: bool = True, stale_lock_ttl: float | None = None,
+    second_order=None,
 ) -> None:
     """Estimate once, forecast every origin, single legacy CSV
     (forecasting.jl:228-283)."""
@@ -484,7 +494,8 @@ def run_forecast_no_window_database(
         all_params = all_params[:, None]
     # single estimation on the in-sample span (forecasting.jl:233)
     loss, params = _estimate_for_window(
-        spec, data, in_sample_end, all_params, param_groups, max_group_iters, group_tol)
+        spec, data, in_sample_end, all_params, param_groups, max_group_iters,
+        group_tol, second_order=second_order)
 
     tasks = list(range(in_sample_end, T + 1))
     M, L, N = spec.M, spec.L, spec.N

@@ -147,6 +147,9 @@ def _step(spec: ModelSpec, kp: KalmanParams, Z_const, d_const, state: KalmanStat
         "P_pred": P,
         "beta_upd": beta_upd,
         "P_upd": P_upd,
+        # innovation covariance for the Fisher HVP recursion (ops/newton.py)
+        # — DCE'd from plain loglik consumers like the moment stacks above
+        "F": F,
         "code": code,
     }
     return KalmanState(beta_next, P_next), outs

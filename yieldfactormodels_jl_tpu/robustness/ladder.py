@@ -34,7 +34,12 @@ Everything is deterministic (no RNG anywhere — "jitter" is a fixed
 multiplicative inflation), so escalated runs replay bit-for-bit.  Arming is
 env-gated: ``YFM_ESCALATE=1`` enables the ladder in
 ``estimation/optimize.estimate``/``estimate_steps``; the default ``0``
-reproduces the historical drop-the-start behavior exactly.  Per-start
+reproduces the historical drop-the-start behavior exactly.  The second-order
+cascade (``second_order=``/``YFM_NEWTON``, docs/DESIGN.md §17) sits BEFORE
+this ladder: a start the Newton polish could not move (dead at entry, or
+every damped step rejected) keeps its −Inf/penalty sentinel and climbs these
+same rungs — the polish raises the ``NONPSD_HESSIAN`` taxonomy bit so the
+trace says the second-order phase saw broken curvature, not just "dead".  Per-start
 outcomes (codes + rungs climbed) land in the multi-start report
 (``optimize.last_multistart_report()``) and flow into the task boundary as
 ``orchestration.retry.SentinelFailure``'s decoded cause.

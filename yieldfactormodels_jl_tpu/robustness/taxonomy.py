@@ -54,6 +54,11 @@ MISSING_ALL_OBS = 16
 NONPSD_COV = 32
 #: a serving state carried non-finite entries (the NaN-poisoned-update class)
 NAN_STATE = 64
+#: the second-order polish saw a non-PSD/indefinite model Hessian — negative
+#: curvature in the CG subproblem or a non-finite HVP (a contributing F_t
+#: failed to factorize) — and fell back to the damped/steepest-descent path
+#: (ops/newton.py damping table, docs/DESIGN.md §17)
+NONPSD_HESSIAN = 128
 
 #: bit → name, in bit order (the decode vocabulary; keep sorted by value)
 NAMES = (
@@ -64,6 +69,7 @@ NAMES = (
     (MISSING_ALL_OBS, "MISSING_ALL_OBS"),
     (NONPSD_COV, "NONPSD_COV"),
     (NAN_STATE, "NAN_STATE"),
+    (NONPSD_HESSIAN, "NONPSD_HESSIAN"),
 )
 
 

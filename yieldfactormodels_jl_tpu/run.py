@@ -87,16 +87,18 @@ def load_static_parameters(spec, model_type: str, results_location: str,
 
 
 def run_estimation(spec, data, in_sample_end: int, all_params, param_groups,
-                   max_group_iters: int, group_tol: float, printing: bool = True):
+                   max_group_iters: int, group_tol: float, printing: bool = True,
+                   second_order=None):
     """YieldFactorModels.jl:162-186: grouped (block-coordinate) vs plain MLE."""
     if param_groups:
         assert np.asarray(all_params).shape[0] == len(param_groups)
         return opt.estimate_steps(
             spec, data, all_params, list(param_groups),
             max_group_iters=max_group_iters, tol=group_tol,
-            start=0, end=in_sample_end, printing=printing)
+            start=0, end=in_sample_end, printing=printing,
+            second_order=second_order)
     return opt.estimate(spec, data, all_params, start=0, end=in_sample_end,
-                        printing=printing)
+                        printing=printing, second_order=second_order)
 
 
 def run(
@@ -121,6 +123,7 @@ def run(
     batched_windows: bool = False,
     orchestrated: bool = False,
     n_workers: int = 2,
+    second_order=None,
 ):
     if simulation:  # :241-246
         window_type = "simulation"
@@ -156,7 +159,8 @@ def run(
         print("The param groups are:", param_groups)
         init_params, loss, params, ir = run_estimation(
             spec, data, in_sample_end, all_params, param_groups,
-            max_group_iters, group_tol, printing=True)
+            max_group_iters, group_tol, printing=True,
+            second_order=second_order)
     else:
         init_params = all_params[:, 0]
         params = all_params[:, 0]
@@ -200,6 +204,7 @@ def run(
                 forecast_horizon, all_params,
                 window_type=window_type, param_groups=param_groups,
                 max_group_iters=max_group_iters, group_tol=group_tol,
-                reestimate=reestimate, batched=batched_windows)
+                reestimate=reestimate, batched=batched_windows,
+                second_order=second_order)
 
     return spec, params
