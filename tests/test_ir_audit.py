@@ -1,0 +1,284 @@
+"""graftlint tier 2 (the IR program audit, docs/DESIGN.md §18).
+
+Two layers of coverage:
+
+- unit fixtures drive each artifact check (``ir._audit_case``) on tiny
+  synthetic programs — the dropped-donation regression the acceptance
+  criteria name, dtype down-casts, host callbacks, the lane heuristic, the
+  retrace census — both the fire and the quiet direction;
+- the CI gate runs the real ``--ir`` CLI in a CPU subprocess
+  (``JAX_PLATFORMS=cpu``, 8 virtual devices — the CLAUDE.md TPU access
+  rules) and requires ZERO unsuppressed findings across every registered
+  engine-cache builder, with the flagship donated entries' aliases verified
+  in the lowered artifacts.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+
+from yieldfactormodels_jl_tpu.analysis import ir as ir_mod
+from yieldfactormodels_jl_tpu.analysis.manifest import MANIFEST, Case
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _case(donated=0, max_programs=1, label="t"):
+    return Case("tests.synthetic", label, None, donated, max_programs)
+
+
+def _rules(problems):
+    return [rule for rule, _ in problems]
+
+
+def _audit(case, jitted, arg_sets):
+    problems, record = ir_mod._audit_case(case, jitted, arg_sets)
+    return problems, record
+
+
+F64 = lambda *shape: jax.ShapeDtypeStruct(shape, jnp.float64)  # noqa: E731
+
+
+# ---------------------------------------------------------------------------
+# YFM101 — donation honored vs silently dropped
+# ---------------------------------------------------------------------------
+
+def test_dropped_donation_fires():
+    """THE regression fixture: a donated argument whose value never reaches
+    a shape-matched output lowers with no input_output alias — source-level
+    YFM002 would pass a subtler variant of this, only the artifact check
+    catches the drop."""
+    fn = jax.jit(lambda a, b: b * 2.0, donate_argnums=(0,))
+    problems, record = _audit(_case(donated=1), fn, [(F64(4), F64(4))])
+    assert _rules(problems) == ["YFM101"]
+    assert record["aliases"] == 0
+    assert "dropped the donation" in problems[0][1]
+
+
+def test_honored_donation_quiet():
+    fn = jax.jit(lambda a, b: (a + b, a * 2.0), donate_argnums=(0,))
+    problems, record = _audit(_case(donated=1), fn, [(F64(4), F64(4))])
+    assert not problems
+    assert record["aliases"] == 1
+
+
+def test_shape_mismatched_donation_fires():
+    # the value flows to an output, but reshaped — no output aval matches
+    # the donated buffer, so XLA cannot alias it (this is the shape YFM002's
+    # reachability analysis wrongly passes: the value reaches a return)
+    import warnings
+
+    fn = jax.jit(lambda a, b: a.reshape(2, 2) + b.reshape(2, 2),
+                 donate_argnums=(0,))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # jax's own donation warning
+        problems, _ = _audit(_case(donated=1), fn, [(F64(4), F64(4))])
+    assert "YFM101" in _rules(problems)
+
+
+# ---------------------------------------------------------------------------
+# YFM102 — dtype discipline
+# ---------------------------------------------------------------------------
+
+def test_f32_downcast_inside_f64_program_fires():
+    fn = jax.jit(lambda a: a.astype(jnp.float32).astype(jnp.float64).sum())
+    problems, _ = _audit(_case(), fn, [(F64(4),)])
+    assert "YFM102" in _rules(problems)
+
+
+def test_pure_f64_program_quiet():
+    fn = jax.jit(lambda a: jnp.linalg.cholesky(a @ a.T
+                                               + jnp.eye(3)).sum())
+    problems, _ = _audit(_case(), fn, [(F64(3, 3),)])
+    assert not problems
+
+
+# ---------------------------------------------------------------------------
+# YFM103 — host round-trips
+# ---------------------------------------------------------------------------
+
+def test_host_callback_fires():
+    import numpy as np
+
+    def with_cb(x):
+        return jax.pure_callback(
+            lambda v: np.asarray(v), jax.ShapeDtypeStruct((4,), jnp.float64),
+            x)
+
+    problems, _ = _audit(_case(), jax.jit(with_cb), [(F64(4),)])
+    assert "YFM103" in _rules(problems)
+
+
+# ---------------------------------------------------------------------------
+# YFM104 — lane rule (unbatched dot_general heuristic)
+# ---------------------------------------------------------------------------
+
+def test_lane_rule_fires_on_big_leading_free_axis():
+    # (1024, 4) @ (4, 4): the big axis rides dim 0, the trailing lane dim
+    # is 4 — the transposed formulation the lane convention forbids
+    fn = jax.jit(lambda A, B: A @ B)
+    problems, _ = _audit(_case(), fn, [(F64(1024, 4), F64(4, 4))])
+    assert "YFM104" in _rules(problems)
+
+
+def test_lane_rule_quiet_on_batch_last_formulation():
+    fn = jax.jit(lambda A, B: A @ B)   # (4, 4) @ (4, 1024): batch last
+    problems, _ = _audit(_case(), fn, [(F64(4, 4), F64(4, 1024))])
+    assert not problems
+
+
+def test_lane_rule_skips_vmap_batched_dots():
+    # vmap hoists the batch axis into dot_general BATCH dims (and, for
+    # scatter, to the operand front) — XLA owns that layout, no finding
+    fn = jax.jit(jax.vmap(lambda a, b: a @ b, in_axes=(-1, -1),
+                          out_axes=-1))
+    problems, _ = _audit(_case(), fn, [(F64(4, 4, 1024), F64(4, 4, 1024))])
+    assert not problems
+
+
+# ---------------------------------------------------------------------------
+# YFM105 — retrace census
+# ---------------------------------------------------------------------------
+
+def test_retrace_census_fires_on_staging_mismatch():
+    fn = jax.jit(lambda a: a * 2)
+    problems, record = _audit(
+        _case(max_programs=1), fn,
+        [(F64(4),), (jax.ShapeDtypeStruct((4,), jnp.float32),)])
+    assert "YFM105" in _rules(problems)
+    assert record["programs"] == 2
+
+
+def test_retrace_census_quiet_on_identical_staging():
+    fn = jax.jit(lambda a: a * 2)
+    problems, record = _audit(_case(max_programs=1), fn,
+                              [(F64(4),), (F64(4),)])
+    assert not problems
+    assert record["programs"] == 1
+
+
+# ---------------------------------------------------------------------------
+# finding anchors: the builder's def line, where the documented pragma goes
+# ---------------------------------------------------------------------------
+
+def test_builder_site_anchors_at_def_line_and_pragma_applies(tmp_path):
+    """``inspect.getsourcelines`` starts at the first DECORATOR; the finding
+    must anchor at the ``def`` line — the line CLAUDE.md tells the
+    maintainer to pragma, the line ``suppression_for`` reads, and the line
+    the AST-side YFM011 rule uses (so the tiers' baseline keys agree)."""
+    import importlib.util
+    import textwrap
+
+    from yieldfactormodels_jl_tpu.analysis.engine import (Finding, LintConfig,
+                                                          SourceModule)
+
+    mod = tmp_path / "m.py"
+    mod.write_text(textwrap.dedent("""\
+        def deco(fn):
+            return fn
+
+        @deco
+        @deco
+        # yfmlint: disable=YFM104 -- fixture: deliberate layout
+        def builder():
+            return 1
+    """))
+    spec = importlib.util.spec_from_file_location("m_anchor_fixture", mod)
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    cfg = LintConfig(root=str(tmp_path))
+    rel, line = ir_mod._builder_site(cfg, m.builder)
+    assert rel == "m.py"
+    assert line == 7  # the def, not the decorator block's first line
+
+    src = SourceModule(str(mod), rel)
+    reason = src.suppression_for(Finding("YFM104", rel, line, 0, "x"))
+    assert reason == "fixture: deliberate layout"
+
+
+# ---------------------------------------------------------------------------
+# run_ir: flagship donations + runtime census
+# ---------------------------------------------------------------------------
+
+FLAGSHIPS = {
+    "estimation.scenario._jitted_lattice": 3,   # idx, sv_draws, acc
+    "serving.online._jitted_shard_update": 4,   # params, β, cov, ver
+    "parallel.mesh._sharded_multistart": 1,     # x0 → xs
+}
+
+
+def test_flagship_donated_entries_alias_in_lowered_artifact():
+    """Acceptance: the lattice, the shard update and the sharded multistart
+    must lower with every declared donation ALIASED (not just reachable)."""
+    res = ir_mod.run_ir(only=sorted(FLAGSHIPS))
+    assert not res.lint.findings, [f.message for f in res.lint.findings]
+    assert not res.lint.errors, res.lint.errors
+    by_builder = {}
+    for r in res.records:
+        by_builder.setdefault(r["builder"], []).append(r)
+    for builder, want in FLAGSHIPS.items():
+        recs = by_builder[builder]
+        assert recs, f"{builder} not audited"
+        for r in recs:
+            assert r["status"] == "ok", r
+            assert r["aliases"] >= want, r
+
+
+def test_runtime_census_fires_on_unmanifested_builder(monkeypatch):
+    key = "estimation.optimize._jitted_loss"
+    pruned = {k: v for k, v in MANIFEST.items() if k != key}
+    monkeypatch.setattr(ir_mod, "_import_package_modules",
+                        lambda config: [])
+    import yieldfactormodels_jl_tpu.estimation.optimize  # registers builders
+
+    monkeypatch.setattr("yieldfactormodels_jl_tpu.analysis.manifest.MANIFEST",
+                        pruned)
+    res = ir_mod.run_ir(only=[key])
+    assert [f.rule for f in res.lint.findings] == ["YFM011"]
+    assert key in res.lint.findings[0].message
+
+
+def test_runtime_census_fires_on_stale_manifest_key(monkeypatch):
+    key = "estimation.optimize._no_such_builder"
+    padded = dict(MANIFEST)
+    padded[key] = [Case(key, "skip", None, skip="stale")]
+    monkeypatch.setattr(ir_mod, "_import_package_modules",
+                        lambda config: [])
+    monkeypatch.setattr("yieldfactormodels_jl_tpu.analysis.manifest.MANIFEST",
+                        padded)
+    res = ir_mod.run_ir(only=[key])
+    assert [f.rule for f in res.lint.findings] == ["YFM011"]
+    assert "manifest" in res.lint.findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# the CI gate: full --ir run, zero unsuppressed findings
+# ---------------------------------------------------------------------------
+
+def test_ir_cli_full_audit_zero_findings():
+    """Every ``@register_engine_cache`` builder audits clean at the manifest
+    shapes (skips carry reasons; the AST-side YFM011 + the runtime census
+    guarantee nothing is silently uncovered)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "yieldfactormodels_jl_tpu.analysis", "--ir",
+         "--format", "json"],
+        cwd=ROOT, env=env, capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    data = json.loads(proc.stdout)
+    assert data["tier"] == "ir"
+    assert data["counts"]["findings"] == 0
+    assert not data["errors"]
+    # every non-skip record lowered clean, and coverage is the whole registry
+    records = data["records"]
+    assert len(records) >= 40
+    skipped = [r for r in records if r["status"] == "skip"]
+    assert all(r["reason"] for r in skipped)
+    assert all(r["status"] in ("ok", "skip") for r in records), [
+        r for r in records if r["status"] not in ("ok", "skip")]

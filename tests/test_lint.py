@@ -41,10 +41,10 @@ def test_repo_is_lint_clean():
 
 
 def test_lint_pass_is_not_vacuous():
-    """All nine rules registered and the walk actually covers the package,
-    the bench layer, and the kernel modules (a rotted glob would green-light
-    everything)."""
-    assert {f"YFM{i:03d}" for i in range(1, 10)} <= set(RULES)
+    """All eleven AST rules registered and the walk actually covers the
+    package, the bench layer, and the kernel modules (a rotted glob would
+    green-light everything)."""
+    assert {f"YFM{i:03d}" for i in range(1, 12)} <= set(RULES)
     cfg = LintConfig(root=ROOT)
     rels = set(cfg.lint_files())
     assert {"yieldfactormodels_jl_tpu/ops/univariate_kf.py",
@@ -271,3 +271,213 @@ def test_ruff_pyflakes_clean():
          "tests"],
         cwd=ROOT, capture_output=True, text=True, timeout=300)
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# --format sarif (both tiers share the emitter; exercised on the AST tier)
+# ---------------------------------------------------------------------------
+
+def test_cli_sarif_schema(tmp_path):
+    body = _BAD_SERVING + textwrap.dedent("""\
+
+        def pump2():
+            # yfmlint: disable=YFM008 -- fixture: deliberately suppressed
+            return queue.Queue()
+    """)
+    root = _scaffold(tmp_path, serving_body=body)
+    proc = _cli("--root", str(root), "--format", "sarif")
+    assert proc.returncode == 1  # findings still drive the exit code
+    sarif = json.loads(proc.stdout)
+    assert sarif["version"] == "2.1.0"
+    run = sarif["runs"][0]
+    assert run["tool"]["driver"]["name"] == "graftlint"
+    # schema validators reject a malformed informationUri (spaces/parens)
+    # wholesale — either omit it or keep it a bare valid URI
+    assert " " not in run["tool"]["driver"].get("informationUri", "")
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert "YFM008" in rule_ids
+    actionable = [r for r in run["results"] if "suppressions" not in r]
+    suppressed = [r for r in run["results"] if "suppressions" in r]
+    assert len(actionable) == 1 and actionable[0]["ruleId"] == "YFM008"
+    loc = actionable[0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("serving/gw.py")
+    assert loc["region"]["startLine"] >= 1
+    assert len(suppressed) == 1
+    assert suppressed[0]["suppressions"][0]["kind"] == "inSource"
+    assert "deliberately" in suppressed[0]["suppressions"][0]["justification"]
+
+
+def test_cli_list_rules_includes_ir_tier():
+    proc = _cli("--list-rules")
+    assert proc.returncode == 0
+    for rid in ("YFM010", "YFM011", "YFM101", "YFM105"):
+        assert rid in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# --changed-only: staged and untracked files (pre-commit on new modules)
+# ---------------------------------------------------------------------------
+
+def test_changed_only_sees_staged_and_untracked_files(tmp_path):
+    """A brand-new module must be linted by a pre-commit run whether it is
+    merely on disk (untracked) or already ``git add``-ed (staged) — the
+    committed-diff-only failure mode misses both."""
+    root = _scaffold(tmp_path)  # clean tree
+    git_env = dict(os.environ, GIT_AUTHOR_NAME="t", GIT_AUTHOR_EMAIL="t@t",
+                   GIT_COMMITTER_NAME="t", GIT_COMMITTER_EMAIL="t@t")
+
+    def git(*args):
+        proc = subprocess.run(["git", *args], cwd=root, env=git_env,
+                              capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, proc.stderr
+        return proc.stdout
+
+    git("init", "-q")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+
+    # untracked new module: in the --changed-only set before any git add
+    new = root / "yieldfactormodels_jl_tpu" / "serving" / "new_mod.py"
+    new.write_text(_BAD_SERVING)
+    proc = _cli("--changed-only", "--root", str(root), "--format", "json")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    data = json.loads(proc.stdout)
+    assert [f["rule"] for f in data["findings"]] == ["YFM008"]
+    assert data["findings"][0]["file"].endswith("new_mod.py")
+
+    # staged (git add, not committed): still in the set — and the worktree
+    # copy is what gets linted
+    git("add", "yieldfactormodels_jl_tpu/serving/new_mod.py")
+    proc = _cli("--changed-only", "--root", str(root), "--format", "json")
+    assert proc.returncode == 1
+    assert json.loads(proc.stdout)["findings"][0]["file"].endswith(
+        "new_mod.py")
+
+    # committed: drops out of the changed set again
+    git("commit", "-qm", "add module")
+    proc = _cli("--changed-only", "--root", str(root), "--format", "json")
+    assert proc.returncode == 0
+    assert json.loads(proc.stdout)["counts"]["findings"] == 0
+
+
+# ---------------------------------------------------------------------------
+# baseline hygiene: prune reporting + stale-entry warnings
+# ---------------------------------------------------------------------------
+
+def test_write_baseline_refused_under_partial_runs(tmp_path):
+    root = _scaffold(tmp_path, serving_body=_BAD_SERVING)
+    for extra in (("--changed-only",), ("--rules", "YFM008")):
+        proc = _cli("--root", str(root), "--write-baseline", *extra)
+        assert proc.returncode == 2, proc.stdout + proc.stderr
+        assert "partial" in proc.stderr or "FULL" in proc.stderr
+
+
+def test_ir_refused_with_foreign_root(tmp_path):
+    """The IR tier audits the IMPORTED package — builders register at
+    import time, so a different checkout's --root would silently audit the
+    wrong tree (anchors, pragmas and baseline keys all diverging)."""
+    proc = _cli("--ir", "--root", str(tmp_path))
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "IMPORTED package" in proc.stderr
+
+
+def test_write_baseline_prunes_fixed_entries_and_reports(tmp_path):
+    root = _scaffold(tmp_path, serving_body=_BAD_SERVING)
+    proc = _cli("--root", str(root), "--write-baseline")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    bl = str(root / ".yfmlint-baseline.json")
+    assert len(load_baseline(bl)) == 1
+
+    # fix the violation: the next --write-baseline must PRUNE the entry and
+    # say why, not silently shrink
+    (root / "yieldfactormodels_jl_tpu" / "serving" / "gw.py").write_text(
+        _CLEAN + "\n\n# fixed\n")
+    proc = _cli("--root", str(root), "--write-baseline")
+    assert proc.returncode == 0
+    assert "pruned" in proc.stdout
+    assert "no longer fires (fixed)" in proc.stdout
+    assert load_baseline(bl) == set()
+
+
+def test_write_baseline_is_idempotent_and_keeps_foreign_tier(tmp_path):
+    """Still-firing grandfathered entries survive a rewrite (they land in
+    ``baselined``, not ``findings`` — dropping them would empty the baseline
+    on the second consecutive write), and entries only the OTHER tier can
+    observe (IR YFM10x keys during an AST run) are preserved verbatim."""
+    root = _scaffold(tmp_path, serving_body=_BAD_SERVING)
+    proc = _cli("--root", str(root), "--write-baseline")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    bl = str(root / ".yfmlint-baseline.json")
+    entries = load_baseline(bl)
+    assert len(entries) == 1
+
+    # seed an IR-tier key: the AST rewrite cannot re-observe it and must
+    # carry it, pruning nothing
+    ir_key = "YFM101::yieldfactormodels_jl_tpu/serving/gw.py::1"
+    save_baseline(bl, [], extra_keys=entries | {ir_key})
+    proc = _cli("--root", str(root), "--write-baseline")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "graftlint: pruned" not in proc.stdout
+    assert load_baseline(bl) == entries | {ir_key}
+
+    # third write, unchanged tree: still a fixed point
+    proc = _cli("--root", str(root), "--write-baseline")
+    assert proc.returncode == 0
+    assert load_baseline(bl) == entries | {ir_key}
+
+    # a malformed key is NOT foreign — it matches no finding in any tier,
+    # and the plain-run stale warning promises the rewrite prunes it
+    bad_key = "YFM008:wrong:separator"
+    save_baseline(bl, [], extra_keys=entries | {ir_key, bad_key})
+    proc = _cli("--root", str(root), "--write-baseline")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "malformed" in proc.stdout
+    assert load_baseline(bl) == entries | {ir_key}
+
+    # staleness is tier-agnostic: a foreign (IR) key whose file is gone
+    # matches no finding in ANY tier — the rewrite prunes it as promised
+    stale_ir = "YFM101::yieldfactormodels_jl_tpu/serving/deleted.py::5"
+    save_baseline(bl, [], extra_keys=entries | {ir_key, stale_ir})
+    proc = _cli("--root", str(root), "--write-baseline")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "no longer exists" in proc.stdout
+    assert load_baseline(bl) == entries | {ir_key}
+
+
+def test_write_baseline_refused_while_run_has_errors(tmp_path):
+    """A module that fails to parse fires nothing — rewriting the baseline
+    then would drop its grandfathered entries as 'fixed'."""
+    root = _scaffold(tmp_path, serving_body=_BAD_SERVING)
+    proc = _cli("--root", str(root), "--write-baseline")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    bl = str(root / ".yfmlint-baseline.json")
+    before = load_baseline(bl)
+    (root / "yieldfactormodels_jl_tpu" / "serving" / "gw.py").write_text(
+        "def broken(:\n")
+    proc = _cli("--root", str(root), "--write-baseline")
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "refusing --write-baseline" in proc.stderr
+    assert load_baseline(bl) == before  # untouched
+
+
+def test_stale_baseline_entries_warn_on_plain_runs(tmp_path):
+    root = _scaffold(tmp_path, serving_body=_BAD_SERVING)
+    proc = _cli("--root", str(root), "--write-baseline")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    # delete the violating module: the baseline entry now points nowhere —
+    # a plain run must SAY so (and stay green: nothing fires), and a
+    # rewrite must prune it with the file-gone reason
+    (root / "yieldfactormodels_jl_tpu" / "serving" / "gw.py").unlink()
+    proc = _cli("--root", str(root), "--format", "json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    data = json.loads(proc.stdout)
+    assert data["counts"]["findings"] == 0
+    assert len(data["stale_baseline"]) == 1
+    assert "no longer exists" in next(iter(data["stale_baseline"].values()))
+    assert "stale baseline entry" in proc.stderr
+
+    proc = _cli("--root", str(root), "--write-baseline")
+    assert proc.returncode == 0
+    assert "pruned" in proc.stdout and "no longer exists" in proc.stdout
+    assert load_baseline(str(root / ".yfmlint-baseline.json")) == set()

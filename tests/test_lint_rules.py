@@ -655,3 +655,390 @@ def test_yfm001_fires_inside_cond_branch_and_fori_body(tmp_path):
             return lax.fori_loop(0, 3, loop_body, y)
     """, ["YFM001"])
     assert len(fired(res, "YFM001")) == 2
+
+
+# ---------------------------------------------------------------------------
+# YFM010 — lock discipline (serving/ + orchestration/ threaded classes)
+# ---------------------------------------------------------------------------
+
+def test_yfm010_fires_on_write_outside_lock(tmp_path):
+    res = lint(tmp_path, f"{PKG}/serving/st.py", """\
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._slot = {}
+
+            def register(self, k, v):
+                with self._lock:
+                    self._slot[k] = v
+
+            def evict(self, k):
+                self._slot.pop(k)
+    """, ["YFM010"])
+    hits = fired(res, "YFM010")
+    assert len(hits) == 1
+    assert "_slot" in hits[0].message
+    assert hits[0].line == 13  # the unlocked pop, not the locked write
+
+
+def test_yfm010_fires_on_inplace_mutator_outside_lock(tmp_path):
+    # deque-style mutation: append under the lock, popleft bare
+    res = lint(tmp_path, f"{PKG}/serving/gw.py", """\
+        import threading
+        from collections import deque
+
+        class Gateway:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._queue = deque()
+
+            def admit(self, req):
+                with self._lock:
+                    self._queue.append(req)
+
+            def drain(self):
+                return self._queue.popleft()
+    """, ["YFM010"])
+    assert fired(res, "YFM010")
+
+
+def test_yfm010_quiet_on_init_only_and_locked_writes(tmp_path):
+    res = lint(tmp_path, f"{PKG}/serving/st.py", """\
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._slot = {}
+                self._free = [1, 2, 3]   # construction: single-threaded
+
+            def register(self, k, v):
+                with self._lock:
+                    self._slot[k] = v
+                    self._free.pop()
+    """, ["YFM010"])
+    assert not res.findings
+
+
+def test_yfm010_quiet_on_lock_held_through_private_call_chain(tmp_path):
+    # the pump -> _pump_locked -> _dispatch convention: every call site of
+    # the private method holds a lock, so its writes are locked writes —
+    # closed to a fixed point down the chain
+    res = lint(tmp_path, f"{PKG}/serving/gw.py", """\
+        import threading
+
+        class Gateway:
+            def __init__(self):
+                self._pump_lock = threading.Lock()
+                self._cost = 0.0
+
+            def pump(self):
+                with self._pump_lock:
+                    return self._pump_locked()
+
+            def _pump_locked(self):
+                self._cost = 0.5 * self._cost
+                return self._dispatch()
+
+            def _dispatch(self):
+                self._cost = self._cost + 1.0
+                return 1
+    """, ["YFM010"])
+    assert not res.findings
+
+
+def test_yfm010_fires_when_one_call_site_is_unlocked(tmp_path):
+    # same chain, but a second caller reaches the private method with no
+    # lock held: the fixed point must NOT mark it locked
+    res = lint(tmp_path, f"{PKG}/serving/gw.py", """\
+        import threading
+
+        class Gateway:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cost = 0.0
+
+            def pump(self):
+                with self._lock:
+                    self._cost = 0.0
+                    self._bump()
+
+            def hot_path(self):
+                self._bump()
+
+            def _bump(self):
+                self._cost = self._cost + 1.0
+    """, ["YFM010"])
+    hits = fired(res, "YFM010")
+    assert len(hits) == 1
+    assert hits[0].line == 17  # _bump's write: one bare call site unlocks it
+
+
+def test_yfm010_quiet_on_ctor_only_helper_chain(tmp_path):
+    # __init__ -> self._reset(): calls FROM construction-time code are
+    # single-threaded by the same contract that exempts ctor bodies, so a
+    # private helper reachable only from ctors inherits the exemption —
+    # its writes are neither locked nor unlocked evidence
+    res = lint(tmp_path, f"{PKG}/serving/st.py", """\
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._reset()
+
+            def _reset(self):
+                self._state = {}
+
+            def update(self, k, v):
+                with self._lock:
+                    self._state[k] = v
+    """, ["YFM010"])
+    assert not res.findings
+
+
+def test_yfm010_fires_when_ctor_helper_is_also_called_at_runtime(tmp_path):
+    # same helper, but a runtime method reaches it with no lock held: the
+    # ctor call is still exempt, the runtime call is what convicts it
+    res = lint(tmp_path, f"{PKG}/serving/st.py", """\
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._reset()
+
+            def _reset(self):
+                self._state = {}
+
+            def clear(self):
+                self._reset()
+
+            def update(self, k, v):
+                with self._lock:
+                    self._state[k] = v
+    """, ["YFM010"])
+    hits = fired(res, "YFM010")
+    assert len(hits) == 1
+    assert hits[0].line == 9  # _reset's write, convicted by clear()
+
+
+def test_yfm010_fires_with_annotated_lock_creation(tmp_path):
+    # `self._lock: threading.Lock = threading.Lock()` must register the
+    # lock — an AnnAssign-shaped ctor would otherwise disable the rule for
+    # the whole class
+    res = lint(tmp_path, f"{PKG}/serving/st.py", """\
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock: threading.Lock = threading.Lock()
+                self._slot = {}
+
+            def register(self, k, v):
+                with self._lock:
+                    self._slot[k] = v
+
+            def evict(self, k):
+                self._slot.pop(k)
+    """, ["YFM010"])
+    hits = fired(res, "YFM010")
+    assert len(hits) == 1 and "_slot" in hits[0].message
+
+
+def test_yfm010_quiet_on_recursive_locked_chain(tmp_path):
+    # self- and mutually-recursive private methods whose every EXTERNAL
+    # entry point holds the lock: the greatest-fixed-point closure must
+    # converge to locked (a least fixed point never could — the recursive
+    # call site's owner is the method itself)
+    res = lint(tmp_path, f"{PKG}/serving/gw.py", """\
+        import threading
+
+        class Gateway:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cost = 0.0
+
+            def pump(self):
+                with self._lock:
+                    self._retry(3)
+
+            def _retry(self, n):
+                self._cost = self._cost + 1.0
+                if n:
+                    self._retry(n - 1)
+                else:
+                    self._backoff(n)
+
+            def _backoff(self, n):
+                self._cost = 0.5 * self._cost
+                self._retry(n)
+    """, ["YFM010"])
+    assert not res.findings
+
+
+def test_yfm010_quiet_on_bare_annotation(tmp_path):
+    # `self._pending: Dict[str, int]` (no value) declares for the type
+    # checker — it mutates nothing and must not count as an unlocked write
+    res = lint(tmp_path, f"{PKG}/serving/st.py", """\
+        import threading
+        from typing import Dict
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def setup(self):
+                self._pending: Dict[str, int]
+
+            def update(self, k, v):
+                with self._lock:
+                    self._pending = {k: v}
+    """, ["YFM010"])
+    assert not res.findings
+
+
+def test_yfm010_quiet_on_subobject_writes_and_other_dirs(tmp_path):
+    # writes into a sub-object (self.counters.shed) have ambiguous
+    # ownership — out of scope by design; and the rule only patrols the
+    # genuinely threaded serving/ + orchestration/ layers
+    res = lint(tmp_path, f"{PKG}/serving/svc.py", """\
+        import threading
+
+        class Service:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def locked(self):
+                with self._lock:
+                    self.counters.completed += 1
+
+            def bare(self):
+                self.counters.shed += 1
+    """, ["YFM010"])
+    assert not res.findings
+    res = lint(tmp_path, f"{PKG}/models/mod.py", """\
+        import threading
+
+        class NotPatrolled:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._x = 0
+
+            def locked(self):
+                with self._lock:
+                    self._x = 1
+
+            def bare(self):
+                self._x = 2
+    """, ["YFM010"])
+    assert not res.findings
+
+
+# ---------------------------------------------------------------------------
+# YFM011 — IR-audit manifest coverage
+# ---------------------------------------------------------------------------
+
+_MANIFEST_STUB = """\
+def case(builder, label="default", donated=0, max_programs=1):
+    def wrap(fn):
+        return fn
+    return wrap
+
+
+def skip_case(builder, reason):
+    pass
+"""
+
+
+def _builder_module():
+    return """\
+        from functools import lru_cache
+        from ..config import register_engine_cache
+
+        @register_engine_cache
+        @lru_cache(maxsize=8)
+        def _jitted_thing(spec, T):
+            return None
+    """
+
+
+def test_yfm011_fires_on_unmanifested_builder(tmp_path):
+    (tmp_path / PKG / "analysis").mkdir(parents=True)
+    (tmp_path / PKG / "analysis" / "manifest.py").write_text(_MANIFEST_STUB)
+    res = lint(tmp_path, f"{PKG}/estimation/opt.py", _builder_module(),
+               ["YFM011"])
+    hits = fired(res, "YFM011")
+    assert len(hits) == 1
+    assert "estimation.opt._jitted_thing" in hits[0].message
+    assert hits[0].file.endswith("estimation/opt.py")
+
+
+def test_yfm011_quiet_when_covered_and_fires_on_stale_key(tmp_path):
+    (tmp_path / PKG / "analysis").mkdir(parents=True)
+    (tmp_path / PKG / "analysis" / "manifest.py").write_text(
+        _MANIFEST_STUB + """
+
+@case("estimation.opt._jitted_thing", donated=1)
+def _m_thing():
+    return None, []
+
+skip_case("estimation.gone._jitted_stale", "builder was deleted")
+""")
+    res = lint(tmp_path, f"{PKG}/estimation/opt.py", _builder_module(),
+               ["YFM011"])
+    hits = fired(res, "YFM011")
+    assert len(hits) == 1           # the covered builder is quiet...
+    assert "_jitted_stale" in hits[0].message   # ...the stale key is not
+    assert hits[0].file.endswith("analysis/manifest.py")
+
+
+def test_yfm011_sees_aliased_decorator_import(tmp_path):
+    # `from ..config import register_engine_cache as _rec` must not hide a
+    # builder from the census — the runtime census in ir.py would still
+    # see it, and the tiers must observe the same builder set
+    (tmp_path / PKG / "analysis").mkdir(parents=True)
+    (tmp_path / PKG / "analysis" / "manifest.py").write_text(_MANIFEST_STUB)
+    res = lint(tmp_path, f"{PKG}/estimation/opt.py", """\
+        from functools import lru_cache
+        from ..config import register_engine_cache as _rec
+
+        @_rec
+        @lru_cache(maxsize=8)
+        def _jitted_thing(spec, T):
+            return None
+    """, ["YFM011"])
+    hits = fired(res, "YFM011")
+    assert len(hits) == 1
+    assert "estimation.opt._jitted_thing" in hits[0].message
+
+
+def test_yfm011_ignores_nested_builders(tmp_path):
+    # the runtime census keys builders by __qualname__ (mod.factory.
+    # <locals>.builder), which the AST tier cannot reproduce — a nested
+    # builder must not make the tiers demand contradictory manifest keys
+    # (tier 2's runtime census still covers it)
+    (tmp_path / PKG / "analysis").mkdir(parents=True)
+    (tmp_path / PKG / "analysis" / "manifest.py").write_text(_MANIFEST_STUB)
+    res = lint(tmp_path, f"{PKG}/estimation/opt.py", """\
+        from functools import lru_cache
+        from ..config import register_engine_cache
+
+        def factory():
+            @register_engine_cache
+            @lru_cache(maxsize=8)
+            def _jitted_inner(spec, T):
+                return None
+            return _jitted_inner
+    """, ["YFM011"])
+    assert not res.findings
+
+
+def test_yfm011_gated_off_without_manifest(tmp_path):
+    # pre-tier-2 trees (and most fixture repos here) have no manifest:
+    # the rule must stay quiet, not flag every builder
+    res = lint(tmp_path, f"{PKG}/estimation/opt.py", _builder_module(),
+               ["YFM011"])
+    assert not res.findings

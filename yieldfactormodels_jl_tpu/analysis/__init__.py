@@ -1,31 +1,39 @@
-"""graftlint: rule-based AST static analysis for the repo's jit/TPU
-invariants (docs/DESIGN.md §15).
+"""graftlint: rule-based static analysis for the repo's jit/TPU invariants.
 
-One parse per file, shared scope/decorator/call-name resolution, named rules
-YFM001–YFM009, inline ``# yfmlint: disable=YFM00x -- reason`` pragmas, and a
-committed baseline for deliberately-kept findings.  Import-light on purpose:
-nothing in this package imports jax (enforced by
+Tier 1 (default; docs/DESIGN.md §15): one AST parse per file, shared
+scope/decorator/call-name resolution, named rules YFM001–YFM011, inline
+``# yfmlint: disable=YFM00x -- reason`` pragmas, and a committed baseline
+for deliberately-kept findings.  Import-light on purpose: importing this
+package pulls NO jax (enforced by
 tests/test_lint.py::test_engine_imports_without_jax), so the CLI runs in
 about a second on a CPU-only box without touching backend init.
 
-CLI: ``python -m yieldfactormodels_jl_tpu.analysis --format json|text
-[--changed-only]``.
+Tier 2 (``--ir``; docs/DESIGN.md §18): the IR program audit — ``ir.py``
+lowers every ``@register_engine_cache`` builder at the shapes
+``manifest.py`` declares and checks the compiled artifacts (donation
+honored, dtype discipline, host round-trips, lane rule, retrace census).
+Only :func:`ir.run_ir` itself imports jax, and only when invoked.
+
+CLI: ``python -m yieldfactormodels_jl_tpu.analysis --format json|text|sarif
+[--changed-only | --ir]``.
 """
 
-from .baseline import load_baseline, save_baseline
+from .baseline import load_baseline, save_baseline, stale_entries
 from .engine import (Finding, JIT_ENTRY, JIT_WRAPPERS, LintConfig,
                      LintResult, RULES, SourceModule, TRACE_BODY,
                      TRACE_BODY_WRAPPERS, call_name, changed_files,
                      detect_jit_contexts, dotted_name, enclosing_functions,
                      func_depth, iter_py_files, names_reaching_return,
                      parent_map, raised_name, rule, run_lint)
-from . import rules as rules  # registers YFM001–YFM009 on import
+from .ir import IR_RULES, IRResult, run_ir  # jax-free until run_ir is called
+from . import rules as rules  # registers YFM001–YFM011 on import
 
 __all__ = [
-    "Finding", "JIT_ENTRY", "JIT_WRAPPERS", "LintConfig", "LintResult",
-    "RULES", "SourceModule", "TRACE_BODY", "TRACE_BODY_WRAPPERS",
-    "call_name", "changed_files", "detect_jit_contexts", "dotted_name",
-    "enclosing_functions", "func_depth", "iter_py_files", "load_baseline",
+    "Finding", "IR_RULES", "IRResult", "JIT_ENTRY", "JIT_WRAPPERS",
+    "LintConfig", "LintResult", "RULES", "SourceModule", "TRACE_BODY",
+    "TRACE_BODY_WRAPPERS", "call_name", "changed_files",
+    "detect_jit_contexts", "dotted_name", "enclosing_functions",
+    "func_depth", "iter_py_files", "load_baseline",
     "names_reaching_return", "parent_map", "raised_name", "rule", "rules",
-    "run_lint", "save_baseline",
+    "run_ir", "run_lint", "save_baseline", "stale_entries",
 ]

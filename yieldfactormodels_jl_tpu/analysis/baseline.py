@@ -4,16 +4,61 @@ The baseline is a JSON file of ``RULE::file::line`` keys.  A finding whose
 key appears here is reported under ``baselined`` (visible, never actionable)
 so the zero-unsuppressed-findings CI gate stays green while the debt stays
 on the books.  ``--write-baseline`` regenerates it from the current
-unsuppressed findings; an empty baseline is the healthy steady state.
+unsuppressed findings — pruning entries that no longer fire and REPORTING
+what it dropped (a silently shrinking baseline hides both progress and
+typos) — and a plain run warns on stale entries (file gone, line past EOF)
+instead of carrying them forever.  An empty baseline is the healthy steady
+state.
 """
 
 from __future__ import annotations
 
 import json
 import os
-from typing import Iterable, Set
+from typing import Dict, Iterable, Optional, Set, Tuple
 
 VERSION = 1
+
+
+def parse_key(key: str) -> Optional[Tuple[str, str, int]]:
+    """``"RULE::file::line"`` → ``(rule, file, line)``; ``None`` for a
+    malformed entry (itself a kind of staleness)."""
+    parts = key.split("::")
+    if len(parts) != 3:
+        return None
+    try:
+        return parts[0], parts[1], int(parts[2])
+    except ValueError:
+        return None
+
+
+def stale_entries(entries: Iterable[str], root: str) -> Dict[str, str]:
+    """``key → reason`` for baseline entries that can no longer match any
+    finding: malformed keys, files that no longer exist, line numbers past
+    the current end of file.  (An entry whose site exists but no longer
+    fires is only detectable by a lint run — ``--write-baseline`` prunes
+    those and reports them as fixed.)"""
+    stale: Dict[str, str] = {}
+    line_counts: Dict[str, Optional[int]] = {}
+    for key in entries:
+        parsed = parse_key(key)
+        if parsed is None:
+            stale[key] = "malformed entry (want RULE::file::line)"
+            continue
+        _, rel, line = parsed
+        if rel not in line_counts:
+            path = os.path.join(root, rel)
+            if not os.path.isfile(path):
+                line_counts[rel] = None
+            else:
+                with open(path, encoding="utf-8", errors="replace") as fh:
+                    line_counts[rel] = sum(1 for _ in fh)
+        n = line_counts[rel]
+        if n is None:
+            stale[key] = f"{rel} no longer exists"
+        elif line > n:
+            stale[key] = f"line {line} is past the end of {rel} ({n} lines)"
+    return stale
 
 
 def load_baseline(path: str) -> Set[str]:
@@ -30,10 +75,13 @@ def load_baseline(path: str) -> Set[str]:
     return set(data.get("entries", []))
 
 
-def save_baseline(path: str, findings: Iterable) -> int:
+def save_baseline(path: str, findings: Iterable,
+                  extra_keys: Iterable[str] = ()) -> int:
     """Atomically write the baseline from findings (tmp + os.replace — the
-    same publish discipline the linter enforces on everyone else)."""
-    entries = sorted({f.key() for f in findings})
+    same publish discipline the linter enforces on everyone else).
+    ``extra_keys`` are preserved verbatim: entries the calling run cannot
+    re-observe (the other tier's rules) must never be pruned by it."""
+    entries = sorted({f.key() for f in findings} | set(extra_keys))
     tmp = f"{path}.tmp-{os.getpid()}"
     with open(tmp, "w", encoding="utf-8") as fh:
         json.dump({"version": VERSION, "entries": entries}, fh, indent=1)

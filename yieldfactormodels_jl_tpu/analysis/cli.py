@@ -1,23 +1,39 @@
 """graftlint CLI: ``python -m yieldfactormodels_jl_tpu.analysis``.
 
+Two tiers behind one entry point:
+
+- default: the jax-free AST tier (rules YFM001–YFM011, ~1 s);
+- ``--ir``: the IR tier (``ir.py``, docs/DESIGN.md §18) — imports jax,
+  lowers every engine-cache builder at the manifest shapes and audits the
+  compiled artifacts (rules YFM100–YFM105 + the runtime YFM011 census).
+
 Exit codes: 0 = no unsuppressed/unbaselined findings, 1 = findings,
 2 = usage/parse errors.  ``--format json`` emits the machine schema
-(``version``/``counts``/``findings``/``suppressed``/``baselined``);
-``--changed-only`` restricts the file set to the git worktree diff
-(plus staged and untracked files) — the fast pre-commit mode.
+(``version``/``counts``/``findings``/``suppressed``/``baselined``, plus
+``tier``/``records`` under ``--ir`` and ``stale_baseline`` whenever the
+committed baseline carries dead entries); ``--format sarif`` emits SARIF
+2.1.0 for editor/CI annotation (suppressed and baselined findings carry
+``suppressions`` so only actionable results annotate).  ``--changed-only``
+restricts the AST tier's file set to the git worktree diff — worktree +
+staged + untracked, so pre-commit runs see brand-new modules — and is
+refused under ``--ir`` (programs have no file subset) and with
+``--write-baseline`` (a partial run must never silently un-grandfather the
+rest of the tree).
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 from . import baseline as _baseline
 from .engine import LintConfig, RULES, changed_files, run_lint
+from .ir import IR_RULES
 
 
-def _format_text(result, verbose: bool) -> str:
+def _format_text(result, verbose: bool, records=None) -> str:
     lines = []
     for f in result.findings:
         lines.append(f"{f.file}:{f.line}: {f.rule} {f.message}")
@@ -28,20 +44,100 @@ def _format_text(result, verbose: bool) -> str:
                          f"— {reason}")
         for f in result.baselined:
             lines.append(f"{f.file}:{f.line}: {f.rule} baselined")
+        for r in (records or []):
+            if r.get("status") == "skip":
+                lines.append(f"{r['file']}:{r['line']}: {r['builder']} "
+                             f"skipped — {r['reason']}")
+    skipped = sum(1 for r in (records or []) if r.get("status") == "skip")
+    tail = (f", {len(records)} case(s) ({skipped} skipped)"
+            if records is not None else
+            f", {result.files_scanned} files scanned")
     lines.append(
         f"graftlint: {len(result.findings)} finding(s), "
         f"{len(result.suppressed)} suppressed, "
-        f"{len(result.baselined)} baselined, "
-        f"{result.files_scanned} files scanned")
+        f"{len(result.baselined)} baselined" + tail)
     return "\n".join(lines)
+
+
+def _rule_meta():
+    """id → (name, summary) across both tiers (AST registry + IR table)."""
+    from . import rules as _rules  # noqa: F401  (registers RULES)
+
+    meta = {r.id: (r.name, r.summary) for r in RULES.values()}
+    for rid, (name, summary) in IR_RULES.items():
+        meta.setdefault(rid, (name, summary))
+    return meta
+
+
+def _format_sarif(result) -> str:
+    """SARIF 2.1.0: one run, both tiers' rule metadata, suppressed/baselined
+    results carrying ``suppressions`` (CI annotators skip those)."""
+    meta = _rule_meta()
+    used = sorted({f.rule for f in (result.findings + result.suppressed
+                                    + result.baselined)})
+    rules = [{
+        "id": rid,
+        "name": meta.get(rid, (rid, ""))[0] or rid,
+        "shortDescription": {"text": meta.get(rid, ("", rid))[1] or rid},
+    } for rid in used]
+
+    def one(f, suppressions):
+        d = {
+            "ruleId": f.rule,
+            "ruleIndex": used.index(f.rule),
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{"physicalLocation": {
+                "artifactLocation": {"uri": f.file},
+                "region": {"startLine": max(int(f.line), 1),
+                           "startColumn": int(f.col) + 1},
+            }}],
+        }
+        if suppressions is not None:
+            d["suppressions"] = suppressions
+        return d
+
+    results = [one(f, None) for f in result.findings]
+    results += [one(f, [{"kind": "inSource",
+                         "justification": f.suppress_reason or ""}])
+                for f in result.suppressed]
+    results += [one(f, [{"kind": "external",
+                         "justification":
+                         "grandfathered in .yfmlint-baseline.json"}])
+                for f in result.baselined]
+    sarif = {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftlint",
+                # informationUri must be a bare valid URI or schema
+                # validators (GitHub code-scanning upload) reject the file
+                "fullDescription": {
+                    "text": "rule tables in docs/DESIGN.md §15 (AST tier) "
+                            "and §18 (IR tier)"},
+                "rules": rules,
+            }},
+            "results": results,
+        }],
+    }
+    return json.dumps(sarif, indent=1)
 
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m yieldfactormodels_jl_tpu.analysis",
-        description="graftlint: rule-based AST static analysis for the "
-                    "repo's jit/TPU invariants (docs/DESIGN.md §15)")
-    parser.add_argument("--format", choices=("text", "json"), default="text")
+        description="graftlint: rule-based static analysis for the repo's "
+                    "jit/TPU invariants — AST tier (docs/DESIGN.md §15) "
+                    "plus the --ir program-audit tier (§18)")
+    parser.add_argument("--format", choices=("text", "json", "sarif"),
+                        default="text")
+    parser.add_argument("--ir", action="store_true",
+                        help="run the IR tier: lower every engine-cache "
+                             "builder at the manifest shapes and audit the "
+                             "compiled artifacts (imports jax; forces a "
+                             "CPU backend with 8 virtual devices unless "
+                             "JAX_PLATFORMS is already set)")
     parser.add_argument("--changed-only", action="store_true",
                         help="lint only files changed vs git HEAD "
                              "(worktree + staged + untracked)")
@@ -50,26 +146,49 @@ def main(argv=None) -> int:
                              "installed package location)")
     parser.add_argument("--rules", default=None,
                         help="comma-separated rule ids to run "
-                             "(default: all)")
+                             "(default: all; AST tier only)")
     parser.add_argument("--baseline", default=None,
                         help="baseline JSON path (default: "
                              "<root>/.yfmlint-baseline.json)")
     parser.add_argument("--write-baseline", action="store_true",
                         help="grandfather the current unsuppressed findings "
-                             "into the baseline and exit 0")
+                             "into the baseline (prunes + reports dropped "
+                             "entries) and exit 0")
     parser.add_argument("--list-rules", action="store_true",
-                        help="print the rule table and exit")
+                        help="print the rule table (both tiers) and exit")
     parser.add_argument("-v", "--verbose", action="store_true",
-                        help="also print suppressed/baselined findings")
+                        help="also print suppressed/baselined findings "
+                             "(and, under --ir, skipped manifest cases)")
     args = parser.parse_args(argv)
 
     config = LintConfig(root=args.root) if args.root else LintConfig()
 
     if args.list_rules:
-        from . import rules as _rules  # noqa: F401  (registers RULES)
-        for r in sorted(RULES.values(), key=lambda r: r.id):
-            print(f"{r.id}  {r.name}: {r.summary}")
+        for rid, (name, summary) in sorted(_rule_meta().items()):
+            print(f"{rid}  {name}: {summary}")
         return 0
+
+    if args.ir and args.root and os.path.realpath(args.root) \
+            != os.path.realpath(LintConfig().root):
+        print("--ir audits the IMPORTED package (builders register at "
+              "import time) — it cannot audit a different checkout via "
+              "--root; run it from that tree's environment instead",
+              file=sys.stderr)
+        return 2
+    if args.ir and args.changed_only:
+        print("--ir audits compiled programs — there is no changed-file "
+              "subset to restrict to; drop --changed-only", file=sys.stderr)
+        return 2
+    if args.ir and args.rules:
+        print("--rules selects AST rules; the IR tier runs its full check "
+              "set — drop --rules", file=sys.stderr)
+        return 2
+    if args.write_baseline and (args.changed_only or args.rules):
+        print("--write-baseline regenerates the baseline from a FULL run; "
+              "with --changed-only/--rules it would silently drop every "
+              "entry the partial run cannot see — run it unrestricted",
+              file=sys.stderr)
+        return 2
 
     rule_ids = None
     if args.rules:
@@ -97,17 +216,75 @@ def main(argv=None) -> int:
         print(f"bad baseline: {e}", file=sys.stderr)
         return 2
 
-    result = run_lint(config, files=files, rules=rule_ids, baseline=baseline)
+    records = None
+    if args.ir:
+        from .ir import run_ir
+
+        ir_result = run_ir(config, baseline=baseline)
+        result, records = ir_result.lint, ir_result.records
+        payload = ir_result.to_dict()
+    else:
+        result = run_lint(config, files=files, rules=rule_ids,
+                          baseline=baseline)
+        payload = result.to_dict()
 
     if args.write_baseline:
-        n = _baseline.save_baseline(baseline_path, result.findings)
+        if result.errors:
+            # an unparseable module fires nothing — writing now would
+            # silently un-grandfather everything it grandfathers
+            for e in result.errors:
+                print(f"graftlint: error: {e}", file=sys.stderr)
+            print("graftlint: refusing --write-baseline while the run has "
+                  "errors (entries in broken files would be dropped as "
+                  "'fixed')", file=sys.stderr)
+            return 2
+        # keep: still-firing findings (actionable AND already-grandfathered)
+        # plus every entry only the OTHER tier can observe — an AST run must
+        # never prune IR debt (YFM10x) and vice versa; YFM011 is producible
+        # by both tiers, so either run owns it
+        producible = (set(IR_RULES) | {"YFM011"}) if args.ir else set(RULES)
+
+        def _foreign(key):
+            # malformed keys are NOT foreign — they match no finding in any
+            # tier, and the plain-run stale warning promises a rewrite
+            # prunes them
+            parsed = _baseline.parse_key(key)
+            return parsed is not None and parsed[0] not in producible
+
+        foreign = {key for key in baseline if _foreign(key)}
+        # staleness (file gone, line past EOF) is tier-agnostic: a stale
+        # foreign key matches no finding in ANY tier, and the plain-run
+        # warning promises the rewrite prunes it
+        foreign -= set(_baseline.stale_entries(foreign, config.root))
+        n = _baseline.save_baseline(
+            baseline_path, result.findings + result.baselined,
+            extra_keys=foreign)
+        kept = ({f.key() for f in result.findings + result.baselined}
+                | foreign)
+        stale = _baseline.stale_entries(baseline - kept, config.root)
+        dropped = sorted(baseline - kept)
         print(f"graftlint: wrote {n} baseline entrie(s) to {baseline_path}")
+        for key in dropped:
+            why = stale.get(key, "no longer fires (fixed)")
+            print(f"graftlint: pruned {key} — {why}")
+        if not dropped and baseline:
+            print("graftlint: no entries pruned")
         return 0
 
+    # a plain run must not silently carry dead grandfathered debt
+    stale = _baseline.stale_entries(baseline, config.root)
+    if stale:
+        payload["stale_baseline"] = stale
+        for key, why in sorted(stale.items()):
+            print(f"graftlint: warning: stale baseline entry {key} — {why} "
+                  f"(--write-baseline prunes it)", file=sys.stderr)
+
     if args.format == "json":
-        print(json.dumps(result.to_dict(), indent=1))
+        print(json.dumps(payload, indent=1))
+    elif args.format == "sarif":
+        print(_format_sarif(result))
     else:
-        print(_format_text(result, args.verbose))
+        print(_format_text(result, args.verbose, records))
     if result.errors:
         return 2
     return 1 if result.findings else 0

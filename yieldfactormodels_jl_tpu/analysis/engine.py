@@ -426,6 +426,15 @@ class LintConfig:
         "yieldfactormodels_jl_tpu/orchestration",
         "yieldfactormodels_jl_tpu/persistence",
     )
+    #: directories whose classes run genuinely multi-threaded (gateway
+    #: worker, store slot tables, supervisor) — the YFM010 lock-discipline
+    #: scope
+    lock_dirs: Tuple[str, ...] = (
+        "yieldfactormodels_jl_tpu/serving",
+        "yieldfactormodels_jl_tpu/orchestration",
+    )
+    #: the IR-audit shape manifest YFM011 requires coverage in
+    manifest_module: str = "yieldfactormodels_jl_tpu/analysis/manifest.py"
     bench_files: Tuple[str, ...] = ("bench.py", "benchmarks/*.py")
     tests_dir: str = "tests"
     claude_md: str = "CLAUDE.md"

@@ -1,0 +1,422 @@
+"""graftlint tier 2: IR-level audit of the compiled engine-cache programs.
+
+The AST tier (``engine.py``/``rules.py``) checks what the *source* promises;
+this tier checks what the *artifact* delivers.  It enumerates every
+``@register_engine_cache`` builder (``config.engine_cache_entries()`` — the
+registrar introspection seam), constructs each cached jitted program at the
+representative shapes ``analysis/manifest.py`` declares, LOWERS it (nothing
+is compiled or executed), and audits the lowered StableHLO + jaxpr:
+
+- **YFM101 donation honored.**  Source-level YFM002 can prove a donated
+  value *reaches a return*; only the lowered module proves XLA actually
+  aliased the buffer (``tf.aliasing_output`` on the argument).  A declared
+  donation that lowers un-aliased is silently dropped — no reuse, no
+  warning on some paths — which is exactly the failure mode the lattice /
+  shard-update / multistart donation work guards against (DESIGN §14).
+- **YFM102 dtype discipline.**  ``stablehlo.convert`` from f64 down to
+  f32/f16/bf16 inside a float64 program means some intermediate silently
+  dropped precision the oracle-parity tests assume.
+- **YFM103 host round-trips.**  ``pure_callback``/``io_callback``/host
+  custom-calls inside the graph serialize the device pipeline per call.
+- **YFM104 lane rule.**  Heuristic over jaxpr avals: an UNBATCHED
+  ``dot_general``/``scatter`` whose big free axis (≥ :data:`LANE_BIG`) sits
+  off the trailing dimension while the trailing dimension is tiny wastes
+  TPU lanes (CLAUDE.md lane convention).  Batched dots (vmap-generated —
+  XLA owns their layout) are skipped.
+- **YFM105 retrace census.**  All of a case's staging variants must lower
+  to at most ``max_programs`` distinct artifacts — the PR-8 class of bug
+  where warm-up staged inputs differently from the hot path and silently
+  doubled the compile matrix.
+- **YFM011 runtime coverage census.**  Builders that registered at import
+  but have no manifest case (and stale manifest keys) — the runtime
+  cross-check of the AST-side YFM011 rule.
+
+Findings carry the builder's def site (file:line), so the ordinary pragma
+(``# yfmlint: disable=YFM10x -- reason`` above the builder) and the
+committed ``.yfmlint-baseline.json`` apply unchanged.  Manifest-level skips
+(``skip_case`` — e.g. Pallas programs that only lower for TPU) surface as
+suppressed findings with their reasons, never silently.
+
+This module imports NO jax at import time; everything heavy happens inside
+:func:`run_ir`, which the CLI reaches only under ``--ir``.  The default AST
+tier stays jax-free and ~1 s (tests/test_lint.py pins it).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import inspect
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .engine import Finding, LintConfig, LintResult, SourceModule
+
+#: tier-2 rule table (id → (name, summary)); the CLI merges this with the
+#: AST-side RULES for --list-rules and the SARIF rule metadata
+IR_RULES: Dict[str, Tuple[str, str]] = {
+    "YFM100": ("ir-audit-error",
+               "a manifest case failed to build or lower — manifest rot or "
+               "a broken builder"),
+    "YFM101": ("ir-donation-honored",
+               "every declared donated input must lower with an "
+               "input_output alias — an un-aliased donation is silently "
+               "dropped by XLA"),
+    "YFM102": ("ir-dtype-discipline",
+               "no f64→f32/f16/bf16 down-conversions inside float64 "
+               "programs"),
+    "YFM103": ("ir-host-roundtrip",
+               "no pure_callback/io_callback/host custom-calls inside "
+               "compiled programs"),
+    "YFM104": ("ir-lane-rule",
+               "big free axes of unbatched dot_general/scatter operands "
+               "must ride the trailing (lane) dimension"),
+    "YFM105": ("ir-retrace-census",
+               "a case's staging variants must collapse to its declared "
+               "program count — staging mismatches multiply compiles "
+               "silently"),
+}
+
+#: an axis is "big" for the lane heuristic at/above this (one TPU lane tile
+#: is 128; 512 keeps audit-sized batches of vmapped small-state filters out)
+LANE_BIG = 512
+#: ... and a trailing axis is "tiny" below this
+LANE_TINY = 8
+
+_ALIAS_ATTR = "tf.aliasing_output"
+_CONVERT_RE = re.compile(
+    r"stablehlo\.convert\s+%\S+\s*:\s*\(tensor<[^>]*xf64>\)\s*->\s*"
+    r"tensor<[^>]*x(f32|f16|bf16)>")
+_CUSTOM_CALL_RE = re.compile(r"stablehlo\.custom_call\s+@([\w.]+)")
+_CALLBACK_MARKERS = ("callback", "host")
+
+
+def _setup_audit_env() -> None:
+    """Point the not-yet-initialized jax at a CPU backend with 8 virtual
+    devices (the tests' conftest environment): the audit lowers mesh-sharded
+    programs, and an un-forced import would dial the TPU tunnel (CLAUDE.md
+    TPU access rules).  A no-op once jax is imported — an explicitly
+    configured environment (JAX_PLATFORMS=tpu for an on-device audit) wins."""
+    if "jax" in sys.modules:
+        return
+    # jax treats an EMPTY JAX_PLATFORMS as unset — setdefault would keep
+    # the empty string and the import would dial the TPU tunnel anyway
+    if not os.environ.get("JAX_PLATFORMS"):
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = \
+            (flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def _import_package_modules(config: LintConfig) -> List[str]:
+    """Import every package module so ``engine_cache_entries()`` is complete
+    (registration happens at import time).  Returns import failures as
+    ``"module: error"`` strings; the analysis subpackage itself is skipped
+    (it is jax-free by contract and registers nothing)."""
+    from .engine import iter_py_files
+
+    errors = []
+    pkg_root = config.abspath(config.package)
+    for path in iter_py_files(pkg_root):
+        rel = os.path.relpath(path, pkg_root).replace(os.sep, "/")
+        if rel.startswith("analysis/"):
+            continue
+        dotted = rel[:-3].replace("/", ".")
+        if dotted.endswith("__init__"):
+            dotted = dotted[: -len(".__init__")] or ""
+        name = config.package + ("." + dotted if dotted else "")
+        try:
+            importlib.import_module(name)
+        except Exception as e:  # noqa: BLE001 — report, keep auditing
+            errors.append(f"{name}: {e!r}")
+    return errors
+
+
+# ---------------------------------------------------------------------------
+# per-case checks
+# ---------------------------------------------------------------------------
+
+def _sub_jaxprs(v):
+    out = []
+    if hasattr(v, "jaxpr"):          # ClosedJaxpr
+        out.append(v.jaxpr)
+    elif hasattr(v, "eqns"):         # Jaxpr
+        out.append(v)
+    elif isinstance(v, (list, tuple)):
+        for el in v:
+            out.extend(_sub_jaxprs(el))
+    return out
+
+
+def _iter_eqns(jaxpr):
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for sub in _sub_jaxprs(v):
+                yield from _iter_eqns(sub)
+
+
+def _lane_violations(jaxpr) -> List[str]:
+    """Lane-rule heuristic (module docstring).  Returns human messages."""
+    out = []
+    for eqn in _iter_eqns(jaxpr):
+        prim = eqn.primitive.name
+        if prim == "dot_general":
+            (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+            if lb or rb:
+                continue  # vmap-generated batched dot: XLA owns the layout
+            for aval, contract, side in ((eqn.invars[0].aval, lc, "lhs"),
+                                         (eqn.invars[1].aval, rc, "rhs")):
+                shape = getattr(aval, "shape", ())
+                if len(shape) < 2:
+                    continue
+                free = [d for d in range(len(shape)) if d not in contract]
+                bad = [d for d in free
+                       if shape[d] >= LANE_BIG and d != len(shape) - 1]
+                if bad and shape[-1] < LANE_TINY:
+                    out.append(
+                        f"dot_general {side} operand {tuple(shape)} carries "
+                        f"a big free axis (dim {bad[0]}, size "
+                        f"{shape[bad[0]]}) off the trailing lane dimension "
+                        f"(trailing size {shape[-1]})")
+        # scatter is deliberately NOT checked here: vmap's batching rule
+        # hoists the batch axis to the FRONT of every interior scatter, so a
+        # correctly batch-last program (the store's slot scatters, the
+        # batcher buckets) and a violating one lower to identical interior
+        # shapes — measured on serving.batcher._jitted_forecast_bucket.
+    return out
+
+
+def _audit_case(case, jitted, arg_sets) -> Tuple[List[Tuple[str, str]], dict]:
+    """Lower every arg set of one case and run the artifact checks.
+    Returns ``([(rule_id, message), ...], record)``."""
+    problems: List[Tuple[str, str]] = []
+    texts = []
+    first_traced = None
+    for args in arg_sets:
+        # one trace serves both the lowered text and (for the first
+        # variant) the YFM104 jaxpr scan — tracing dominates the tier's
+        # wall, lowering the same trace twice would double it
+        traced = jitted.trace(*args)
+        if first_traced is None:
+            first_traced = traced
+        texts.append(traced.lower().as_text())
+
+    # YFM101 — donation honored in the artifact
+    aliases = min(t.count(_ALIAS_ATTR) for t in texts) if texts else 0
+    if case.donated and aliases < case.donated:
+        problems.append((
+            "YFM101",
+            f"case {case.label!r} declares {case.donated} donated "
+            f"buffer(s) but the lowered artifact aliases only {aliases} — "
+            f"XLA dropped the donation (no input_output alias); pass the "
+            f"donated value through to a shape-matched output "
+            f"(docs/DESIGN.md §14)"))
+
+    # YFM102 — dtype discipline inside f64 programs
+    for t in texts:
+        if "xf64" not in t:
+            continue
+        m = _CONVERT_RE.search(t)
+        if m:
+            problems.append((
+                "YFM102",
+                f"case {case.label!r}: float64 program lowers a "
+                f"down-conversion to {m.group(1)} "
+                f"({m.group(0).split(':')[0].strip()}) — some intermediate "
+                f"silently drops the precision the oracle parity assumes"))
+            break
+
+    # YFM103 — host round-trips
+    for t in texts:
+        hits = [tgt for tgt in _CUSTOM_CALL_RE.findall(t)
+                if any(mk in tgt.lower() for mk in _CALLBACK_MARKERS)]
+        if hits:
+            problems.append((
+                "YFM103",
+                f"case {case.label!r}: compiled program contains host "
+                f"callback custom-call(s) {sorted(set(hits))} — the device "
+                f"pipeline serializes on the host once per call"))
+            break
+
+    # YFM104 — lane rule over the jaxpr
+    lanes: List[str] = []
+    try:
+        lanes = _lane_violations(first_traced.jaxpr.jaxpr)
+    except Exception:  # noqa: BLE001 — heuristic check, never fatal
+        pass
+    if lanes:
+        problems.append((
+            "YFM104",
+            f"case {case.label!r}: {lanes[0]}" +
+            (f" (+{len(lanes) - 1} more site(s))" if len(lanes) > 1 else "")
+            + " — keep the big batch axis LAST (CLAUDE.md lane rule)"))
+
+    # YFM105 — retrace census across the case's staging variants
+    distinct = len(set(texts))
+    if distinct > case.max_programs:
+        problems.append((
+            "YFM105",
+            f"case {case.label!r}: {len(arg_sets)} staging variant(s) "
+            f"lower to {distinct} distinct program(s), expected at most "
+            f"{case.max_programs} — a staging mismatch multiplies the "
+            f"compile matrix silently (the PR-8 warmup bug class)"))
+
+    record = {"label": case.label, "variants": len(arg_sets),
+              "aliases": aliases, "programs": distinct,
+              "lane_sites": len(lanes)}
+    return problems, record
+
+
+# ---------------------------------------------------------------------------
+# the run
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class IRResult:
+    """Tier-2 result: the shared finding partition plus per-case records."""
+
+    lint: LintResult
+    #: one dict per audited (builder, case): status ok/skip/error + counters
+    records: List[dict] = dataclasses.field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        d = self.lint.to_dict()
+        d["tier"] = "ir"
+        d["records"] = list(self.records)
+        return d
+
+
+def _builder_site(config: LintConfig, fn) -> Tuple[str, int]:
+    """(repo-relative file, def line) of a registered builder — the anchor
+    every IR finding reports, so pragmas/baseline address source lines.
+
+    ``inspect.getsourcelines`` starts at the FIRST DECORATOR; the anchor
+    must be the ``def`` line itself — it is where CLAUDE.md tells the
+    maintainer to put the pragma, where ``suppression_for`` looks, and
+    where the AST-side YFM011 rule anchors (``ast.FunctionDef.lineno``),
+    so the two tiers' baseline keys agree."""
+    try:
+        raw = inspect.unwrap(fn)
+        path = inspect.getsourcefile(raw)
+        lines, line = inspect.getsourcelines(raw)
+        for off, text in enumerate(lines):
+            stripped = text.lstrip()
+            if stripped.startswith(("def ", "async def ")):
+                line += off
+                break
+        rel = os.path.relpath(path, config.root).replace(os.sep, "/")
+        return rel, int(line)
+    except (OSError, TypeError):
+        return config.config_module, 1
+
+
+def run_ir(config: Optional[LintConfig] = None,
+           only: Optional[Sequence[str]] = None,
+           baseline: Optional[set] = None) -> IRResult:
+    """Audit the engine-cache builders' lowered artifacts.
+
+    ``only`` restricts to a subset of builder keys (tests/partial audits;
+    the completeness census is skipped then).  Findings flow through the
+    same pragma + baseline partition as the AST tier."""
+    config = config or LintConfig()
+    baseline = baseline or set()
+    _setup_audit_env()
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+    result = LintResult()
+    out = IRResult(result)
+    result.errors.extend(_import_package_modules(config))
+
+    from .. import config as pkg_config
+    from . import manifest as mf
+
+    entries = dict(pkg_config.engine_cache_entries())
+    keys = sorted(set(mf.MANIFEST) | set(entries)) if only is None \
+        else [k for k in sorted(set(mf.MANIFEST) | set(entries))
+              if k in set(only)]
+
+    raw: List[Finding] = []
+
+    def add(rule, rel, line, msg):
+        raw.append(Finding(rule, rel, line, 0, msg))
+
+    # anchor stale-key findings at the case() registration line — the same
+    # line the AST-side YFM011 rule uses, so the tiers' baseline keys agree
+    from .rules import _manifest_keys
+
+    manifest_rel = config.manifest_module
+    manifest_lines = _manifest_keys(config) or {}
+    for key in keys:
+        cases = mf.MANIFEST.get(key)
+        fn = entries.get(key)
+        if fn is None:
+            # manifest names a builder that never registered: stale manifest
+            add("YFM011", manifest_rel, manifest_lines.get(key, 1),
+                f"manifest case {key!r} names no registered engine-cache "
+                f"builder — prune or fix the key (runtime census)")
+            continue
+        rel, line = _builder_site(config, fn)
+        if cases is None:
+            add("YFM011", rel, line,
+                f"builder {key} registered at import but has no "
+                f"manifest case — add one to analysis/manifest.py so "
+                f"tier-2 coverage grows with the code (runtime census)")
+            continue
+        for case in cases:
+            rec = {"builder": key, "file": rel, "line": line,
+                   "label": case.label}
+            if case.skip is not None:
+                rec["status"] = "skip"
+                rec["reason"] = case.skip
+                out.records.append(rec)
+                continue
+            try:
+                jitted, arg_sets = case.make()
+                problems, counters = _audit_case(case, jitted, arg_sets)
+            except Exception as e:  # noqa: BLE001 — audit must not die
+                add("YFM100", rel, line,
+                    f"case {case.label!r} failed to build/lower: {e!r}")
+                rec["status"] = "error"
+                rec["error"] = repr(e)
+                out.records.append(rec)
+                continue
+            rec["status"] = "ok" if not problems else "findings"
+            rec.update(counters)
+            out.records.append(rec)
+            for rule, msg in problems:
+                add(rule, rel, line, f"{key}: {msg}")
+
+    # partition: pragmas (on the builder's source lines) > baseline > action
+    mods: Dict[str, Optional[SourceModule]] = {}
+
+    def module_for(rel: str) -> Optional[SourceModule]:
+        if rel not in mods:
+            path = config.abspath(rel)
+            try:
+                mods[rel] = SourceModule(path, rel)
+            except (OSError, SyntaxError):
+                mods[rel] = None
+        return mods[rel]
+
+    for f in sorted(raw, key=lambda f: (f.file, f.line, f.rule)):
+        mod = module_for(f.file)
+        reason = mod.suppression_for(f) if mod is not None else None
+        if reason is not None:
+            f.suppressed, f.suppress_reason = True, reason
+            result.suppressed.append(f)
+        elif f.key() in baseline:
+            f.baselined = True
+            result.baselined.append(f)
+        else:
+            result.findings.append(f)
+    result.files_scanned = len([r for r in out.records
+                                if r.get("status") != "skip"])
+    return out

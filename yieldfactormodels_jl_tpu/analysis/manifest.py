@@ -1,0 +1,572 @@
+"""IR-audit shape manifest: representative shapes per engine-cache builder.
+
+Tier 2 of graftlint (``analysis/ir.py``, docs/DESIGN.md §18) audits the
+*compiled artifacts* of every ``@register_engine_cache`` builder — donation
+actually honored, dtype discipline, host round-trips, the lane rule, retrace
+census.  This module is the declarative half: one :func:`case` per builder
+saying HOW to build the jitted program and WHAT abstract shapes to lower it
+at.  Coverage is a closed loop, not a convention:
+
+- AST rule YFM011 (``rules.py``) statically requires a ``case``/``skip_case``
+  registration here for every builder in the package, so tier-2 coverage
+  grows with the code;
+- the runtime census in ``ir.py`` cross-checks this manifest against
+  ``config.engine_cache_entries()`` after importing the package, catching
+  stale keys and builders the AST pass could not see.
+
+A ``case``'s ``make()`` returns ``(jitted_program, [arg_tuple, ...])``; args
+may be ``jax.ShapeDtypeStruct`` avals or small concrete arrays (PRNG keys,
+host-staged buffers) — nothing is ever *executed*, only lowered.  Multiple
+arg tuples audit staging parity: all of them must collapse to
+``max_programs`` distinct lowerings (the PR-8 warmup-staging-mismatch bug
+class).  ``donated=`` declares how many input buffers must come out ALIASED
+in the lowered artifact — the check source-level YFM002 cannot make.
+``skip_case`` keeps a builder on the coverage books without lowering it
+(Pallas-fused programs lower only for the TPU backend; their on-chip checks
+live in ``benchmarks/hw_verify.py``).
+
+Deliberately jax-free at import (like the whole analysis package): every
+helper imports jax inside the call, so the AST tier and the CLI stay
+importable in ~100 ms.  Shapes are intentionally SMALL — lowering cost is
+roughly shape-independent, and nothing here compiles or runs — except where
+the lane-rule heuristic needs a visibly big batch axis (the batcher bucket,
+the sharded store, the fused grid plane).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional
+
+#: builder key ("estimation.optimize._jitted_loss") → registered cases
+MANIFEST: Dict[str, List["Case"]] = {}
+
+#: the one reason string for every Pallas-fused skip (uniform, greppable)
+PALLAS_SKIP = ("Pallas-fused program — lowers only for the TPU backend; "
+               "on-chip verification lives in benchmarks/hw_verify.py")
+
+
+@dataclasses.dataclass
+class Case:
+    """One auditable configuration of one builder."""
+
+    builder: str                     # package-relative dotted builder name
+    label: str                       # distinguishes cases of one builder
+    make: Optional[Callable]         # () -> (jitted, [args, ...]); None=skip
+    donated: int = 0                 # input buffers that MUST lower aliased
+    max_programs: int = 1            # distinct lowerings allowed across args
+    skip: Optional[str] = None       # reason: covered but not lowered
+
+
+def case(builder: str, label: str = "default", donated: int = 0,
+         max_programs: int = 1):
+    """Register a lowering case for ``builder`` (decorator)."""
+    def wrap(fn):
+        MANIFEST.setdefault(builder, []).append(
+            Case(builder, label, fn, donated, max_programs))
+        return fn
+    return wrap
+
+
+def skip_case(builder: str, reason: str) -> None:
+    """Register a coverage-only entry: the builder is on the books (YFM011
+    and the runtime census count it) but its program is not lowered here."""
+    MANIFEST.setdefault(builder, []).append(
+        Case(builder, "skip", None, skip=reason))
+
+
+# ---------------------------------------------------------------------------
+# shared shapes + helpers (jax imported lazily inside each)
+# ---------------------------------------------------------------------------
+
+MATS = (3.0, 6.0, 12.0, 36.0, 60.0, 120.0)
+N = len(MATS)      # maturities per curve
+T = 16             # panel length (kept divisible by the 2-device meshes)
+S = 4              # multi-start batch
+W = 2              # rolling windows
+R = 4              # bootstrap resamples (lattice faces)
+G = 3              # λ-grid points
+D = 2              # SV draws
+NP = 8             # particles (audit-sized)
+H = 3              # forecast horizon
+CAP = 128          # store shard capacity (slot axis — lane-rule visible)
+BUCKET = 8         # store update bucket
+
+
+def sds(shape, dtype="float64"):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.ShapeDtypeStruct(tuple(shape), jnp.dtype(dtype))
+
+
+def f64(*shape):
+    return sds(shape, "float64")
+
+
+def i32(*shape):
+    return sds(shape, "int32")
+
+
+def i64(*shape):
+    return sds(shape, "int64")
+
+
+def boolean(*shape):
+    return sds(shape, "bool")
+
+
+def key0():
+    import jax
+
+    return jax.random.PRNGKey(0)
+
+
+def keys(n: int):
+    import jax
+    import jax.numpy as jnp
+
+    return jnp.asarray(jax.random.split(jax.random.PRNGKey(0), n),
+                       dtype=jnp.uint32)
+
+
+def spec(family: str = "kalman_dns", **kw):
+    from ..models.specs import ModelSpec
+
+    return ModelSpec(family=family, model_code=f"ir-{family}",
+                     maturities=MATS, dtype_name="float64", **kw)
+
+
+def npar(family: str = "kalman_dns", **kw) -> int:
+    return spec(family, **kw).n_params
+
+
+def mesh2(axis: str = "batch"):
+    from ..parallel.mesh import make_mesh
+
+    return make_mesh(2, axis_name=axis)
+
+
+def shocks2():
+    from ..estimation.scenario import ShockSpec
+
+    return (ShockSpec("baseline"),
+            ShockSpec("parallel_up", (0.5, 0.0, 0.0)))
+
+
+#: L-BFGS/Newton audit budgets: tiny — tracing cost is iteration-independent
+#: (lax.while_loop), tolerances only feed carried constants
+ITERS, GT, FA = 2, 1e-4, 1e-6
+
+
+# ---------------------------------------------------------------------------
+# estimation.optimize
+# ---------------------------------------------------------------------------
+
+@case("estimation.optimize._jitted_loss")
+def _m_loss():
+    from ..estimation.optimize import _jitted_loss
+
+    P = npar()
+    return _jitted_loss(spec(), T), [(f64(P), f64(N, T), i64(), i64())]
+
+
+skip_case("estimation.optimize._jitted_ssd_batch_loss", PALLAS_SKIP)
+skip_case("estimation.optimize._jitted_fused_multistart", PALLAS_SKIP)
+skip_case("estimation.optimize._jitted_fused_windows", PALLAS_SKIP)
+skip_case("estimation.optimize._jitted_group_opt_ssd", PALLAS_SKIP)
+
+
+@case("estimation.optimize._jitted_batch_loss")
+def _m_batch_loss():
+    from ..estimation.optimize import _jitted_batch_loss
+
+    P = npar()
+    return _jitted_batch_loss(spec(), T), [(f64(S, P), f64(N, T),
+                                            i64(), i64())]
+
+
+@case("estimation.optimize._jitted_newton_polish")
+def _m_newton_polish():
+    from ..estimation.optimize import _jitted_newton_polish
+
+    P = npar()
+    fn = _jitted_newton_polish(spec(), T, ITERS, GT, FA, "fisher")
+    return fn, [(f64(S, P), f64(N, T), i64(), i64())]
+
+
+@case("estimation.optimize._jitted_window_newton_polish")
+def _m_window_newton_polish():
+    from ..estimation.optimize import _jitted_window_newton_polish
+
+    P = npar()
+    fn = _jitted_window_newton_polish(spec(), T, ITERS, GT, FA, "fisher")
+    return fn, [(f64(W, S, P), f64(N, T), i64(W), i64(W))]
+
+
+@case("estimation.optimize._jitted_multistart_lbfgs")
+def _m_multistart_lbfgs():
+    from ..estimation.optimize import _jitted_multistart_lbfgs
+
+    P = npar()
+    fn = _jitted_multistart_lbfgs(spec(), T, ITERS, GT, FA)
+    return fn, [(f64(S, P), f64(N, T), i64(), i64())]
+
+
+@case("estimation.optimize._jitted_group_opt_batched")
+def _m_group_opt_batched():
+    from ..estimation.optimize import _jitted_group_opt_batched
+
+    sp = spec("msed_lambda", duplicator=(0,))
+    opts = (("max_iters", ITERS), ("g_tol", GT), ("f_abstol", FA))
+    fn = _jitted_group_opt_batched(sp, T, (0, 1, 2), "lbfgs", opts)
+    return fn, [(f64(S, sp.n_params), f64(N, T), i64(), i64())]
+
+
+@case("estimation.optimize._jitted_group_opt_msed_closed")
+def _m_group_opt_msed_closed():
+    from ..estimation.optimize import _jitted_group_opt_msed_closed
+
+    sp = spec("msed_lambda", duplicator=(0,))
+    fn = _jitted_group_opt_msed_closed(sp, T)
+    return fn, [(f64(S, sp.n_params), f64(N, T), i64(), i64())]
+
+
+@case("estimation.optimize._jitted_window_multistart")
+def _m_window_multistart():
+    from ..estimation.optimize import _jitted_window_multistart
+
+    P = npar()
+    fn = _jitted_window_multistart(spec(), T, ITERS, GT, FA)
+    return fn, [(f64(S, P), f64(N, T), i64(W), i64(W))]
+
+
+# ---------------------------------------------------------------------------
+# estimation.sv / estimation.bootstrap / estimation.inference
+# ---------------------------------------------------------------------------
+
+skip_case("estimation.sv._jitted_sv_search_pallas", PALLAS_SKIP)
+
+
+@case("estimation.sv._jitted_draw_logliks")
+def _m_draw_logliks():
+    from ..estimation.sv import _jitted_draw_logliks
+
+    P = npar()
+    fn = _jitted_draw_logliks(spec(), T, NP, 0.95, 0.2)
+    return fn, [(f64(D, P), f64(N, T), key0())]
+
+
+@case("estimation.sv._jitted_sv_search")
+def _m_sv_search():
+    from ..estimation.sv import _jitted_sv_search
+
+    P = npar()
+    fn = _jitted_sv_search(spec(), T, NP, 0.95, 0.2, ITERS, 1e-6)
+    return fn, [(f64(2, P), f64(N, T), key0())]
+
+
+@case("estimation.sv._jitted_sv_search_full")
+def _m_sv_search_full():
+    from ..estimation.sv import _jitted_sv_search_full
+
+    P = npar()
+    fn = _jitted_sv_search_full(spec(), T, NP, ITERS, 1e-6)
+    return fn, [(f64(2, P + 2), f64(N, T), key0())]
+
+
+@case("estimation.bootstrap._jitted_grid_loss")
+def _m_grid_loss():
+    from ..estimation.bootstrap import _jitted_grid_loss
+
+    sp = spec("static_lambda")
+    fn = _jitted_grid_loss(sp, T)
+    return fn, [(f64(G), i32(R, T), f64(sp.n_params), f64(N, T))]
+
+
+@case("estimation.bootstrap._jitted_grid_loss_fused")
+def _m_grid_loss_fused():
+    from ..estimation.bootstrap import _jitted_grid_loss_fused
+
+    sp = spec("static_lambda")
+    fn = _jitted_grid_loss_fused(sp, T)
+    # R is the lane axis of the fused MXU formulation: audit it big enough
+    # (≥ the lane-rule threshold) that a transposed re-formulation would trip
+    # YFM104, not slip under the size gate
+    Rbig = 600
+    return fn, [(f64(G), i32(Rbig, T), f64(sp.n_params), f64(N, T))]
+
+
+@case("estimation.inference._jitted_information")
+def _m_information():
+    from ..estimation.inference import _jitted_information
+
+    P = npar()
+    return _jitted_information(spec(), T), [(f64(P), f64(N, T),
+                                             i64(), i64())]
+
+
+@case("estimation.inference._jitted_score_contributions")
+def _m_score_contributions():
+    from ..estimation.inference import _jitted_score_contributions
+
+    P = npar()
+    fn = _jitted_score_contributions(spec(), T, "univariate")
+    return fn, [(f64(P), f64(N, T), i64(), i64())]
+
+
+# ---------------------------------------------------------------------------
+# estimation.scenario — the flagship donated lattice
+# ---------------------------------------------------------------------------
+
+@case("estimation.scenario._jitted_lattice", label="donated-full", donated=3)
+def _m_lattice():
+    from ..estimation.scenario import _jitted_lattice
+
+    st, ka = spec("static_lambda"), spec()
+    fn = _jitted_lattice(st, ka, T, R, G, D, shocks2(), H, 2, NP, 0.95, 0.2,
+                         4, "fused", False, "univariate", True, True)
+    # run(key, idx, gammas, static_params, kalman_params, data, sv_draws,
+    #     acc); donated: idx → resample_idx, sv_draws → sv_draws, acc → losses
+    return fn, [(key0(), i32(R, T), f64(G), f64(st.n_params),
+                 f64(ka.n_params), f64(N, T), f64(D, ka.n_params),
+                 f64(R, G))]
+
+
+@case("estimation.scenario._jitted_fan")
+def _m_fan():
+    from ..estimation.scenario import _jitted_fan
+
+    sp = spec()
+    fn = _jitted_fan(sp, shocks2(), H, 2)
+    Ms = sp.state_dim
+    return fn, [(f64(sp.n_params), f64(Ms), f64(Ms, Ms), key0())]
+
+
+@case("estimation.scenario._jitted_refit_column")
+def _m_refit_column():
+    from ..estimation.scenario import _jitted_refit_column
+
+    P = npar()
+    fn = _jitted_refit_column(spec(), T, ITERS, GT, FA)
+    return fn, [(f64(2, P), f64(R, N, T))]
+
+
+@case("estimation.scenario._jitted_refit_polish")
+def _m_refit_polish():
+    from ..estimation.scenario import _jitted_refit_polish
+
+    P = npar()
+    fn = _jitted_refit_polish(spec(), T, ITERS, GT, FA, "fisher")
+    return fn, [(f64(R, 2, P), f64(R, N, T))]
+
+
+# ---------------------------------------------------------------------------
+# forecasting / serving
+# ---------------------------------------------------------------------------
+
+@case("forecasting._jitted_predict_windows")
+def _m_predict_windows():
+    from ..forecasting import _jitted_predict_windows
+
+    P = npar()
+    T_ext = T + H - 1
+    fn = _jitted_predict_windows(spec(), T_ext)
+    return fn, [(f64(W, P), i64(W), i64(W), f64(N, T_ext))]
+
+
+@case("serving.batcher._jitted_forecast_bucket")
+def _m_forecast_bucket():
+    from ..serving.batcher import _jitted_forecast_bucket
+
+    sp = spec()
+    B = 1024  # the lane-rule flagship: batch axis LAST at visible size
+    fn = _jitted_forecast_bucket(sp, H, B)
+    Ms = sp.state_dim
+    return fn, [(f64(sp.n_params, B), f64(Ms, B), f64(Ms, Ms, B))]
+
+
+@case("serving.online._jitted_update", label="donated", donated=2)
+def _m_update_donated():
+    from ..serving.online import _jitted_update
+
+    sp = spec()
+    Ms = sp.state_dim
+    fn = _jitted_update(sp, "univariate", True)
+    return fn, [(f64(sp.n_params), f64(Ms), f64(Ms, Ms), f64(N))]
+
+
+@case("serving.online._jitted_update", label="sqrt-donated", donated=2)
+def _m_update_sqrt():
+    from ..serving.online import _jitted_update
+
+    sp = spec()
+    Ms = sp.state_dim
+    fn = _jitted_update(sp, "sqrt", True)
+    return fn, [(f64(sp.n_params), f64(Ms), f64(Ms, Ms), f64(N))]
+
+
+@case("serving.online._jitted_update_k", label="donated", donated=2)
+def _m_update_k():
+    from ..serving.online import _jitted_update_k
+
+    sp = spec()
+    Ms = sp.state_dim
+    kb = 4
+    fn = _jitted_update_k(sp, "univariate", kb, True)
+    return fn, [(f64(sp.n_params), f64(Ms), f64(Ms, Ms), f64(N, kb),
+                 boolean(kb))]
+
+
+def _shard_update_args(warmup: bool):
+    """The store's two staging paths for the SAME program: hot path
+    (``_launch_chunk``) and warm-up (``warmup``) — bit-identical avals or
+    the compile matrix silently doubles (the PR-8 staging-mismatch bug).
+    The request arrays come from the REAL shared staging helper
+    (``serving.store.stage_request_arrays``, the recipe both production
+    paths call), with the hot variant filled the way ``_launch_chunk``
+    fills it — so a dtype/shape drift in the actual staging code shows up
+    here as a second lowering, not just in a hand-maintained copy."""
+    from ..serving.store import stage_request_arrays
+
+    sp = spec()
+    Ms = sp.state_dim
+    Y, slots, valid = stage_request_arrays(sp, BUCKET)
+    if not warmup:
+        # one live request, as _launch_chunk stages it (concrete values
+        # never change the aval — the variants must still lower identically)
+        Y[:, 0] = 0.04
+        slots[0], valid[0] = 1, True
+    return (f64(sp.n_params, CAP), f64(Ms, CAP), f64(Ms, Ms, CAP),
+            i32(CAP), Y, slots, valid)
+
+
+@case("serving.online._jitted_shard_update", label="donated", donated=4)
+def _m_shard_update():
+    from ..serving.online import _jitted_shard_update
+
+    fn = _jitted_shard_update(spec(), "univariate", CAP, BUCKET, True)
+    return fn, [_shard_update_args(warmup=False),
+                _shard_update_args(warmup=True)]
+
+
+@case("serving.online._jitted_slot_write", label="donated", donated=4)
+def _m_slot_write():
+    from ..serving.online import _jitted_slot_write
+
+    sp = spec()
+    Ms = sp.state_dim
+    fn = _jitted_slot_write(sp, CAP, True)
+    return fn, [(f64(sp.n_params, CAP), f64(Ms, CAP), f64(Ms, Ms, CAP),
+                 i32(CAP), i32(), f64(sp.n_params), f64(Ms), f64(Ms, Ms),
+                 i32())]
+
+
+@case("serving.online._jitted_refilter")
+def _m_refilter():
+    from ..serving.online import _jitted_refilter
+
+    sp = spec()
+    return _jitted_refilter(sp, T), [(f64(sp.n_params), f64(N, T))]
+
+
+@case("serving.online._jitted_scenarios")
+def _m_scenarios():
+    from ..serving.online import _jitted_scenarios
+
+    sp = spec()
+    Ms = sp.state_dim
+    n = 4
+    fn = _jitted_scenarios(sp, H, n)
+    return fn, [(f64(sp.n_params), f64(Ms), f64(Ms, Ms), keys(n))]
+
+
+@case("serving.snapshot._jitted_freeze_batch")
+def _m_freeze_batch():
+    from ..serving.snapshot import _jitted_freeze_batch
+
+    sp = spec()
+    B = 4
+    fn = _jitted_freeze_batch(sp, T, "univariate", B)
+    return fn, [(f64(B, sp.n_params), f64(N, T), i64(B))]
+
+
+# ---------------------------------------------------------------------------
+# robustness
+# ---------------------------------------------------------------------------
+
+@case("robustness.ladder._jitted_sqrt_rescue")
+def _m_sqrt_rescue():
+    from ..robustness.ladder import _jitted_sqrt_rescue
+
+    P = npar()
+    return _jitted_sqrt_rescue(spec(), T), [(f64(P), f64(N, T),
+                                             i64(), i64())]
+
+
+@case("robustness.ladder._jitted_assoc_rescue")
+def _m_assoc_rescue():
+    from ..robustness.ladder import _jitted_assoc_rescue
+
+    P = npar()
+    return _jitted_assoc_rescue(spec()), [(f64(P), f64(N, T),
+                                           i64(), i64())]
+
+
+@case("robustness.taxonomy._jitted_diagnose")
+def _m_diagnose():
+    from ..robustness.taxonomy import _jitted_diagnose
+
+    P = npar()
+    return _jitted_diagnose(spec(), T), [(f64(P), f64(N, T), i64(), i64())]
+
+
+# ---------------------------------------------------------------------------
+# parallel — mesh-sharded programs (2-device meshes; the audit env exposes 8
+# virtual CPU devices, conftest-style)
+# ---------------------------------------------------------------------------
+
+@case("parallel.mesh._sharded_batch_loss", label="donated", donated=1)
+def _m_sharded_batch_loss():
+    from ..parallel.mesh import _sharded_batch_loss
+
+    P = npar()
+    fn = _sharded_batch_loss(spec(), T, mesh2(), "batch")
+    return fn, [(f64(8, P), f64(N, T), i64(), i64())]
+
+
+@case("parallel.mesh._sharded_multistart", label="donated", donated=1)
+def _m_sharded_multistart():
+    from ..parallel.mesh import _sharded_multistart
+
+    P = npar()
+    fn = _sharded_multistart(spec(), T, mesh2(), "batch", ITERS, GT, FA)
+    return fn, [(f64(8, P), f64(N, T), i64(), i64())]
+
+
+@case("parallel.mesh._sharded_pf")
+def _m_sharded_pf():
+    from ..parallel.mesh import _sharded_pf
+
+    P = npar()
+    fn = _sharded_pf(spec(), T, mesh2(), "batch", NP, 0.95, 0.2)
+    return fn, [(f64(4, P), keys(4), f64(N, T))]
+
+
+@case("parallel.time_parallel._jitted_time_sharded_loss")
+def _m_time_sharded_loss():
+    from ..parallel.time_parallel import _jitted_time_sharded_loss
+
+    P = npar()
+    fn = _jitted_time_sharded_loss(spec(), T, mesh2("time"), "time")
+    return fn, [(f64(P), f64(N, T), i64(), i64())]
+
+
+@case("parallel.time_parallel._jitted_time_sharded_multistart")
+def _m_time_sharded_multistart():
+    from ..parallel.time_parallel import _jitted_time_sharded_multistart
+
+    P = npar()
+    fn = _jitted_time_sharded_multistart(spec(), T, mesh2("time"), "time",
+                                         ITERS, GT, FA)
+    return fn, [(f64(2, P), f64(N, T), i64(), i64())]

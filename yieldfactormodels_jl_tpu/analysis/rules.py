@@ -1,4 +1,4 @@
-"""graftlint rules YFM001–YFM009 (rule table in docs/DESIGN.md §15).
+"""graftlint rules YFM001–YFM011 (rule table in docs/DESIGN.md §15/§18).
 
 Each rule is a small function over a parsed :class:`~.engine.SourceModule`
 (or the whole module list for project-scope rules) registered via
@@ -13,7 +13,7 @@ import ast
 import os
 import re
 from functools import lru_cache
-from typing import Iterable, List
+from typing import Iterable, List, Optional
 
 from .engine import (Finding, JIT_ENTRY, LintConfig, SourceModule, call_name,
                      dotted_name, enclosing_functions, iter_py_files,
@@ -580,6 +580,295 @@ def yfm008_request_path(mod: SourceModule,
 # ---------------------------------------------------------------------------
 
 _CITATION = re.compile(r"/root/reference/([A-Za-z0-9_./-]+)")
+
+
+# ---------------------------------------------------------------------------
+# YFM010 — lock discipline in the threaded host layer (DESIGN §18)
+# ---------------------------------------------------------------------------
+
+_LOCK_CTORS = frozenset({
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "Lock", "RLock", "Condition",
+})
+
+#: method calls that mutate their receiver in place (dict/list/set/deque
+#: surface) — the writes a plain assignment scan would miss
+_INPLACE_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "insert", "add", "update",
+    "setdefault", "pop", "popleft", "popitem", "remove", "discard", "clear",
+})
+
+#: construction-time methods: single-threaded by contract, writes there are
+#: neither locked nor unlocked evidence
+_CTOR_METHODS = frozenset({"__init__", "__new__", "__post_init__"})
+
+
+def _self_attr1(expr) -> Optional[str]:
+    """Depth-1 ``self`` attribute a write targets: ``self.a``, ``self.a[k]``,
+    ``self.a[k][j]`` → ``'a'``; ``self.a.b`` (a write into a sub-object,
+    ambiguous ownership) and non-self bases → ``None``."""
+    while isinstance(expr, ast.Subscript):
+        expr = expr.value
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name) \
+            and expr.value.id == "self":
+        return expr.attr
+    return None
+
+
+def _under_self_lock(node, parents, locks: frozenset) -> bool:
+    """Whether ``node`` sits inside ``with self.<lock>:`` for ANY of the
+    class's lock attributes.  Any-lock on purpose: guarding one attribute
+    with two different locks is a (rare) design choice the gateway makes
+    deliberately (``_cv`` wraps ``_lock``); the bug class YFM010 hunts is
+    *no lock at all* on one path while another path locks."""
+    p = parents.get(node)
+    while p is not None:
+        if isinstance(p, (ast.With, ast.AsyncWith)):
+            for item in p.items:
+                name = dotted_name(item.context_expr)
+                if name.startswith("self.") and name[5:] in locks:
+                    return True
+        p = parents.get(p)
+    return False
+
+
+def _iter_self_writes(method):
+    """(node, attr) pairs for every depth-1 ``self`` attribute mutation in
+    ``method``: assignments (plain/aug/ann, incl. subscript targets),
+    ``del self.a[...]``, and in-place mutator calls."""
+    for node in ast.walk(method):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                attr = _self_attr1(t)
+                if attr:
+                    yield node, attr
+        elif isinstance(node, ast.AugAssign) \
+                or (isinstance(node, ast.AnnAssign)
+                    and node.value is not None):
+            # a bare `self._x: SomeType` annotation (no value) declares,
+            # it does not mutate
+            attr = _self_attr1(node.target)
+            if attr:
+                yield node, attr
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                attr = _self_attr1(t)
+                if attr:
+                    yield node, attr
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _INPLACE_MUTATORS:
+            attr = _self_attr1(node.func.value)
+            if attr:
+                yield node, attr
+
+
+@rule("YFM010", "lock-discipline",
+      "in serving/ and orchestration/ classes that create a threading lock, "
+      "an instance attribute mutated under `with self._lock` somewhere must "
+      "not also be mutated with no lock held elsewhere — the silent-race "
+      "bug class the PR-3 thread-local report and PR-8 registry RLock "
+      "patched by hand")
+def yfm010_lock_discipline(mod: SourceModule,
+                           config: LintConfig) -> Iterable[Finding]:
+    rel = mod.rel.replace(os.sep, "/")
+    if not any(rel.startswith(d.rstrip("/") + "/") for d in config.lock_dirs):
+        return
+    for cls in ast.walk(mod.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        def _lock_attrs(node):
+            # plain AND annotated assignments create locks — missing
+            # AnnAssign would silently disable the rule for a class that
+            # writes `self._lock: threading.Lock = threading.Lock()`
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign):
+                targets, value = [node.target], node.value
+            else:
+                return
+            if isinstance(value, ast.Call) \
+                    and call_name(value) in _LOCK_CTORS:
+                for t in targets:
+                    attr = _self_attr1(t)
+                    if attr:
+                        yield attr
+
+        locks = frozenset(attr for node in ast.walk(cls)
+                          for attr in _lock_attrs(node))
+        if not locks:
+            continue
+        methods = [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        # a PRIVATE method every same-class call site invokes while holding
+        # a lock runs locked by construction (`_rebuild_slot` under the
+        # store's `_collect` lock, the `pump`→`_pump_locked`→dispatch
+        # convention) — closed to a fixed point so locked-ness propagates
+        # down call chains
+        method_names = {m.name for m in methods}
+
+        def owner(node):
+            for fn in enclosing_functions(node, mod.parents):
+                if getattr(fn, "name", None) in method_names:
+                    return fn.name
+            return None
+
+        sites = {m.name: [c for c in ast.walk(cls)
+                          if isinstance(c, ast.Call)
+                          and dotted_name(c.func) == f"self.{m.name}"]
+                 for m in methods
+                 if m.name.startswith("_") and m.name not in _CTOR_METHODS}
+
+        # calls FROM construction-time code are single-threaded by the same
+        # contract that exempts ctor bodies — neither locked nor unlocked
+        # evidence; a private method reachable ONLY from ctors inherits the
+        # exemption wholesale (the `__init__ → self._reset()` chain).  Both
+        # closures run as GREATEST fixed points (start optimistic, strike
+        # any method with a disqualifying call site) so recursive and
+        # mutually-recursive chains converge — a least fixed point could
+        # never admit `pump() { with lock: self._retry() }` with a
+        # self-recursive `_retry`, flagging correct code
+        ctor_only: set = {name for name, calls in sites.items() if calls}
+        changed = True
+        while changed:
+            changed = False
+            for name in sorted(ctor_only):
+                if not all(owner(c) in _CTOR_METHODS or owner(c) in ctor_only
+                           for c in sites[name]):
+                    ctor_only.discard(name)
+                    changed = True
+
+        runtime_calls = {name: [c for c in calls
+                                if owner(c) not in _CTOR_METHODS
+                                and owner(c) not in ctor_only]
+                         for name, calls in sites.items()}
+        locked_methods: set = {name for name, rc in runtime_calls.items()
+                               if rc and name not in ctor_only}
+        changed = True
+        while changed:
+            changed = False
+            for name in sorted(locked_methods):
+                if not all(_under_self_lock(c, mod.parents, locks)
+                           or owner(c) in locked_methods
+                           for c in runtime_calls[name]):
+                    locked_methods.discard(name)
+                    changed = True
+        locked: dict = {}
+        unlocked: dict = {}
+        for m in methods:
+            if m.name in _CTOR_METHODS or m.name in ctor_only:
+                continue
+            for node, attr in _iter_self_writes(m):
+                if attr in locks:
+                    continue
+                if _under_self_lock(node, mod.parents, locks) \
+                        or m.name in locked_methods:
+                    locked.setdefault(attr, []).append(node)
+                else:
+                    unlocked.setdefault(attr, []).append(node)
+        for attr in sorted(set(locked) & set(unlocked)):
+            seen_lines = set()
+            for node in unlocked[attr]:
+                if node.lineno in seen_lines:
+                    continue
+                seen_lines.add(node.lineno)
+                yield _finding(
+                    "YFM010", mod, node,
+                    f"{cls.name}.{attr} is mutated under `with self.<lock>` "
+                    f"elsewhere (locks: {sorted(locks)}) but written here "
+                    f"with no lock held — a silent race; take the lock, or "
+                    f"pragma with the invariant that makes this safe")
+
+
+# ---------------------------------------------------------------------------
+# YFM011 — IR-audit manifest coverage (DESIGN §18)
+# ---------------------------------------------------------------------------
+
+def _manifest_keys(config: LintConfig):
+    """``key → lineno`` of every ``case("...")``/``skip_case("...")``
+    registration in the manifest module, or ``None`` when the manifest does
+    not exist (pre-tier-2 trees and fixture repos lint clean)."""
+    path = config.abspath(config.manifest_module)
+    if not os.path.isfile(path):
+        return None
+    with open(path, encoding="utf-8") as fh:
+        try:
+            tree = ast.parse(fh.read(), filename=path)
+        except SyntaxError:
+            return None
+    keys: dict = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and dotted_name(
+                node.func).split(".")[-1] in ("case", "skip_case") \
+                and node.args and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            keys.setdefault(node.args[0].value, node.lineno)
+    return keys
+
+
+def _registered_builders(config: LintConfig):
+    """``key → (rel, lineno)`` for every ``@register_engine_cache`` builder
+    in the package, discovered from disk (like YFM007's registry read: the
+    coverage contract is project-global, independent of the linted subset)."""
+    out: dict = {}
+    pkg = config.abspath(config.package)
+    prefix = config.package + "/analysis/"
+    for path in iter_py_files(pkg):
+        rel = os.path.relpath(path, config.root).replace(os.sep, "/")
+        if rel.startswith(prefix):
+            continue
+        with open(path, encoding="utf-8") as fh:
+            try:
+                tree = ast.parse(fh.read(), filename=path)
+            except SyntaxError:
+                continue
+        dotted = rel[len(config.package) + 1:-3].replace("/", ".")
+        if dotted.endswith(".__init__"):
+            dotted = dotted[: -len(".__init__")]
+        # `from ..config import register_engine_cache as _rec` must not
+        # hide a builder from the coverage census (the runtime census in
+        # ir.py would still see it — the tiers must observe the same set)
+        names = {"register_engine_cache"}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    if a.name == "register_engine_cache" and a.asname:
+                        names.add(a.asname)
+        # module-level defs only: the runtime census keys builders by
+        # __qualname__, which equals the bare name ONLY at top level — a
+        # nested builder would make the two tiers demand contradictory
+        # manifest keys (the runtime census still covers it by qualname)
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and any(_dec_name(d) in names
+                            for d in node.decorator_list):
+                out[f"{dotted}.{node.name}"] = (rel, node.lineno)
+    return out
+
+
+@rule("YFM011", "ir-manifest-coverage",
+      "every @register_engine_cache builder must have a case/skip_case "
+      "entry in analysis/manifest.py (and every manifest key must name a "
+      "real builder) — tier-2 IR coverage grows with the code instead of "
+      "rotting", scope="project")
+def yfm011_manifest_coverage(modules, config: LintConfig) -> Iterable[Finding]:
+    keys = _manifest_keys(config)
+    if keys is None:
+        return
+    builders = _registered_builders(config)
+    for key, (rel, lineno) in sorted(builders.items()):
+        if key not in keys:
+            yield Finding(
+                "YFM011", rel, lineno, 0,
+                f"builder {key} has no IR-audit manifest entry — add a "
+                f"case()/skip_case() to analysis/manifest.py so `--ir` "
+                f"covers it (docs/DESIGN.md §18)")
+    for key, lineno in sorted(keys.items()):
+        if key not in builders:
+            yield Finding(
+                "YFM011", config.manifest_module, lineno, 0,
+                f"manifest entry {key!r} names no registered engine-cache "
+                f"builder — prune the stale key or fix the name")
 
 
 @rule("YFM009", "citation-exists",

@@ -51,6 +51,29 @@ def register_engine_cache(fn):
     return fn
 
 
+def engine_cache_entries():
+    """``(qualified_name, builder)`` pairs for every registered engine-cache
+    builder, name = ``<module>.<qualname>`` with the package prefix stripped
+    (``"estimation.optimize._jitted_loss"``).
+
+    The introspection seam of the IR program auditor (``analysis/ir.py``,
+    docs/DESIGN.md §18): tier 2 enumerates THIS list — after importing the
+    package's modules — and audits each builder's lowered artifact at the
+    shapes ``analysis/manifest.py`` declares, so coverage is defined by what
+    actually registered at import time, never by a hand-maintained list.
+    Names are stable across lru_cache wrapping (``functools.update_wrapper``
+    preserves ``__module__``/``__qualname__``)."""
+    prefix = __name__.rsplit(".", 1)[0] + "."
+    out = []
+    for fn in _ENGINE_CACHES:
+        mod = getattr(fn, "__module__", "") or ""
+        if mod.startswith(prefix):
+            mod = mod[len(prefix):]
+        qual = getattr(fn, "__qualname__", getattr(fn, "__name__", "?"))
+        out.append((f"{mod}.{qual}", fn))
+    return out
+
+
 def make_trace_counter():
     """Per-module trace-counter triple ``(trace_counts, note_trace,
     reset_trace_counts)``: ``note_trace(kind)`` is called at the top of a

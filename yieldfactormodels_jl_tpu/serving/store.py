@@ -72,6 +72,21 @@ from .snapshot import (ServingError, ServingSnapshot, SnapshotMeta,
 Key = Tuple[str, int]
 
 
+def stage_request_arrays(spec, bucket: int):
+    """The ONE staging recipe for a shard-update request bucket: all-padding
+    ``(Y, slots, valid)`` host buffers at the program's input signature.
+    Both launch paths — ``_launch_chunk`` (hot) and ``warmup`` — build their
+    request arrays HERE, so they cannot drift apart and silently double the
+    per-(device, bucket) compile matrix (the PR-8 staging-mismatch bug); the
+    IR-audit manifest (``analysis/manifest.py``) derives its
+    ``_jitted_shard_update`` staging-parity variants from this same helper,
+    pinning the recipe against the resident-state avals at lowering time."""
+    Y = np.full((spec.N, bucket), np.nan, dtype=spec.dtype)
+    slots = np.zeros((bucket,), dtype=np.int32)
+    valid = np.zeros((bucket,), dtype=bool)
+    return Y, slots, valid
+
+
 def _route_waves(items, slot_map) -> List[Dict[int, list]]:
     """Group an update micro-batch by OWNING SHARD — the routing step of the
     request path (DESIGN §16 state machine), pure host dict/list work: no
@@ -402,10 +417,7 @@ class ShardedStateStore:
         onto the owning shard's device alongside the committed resident
         state — no per-input device_put dispatches on the hot path)."""
         bb = self.lattice.update_bucket(len(chunk))
-        N = self.spec.N
-        Y = np.full((N, bb), np.nan, dtype=self.spec.dtype)
-        slots = np.zeros((bb,), dtype=np.int32)
-        valid = np.zeros((bb,), dtype=bool)
+        Y, slots, valid = stage_request_arrays(self.spec, bb)
         for j, (gpos, sl) in enumerate(chunk):
             Y[:, j] = staged[gpos][2]
             slots[j], valid[j] = sl, True
@@ -535,13 +547,12 @@ class ShardedStateStore:
                 runner = _jitted_shard_update(self.spec, self.engine,
                                               self.shard_capacity, bb,
                                               self._donate)
-                # request arrays staged EXACTLY like _launch_chunk's (plain
-                # host buffers): a different staging signature here would
-                # compile a second executable per (device, bucket) and the
-                # first live request would pay it on the hot path
-                Y = np.full((self.spec.N, bb), np.nan, dtype=self.spec.dtype)
-                slots = np.zeros((bb,), dtype=np.int32)
-                valid = np.zeros((bb,), dtype=bool)
+                # request arrays staged EXACTLY like _launch_chunk's: both
+                # paths build them in stage_request_arrays — a different
+                # staging signature here would compile a second executable
+                # per (device, bucket) and the first live request would pay
+                # it on the hot path
+                Y, slots, valid = stage_request_arrays(self.spec, bb)
                 for sh in self._shards:
                     outs = runner(sh["params"], sh["beta"], sh["cov"],
                                   sh["ver"], Y, slots, valid)
