@@ -765,8 +765,12 @@ def _longt_line():
     sequential vs associative-scan loglik evals/s at T ∈ {360, 5k, 20k},
     plus the time-sharded assoc variant (panel ``P(None, "time")`` over the
     mesh — 8 virtual devices on the CPU fallback path, whatever the real
-    topology exposes on device).  Callable both in-process (TPU rounds) and
-    from the ``--longt-bench`` subprocess (CPU fallback rounds)."""
+    topology exposes on device), plus — unless ``BENCH_LONGT_TVL=0`` — the
+    NONLINEAR column (docs/DESIGN.md §19): the sequential TVλ EKF vs the
+    iterated-SLR engine on single-chain value+grad at the same T grid, and
+    the second-order tangent split (sequential vs tree-composed Fisher HVP
+    under the T-switch) at T = 5k.  Callable both in-process (TPU rounds)
+    and from the ``--longt-bench`` subprocess (CPU fallback rounds)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -844,10 +848,78 @@ def _longt_line():
                 ratio_at_max = t_svg / t_avg
         except Exception as e:  # per-T isolation: one OOM ≠ no line
             parts.append(f"T={T} failed ({type(e).__name__})")
+
+    # ---- nonlinear (TVλ) column: sequential EKF vs iterated SLR ----
+    tvl_ratio_at_max = float("nan")
+    if os.environ.get("BENCH_LONGT_TVL", "1") not in ("0", ""):
+        try:
+            from tests.oracle import stable_tvl_params
+            from yieldfactormodels_jl_tpu.ops import slr_scan
+
+            tspec, _ = create_model("TVλ", tuple(MATURITIES),
+                                    float_type="float32")
+            tp = jnp.asarray(stable_tvl_params(tspec, np.float32))
+        except Exception as e:
+            # same isolation contract as the per-T loops: a TVλ setup
+            # failure must not discard the AFNS5 parts already measured
+            parts.append(f"tvl setup failed ({type(e).__name__})")
+            tspec = None
+        for T in Ts if tspec is not None else ():
+            try:
+                data = jnp.asarray(make_panel(seed=7, T=T),
+                                   dtype=tspec.dtype)
+                t_seq, v_seq = timed(jax.jit(jax.value_and_grad(
+                    lambda p: univariate_kf.get_loss(tspec, p, data))), tp)
+                t_slr, v_slr = timed(jax.jit(jax.value_and_grad(
+                    lambda p: slr_scan.get_loss(tspec, p, data))), tp)
+                agree = bool(np.isfinite(float(v_seq[0]))
+                             and np.isclose(float(v_seq[0]),
+                                            float(v_slr[0]), rtol=2e-2))
+                parts.append(
+                    f"tvl T={T} grad[1-chain] seq {t_seq * 1e3:.0f} | slr "
+                    f"{t_slr * 1e3:.0f} ms (agree={agree})")
+                if T == max(Ts):
+                    tvl_ratio_at_max = t_seq / t_slr
+            except Exception as e:
+                parts.append(f"tvl T={T} failed ({type(e).__name__})")
+        # second-order tangent split: the Fisher HVP's linearize sweep over
+        # the assoc elements vs the sequential carry (the provider the
+        # T-switch flips, ops/newton._innovations).  Measured on the AFNS5
+        # constant-Z spec — deliberately independent of the TVλ setup
+        # above, so a TVλ failure cannot suppress it.
+        try:
+            from yieldfactormodels_jl_tpu import config as _cfg2
+            from yieldfactormodels_jl_tpu.models.params import (
+                untransform_params as _untransform)
+            from yieldfactormodels_jl_tpu.ops import newton as _newton2
+
+            Tn = 5000 if 5000 in Ts else max(Ts)
+            data = jnp.asarray(make_panel(seed=7, T=Tn), dtype=spec.dtype)
+            raw = jnp.asarray(_untransform(spec, p1))
+            u = jnp.ones_like(raw)
+            hvp = jax.jit(lambda r, d_: _newton2.fisher_hvp(
+                spec, r, u, d_, 0, Tn))
+            t_hseq, _ = timed(lambda r: hvp(r, data), raw)
+            prev_switch = _cfg2.loglik_t_switch()  # restore, don't clobber
+            _cfg2.set_loglik_t_switch(1)
+            try:
+                hvp_t = jax.jit(lambda r, d_: _newton2.fisher_hvp(
+                    spec, r, u, d_, 0, Tn))
+                t_htree, _ = timed(lambda r: hvp_t(r, data), raw)
+            finally:
+                _cfg2.set_loglik_t_switch(prev_switch)
+            parts.append(f"newton-tangent@T={Tn} fisher-hvp seq "
+                         f"{t_hseq * 1e3:.0f} | tree {t_htree * 1e3:.0f} ms "
+                         f"({t_hseq / t_htree:.2f}x)")
+        except Exception as e:
+            parts.append(f"newton-tangent failed ({type(e).__name__})")
+
     plat = jax.devices()[0].platform
     return (f"longt-bench[AFNS5, {plat} x{n_dev}]: " + "; ".join(parts)
             + f"; assoc/seq 1-chain value+grad speedup @T={max(Ts)}: "
-              f"{ratio_at_max:.2f}x")
+              f"{ratio_at_max:.2f}x"
+            + f"; slr/seq tvl 1-chain value+grad speedup @T={max(Ts)}: "
+              f"{tvl_ratio_at_max:.2f}x")
 
 
 def _longt_bench():

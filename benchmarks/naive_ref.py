@@ -311,6 +311,43 @@ def unit_longt_pass(T=20000):
                   f"ll={ll:.1f}")
 
 
+def unit_slr_pass(T=20000, sweeps=2, chunk=128):
+    """Nonlinear long-panel unit (the BENCH_LONGT TVλ dual-ratio wall): one
+    naive 1-thread NumPy ITERATED-SLR evaluation — the sequential affine
+    pass plus ``sweeps`` chunked exact-EKF refinement sweeps
+    (tests/oracle.iterated_slr_filter, the independent loop the engine is
+    pinned against) — at the T=20,000 daily/intraday scale.  What a user of
+    the reference pays to run the same algorithm as per-step loops: ~(1 +
+    sweeps) sequential T-step walks with per-step relinearization and an
+    N×N inverse each.  Pairs with bench.py's ``BENCH_LONGT=1``
+    seq-vs-SLR TVλ line for the BASELINE.md dual-ratio row."""
+    from yieldfactormodels_jl_tpu import create_model
+
+    spec, _ = create_model("TVλ", tuple(common.MATURITIES),
+                           float_type="float32")
+    p = oracle.stable_tvl_params(spec)
+    Ms = spec.state_dim
+    C = np.zeros((Ms, Ms))
+    rows, cols = spec.chol_indices
+    a, _ = spec.layout["chol"]
+    for k, (r, c) in enumerate(zip(rows, cols)):
+        C[r, c] = p[a + k]
+    lo, hi = spec.layout["delta"]
+    delta = np.asarray(p[lo:hi], dtype=np.float64)
+    lo, hi = spec.layout["phi"]
+    Phi = np.asarray(p[lo:hi], dtype=np.float64).reshape(Ms, Ms)
+    ov = float(p[spec.layout["obs_var"][0]])
+    mats = np.asarray(common.MATURITIES, dtype=np.float64)
+    rng = np.random.default_rng(7)
+    data = oracle.simulate_dns_panel(rng, mats, T=T, lam=0.5)
+    t0 = time.perf_counter()
+    *_, ll = oracle.iterated_slr_filter(Phi, delta, C @ C.T, ov, mats, data,
+                                        sweeps=sweeps, chunk=chunk)
+    wall = time.perf_counter() - t0
+    return wall, (f"one naive iterated-SLR pass at T={T} "
+                  f"(K={sweeps} sweeps, chunk={chunk}), ll={ll:.1f}")
+
+
 def naive_scenario_fan(R=256, G=16, D=8, Pn=128, S=6, h=12, n_paths=32,
                        block_len=12):
     """Scenario-lattice wall (the ``BENCH_SCEN`` dual-ratio denominator): a
@@ -475,6 +512,7 @@ RUNNERS = {
     "bootstrap-2000": naive_bootstrap,
     "unit-afns5-pass": unit_afns5_pass,
     "unit-longt-pass": unit_longt_pass,
+    "unit-slr-pass": unit_slr_pass,
     "unit-ssd-pass": unit_ssd_nns_pass,
     "scenario-fan": naive_scenario_fan,
     "unit-newton-iteration": unit_newton_iteration,

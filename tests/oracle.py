@@ -844,6 +844,109 @@ def crps_sample_naive(samples, y):
     return float(t1 - t2)
 
 
+def _tvl_linearize(beta, maturities, exact_jacobian=False):
+    """(Z (N, 4), d (N,)) — the TVλ measurement's affine surrogate
+    y ≈ Z x + d linearized at ``beta`` (first-order Taylor with the
+    reference's analytic Jacobian column, kalman/filter.jl:38-46), shared by
+    the iterated-SLR oracle below."""
+    lam = LAMBDA_FLOOR + np.exp(beta[3])
+    tau = lam * maturities
+    z = np.exp(-tau)
+    z2 = (1 - z) / tau
+    z3 = z2 - z
+    dlam = lam - LAMBDA_FLOOR
+    if exact_jacobian:
+        dz2 = z / lam - (1 - z) / (lam * lam * maturities)
+    else:
+        dz2 = z / lam - z / (lam * lam * maturities)
+    jac = ((beta[1] + beta[2]) * dz2 + beta[2] * maturities * z) * dlam
+    Z = np.column_stack([np.ones_like(z), z2, z3, jac])
+    h = beta[0] + z2 * beta[1] + z3 * beta[2]
+    return Z, h - Z @ beta
+
+
+def iterated_slr_filter(Phi, delta, Omega_state, obs_var, maturities, data,
+                        sweeps=2, chunk=128, exact_jacobian=False):
+    """Iterated two-scale SLR filter for the TVλ family — independent NumPy
+    float64 loops, the oracle for ``ops/slr_scan.py`` (docs/DESIGN.md §19).
+
+    Deliberately a DIFFERENT algebraic route than the engine: pass A here is
+    a plain SEQUENTIAL affine Kalman recursion under the surrogate
+    linearized on the prediction-only (constant unconditional-mean) path,
+    where the engine composes per-step Woodbury-assembled elements on the
+    parallel-prefix tree — agreement therefore checks the element algebra
+    and the combine composition, not a transliteration.  The K refinement
+    sweeps mirror the engine's semantics exactly: each chunk of ``chunk``
+    steps re-runs the TRUE EKF recursion (predict, linearize at the chunk's
+    own predicted mean, joint update via explicit inverses) from its entry
+    moments — pass A's filtered moments at the chunk boundaries for sweep 1,
+    the previous sweep's chunk-exit moments (Jacobi shift, chunk 0 keeps the
+    stationary prior) after.  Whole columns with any NaN are predict-only.
+
+    Returns ``(betas (T, Ms) filtered means, Ps (T, Ms, Ms), lls (T,),
+    loglik)`` with ``lls`` the per-step contributions (0 on unobserved
+    steps) and ``loglik`` their sum over the engines' contributing window
+    t = 1 .. T−2 — the value that converges to :func:`ekf_tvl_loglik` in K.
+    """
+    N, T = data.shape
+    Ms = Phi.shape[0]
+    beta0, P0 = kalman_init(Phi, delta, Omega_state)
+    Omega_obs = obs_var * np.eye(N)
+
+    # pass A — sequential affine filter under the constant-path surrogate
+    Zc, dc = _tvl_linearize(Phi @ beta0 + delta, maturities, exact_jacobian)
+    beta, P = beta0.copy(), P0.copy()
+    filt = []
+    for t in range(T):
+        beta = delta + Phi @ beta
+        P = Phi @ P @ Phi.T + Omega_state
+        y = data[:, t]
+        if np.all(np.isfinite(y)):
+            v = y - (Zc @ beta + dc)
+            F = Zc @ P @ Zc.T + Omega_obs
+            K = P @ Zc.T @ np.linalg.inv(F)
+            beta = beta + K @ v
+            P = (np.eye(Ms) - K @ Zc) @ P
+        filt.append((beta.copy(), P.copy()))
+
+    L = min(chunk, T)
+    n_chunks = -(-T // L)
+    entries = [(beta0.copy(), P0.copy())]
+    entries += [tuple(np.copy(a) for a in filt[c * L - 1])
+                for c in range(1, n_chunks)]
+
+    # K refinement sweeps — exact EKF within chunks, Jacobi boundary shift
+    for _ in range(sweeps):
+        betas = np.zeros((T, Ms))
+        Ps = np.zeros((T, Ms, Ms))
+        lls = np.zeros(T)
+        exits = []
+        for c in range(n_chunks):
+            beta, P = (np.copy(a) for a in entries[c])
+            for j in range(c * L, min((c + 1) * L, T)):
+                beta = delta + Phi @ beta
+                P = Phi @ P @ Phi.T + Omega_state
+                y = data[:, j]
+                if np.all(np.isfinite(y)):
+                    Z, d = _tvl_linearize(beta, maturities, exact_jacobian)
+                    v = y - (Z @ beta + d)
+                    F = Z @ P @ Z.T + Omega_obs
+                    F_inv = np.linalg.inv(F)
+                    K = P @ Z.T @ F_inv
+                    _, logdet = np.linalg.slogdet(F)
+                    lls[j] = -0.5 * (logdet + v @ F_inv @ v + N * LOG_2PI)
+                    beta = beta + K @ v
+                    P = (np.eye(Ms) - K @ Z) @ P
+                betas[j] = beta
+                Ps[j] = P
+            exits.append((beta.copy(), P.copy()))
+        entries = [(beta0.copy(), P0.copy())] + exits[:-1]
+
+    obs = np.all(np.isfinite(data), axis=0)
+    contrib = (np.arange(T) >= 1) & (np.arange(T) <= T - 2) & obs
+    return betas, Ps, lls, float(np.sum(np.where(contrib, lls, 0.0)))
+
+
 def fd_hessian(fun, x, eps=1e-4):
     """Central-difference Hessian of a scalar callable — independent NumPy
     loops, the second-order parity oracle (tests/test_newton.py pins the

@@ -29,7 +29,7 @@ MATS = tuple(np.array([3, 12, 24, 60, 120, 240, 360]) / 12.0)
 #: engine-coverage guard in test_conventions.py greps test ASTs for these
 #: names, and test_engine_list_is_in_sync below forces this list to track
 #: the registry, so a new engine cannot ship without oracle parity here
-ALL_ENGINES = ("univariate", "sqrt", "joint", "assoc")
+ALL_ENGINES = ("univariate", "sqrt", "joint", "assoc", "slr")
 
 
 def _case(rng, T=120, dtype=np.float64):
@@ -61,7 +61,8 @@ def test_engine_list_is_in_sync():
     assert ALL_ENGINES == tuple(yfm.KALMAN_ENGINES)
 
 
-@pytest.mark.parametrize("engine", ["univariate", "sqrt", "joint", "assoc"])
+@pytest.mark.parametrize("engine",
+                         ["univariate", "sqrt", "joint", "assoc", "slr"])
 def test_engine_oracle_parity_with_nan_gap(engine, rng):
     """Every loglik engine vs the independent NumPy float64 loop
     (tests/oracle.py), interior NaN gap included — oracle-backed, never
@@ -268,9 +269,12 @@ def test_estimate_time_sharded_objective(rng):
     ts = optimize.estimate(spec, data, starts, max_iters=15,
                            objective="time_sharded")
     np.testing.assert_allclose(ts[1], base[1], rtol=1e-6)
+    # TVλ is covered now (the iterated-SLR engine — tests/test_slr_scan.py);
+    # a family with NO parallel-in-time engine still gets the structured
+    # error, via the config.engines_for introspection seam
     with pytest.raises(ValueError, match="time_sharded"):
-        sv_spec, _ = yfm.create_model("TVλ", MATS, float_type="float64")
-        optimize.estimate(sv_spec, data, np.zeros((sv_spec.n_params, 1)),
+        ns_spec, _ = yfm.create_model("NS", MATS, float_type="float64")
+        optimize.estimate(ns_spec, data, np.zeros((ns_spec.n_params, 1)),
                           objective="time_sharded")
 
 
@@ -401,5 +405,7 @@ def test_refilter_sqrt_engine_and_validation(rng):
     tvl_p = oracle.stable_tvl_params(tvl_spec)
     tvl_svc = YieldCurveService(
         freeze_snapshot(tvl_spec, tvl_p, panel[:, :64]))
-    with pytest.raises(ServingError, match="constant-measurement"):
-        tvl_svc.refilter(panel)
+    # TVλ snapshots re-filter on the iterated-SLR engine now (docs/DESIGN.md
+    # §19; the accumulated-updates drift regression lives in
+    # tests/test_slr_scan.py)
+    assert np.isfinite(tvl_svc.refilter(panel))

@@ -95,16 +95,22 @@ def test_every_kalman_engine_has_oracle_parity_coverage():
     assert not res.findings, _render(res.findings)
 
     # non-vacuity: the statically-parsed registries match the live ones
-    # (KALMAN_ENGINES plus the second-order NEWTON_ENGINES — one parity
-    # contract), the scan saw the canonical coverage modules, and the
-    # Kalman registry is still the four-engine set (or larger)
+    # (KALMAN_ENGINES plus the SLR linearization rules plus the
+    # second-order NEWTON_ENGINES — one parity contract), the scan saw the
+    # canonical coverage modules, and the Kalman registry is still the
+    # five-engine set (or larger)
     engines, _ = kalman_engines_static(CFG)
-    from yieldfactormodels_jl_tpu.config import KALMAN_ENGINES, NEWTON_ENGINES
-    assert tuple(engines) == tuple(KALMAN_ENGINES) + tuple(NEWTON_ENGINES)
-    assert len(KALMAN_ENGINES) >= 4
+    from yieldfactormodels_jl_tpu.config import (KALMAN_ENGINES,
+                                                 NEWTON_ENGINES, SLR_ENGINES)
+    assert tuple(engines) == tuple(KALMAN_ENGINES) + tuple(SLR_ENGINES) \
+        + tuple(NEWTON_ENGINES)
+    assert len(KALMAN_ENGINES) >= 5
+    assert len(SLR_ENGINES) >= 1
     assert len(NEWTON_ENGINES) >= 2
     strings = oracle_backed_test_strings(CFG)
     assert "test_assoc_estimation.py" in strings, \
         "engine-coverage guard rotted: canonical parity module not scanned"
     assert "test_newton.py" in strings, \
         "engine-coverage guard rotted: second-order parity module not scanned"
+    assert "test_slr_scan.py" in strings, \
+        "engine-coverage guard rotted: SLR parity module not scanned"

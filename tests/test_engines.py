@@ -41,14 +41,19 @@ def test_all_engines_agree(dns_case):
 def test_all_engines_agree_other_families(code, rng):
     """Engine agreement beyond DNS3: the AFNS intercept and the TVλ EKF's
     state-dependent rows must produce the same loglik through every engine
-    ('assoc' falls back to univariate for TVλ by design — api.get_loss)."""
+    the family supports — config.engines_for(spec), the introspection seam
+    api.get_loss itself dispatches on (TVλ lists 'slr' instead of 'assoc';
+    at T=50 the panel fits one SLR chunk, so the iterated engine is the
+    sequential EKF to float rounding)."""
     from tests.oracle import generic_stable_params
 
     spec, _ = yfm.create_model(code, MATS, float_type="float64")
     p = jnp.asarray(generic_stable_params(spec, rng))
     data = jnp.asarray(0.4 * rng.standard_normal((len(MATS), 50)) + 4.0)
+    engines = yfm.engines_for(spec)
+    assert len(engines) >= 4
     vals = {e: float(api.get_loss(spec, p, data, 1, 48, engine=e))
-            for e in yfm.KALMAN_ENGINES}
+            for e in engines}
     base = vals["univariate"]
     assert np.isfinite(base), f"{code}: non-finite base loglik"
     for e, v in vals.items():
@@ -104,7 +109,11 @@ def test_sqrt_engine_neg_inf_on_invalid_factorization(dns_case, rng):
     assert v == -np.inf
 
 
-def test_assoc_falls_back_for_tvl(rng):
+def test_engines_for_validation_tvl(rng):
+    """The blunt family gating is gone: an EXPLICIT engine the family does
+    not support raises naming config.engines_for(spec); a process-wide
+    default that does not apply silently falls back to the sequential
+    default (a call that chose nothing must not error)."""
     spec, _ = yfm.create_model("TVλ", MATS, float_type="float64")
     p = np.zeros(spec.n_params)
     p[0] = 4e-4
@@ -116,6 +125,14 @@ def test_assoc_falls_back_for_tvl(rng):
     p[11:15] = [0.1, -0.05, 0.02, np.log(0.45)]
     p[15:31] = (0.9 * np.eye(4)).reshape(-1)
     data = 0.4 * rng.standard_normal((len(MATS), 30)) + 4.0
-    a = float(api.get_loss(spec, jnp.asarray(p), jnp.asarray(data), engine="assoc"))
-    u = float(api.get_loss(spec, jnp.asarray(p), jnp.asarray(data), engine="univariate"))
-    np.testing.assert_allclose(a, u, rtol=1e-12)
+    with pytest.raises(ValueError, match="engines_for") as ei:
+        api.get_loss(spec, jnp.asarray(p), jnp.asarray(data), engine="assoc")
+    assert "'slr'" in str(ei.value)          # the message lists the valid set
+    u = float(api.get_loss(spec, jnp.asarray(p), jnp.asarray(data),
+                           engine="univariate"))
+    try:
+        yfm.set_kalman_engine("assoc")
+        v = float(api.get_loss(spec, jnp.asarray(p), jnp.asarray(data)))
+    finally:
+        yfm.set_kalman_engine("univariate")
+    np.testing.assert_allclose(v, u, rtol=1e-12)

@@ -463,6 +463,39 @@ def test_yfm007_quiet_when_newton_engines_oracle_covered(tmp_path):
     assert not res.findings
 
 
+def _slr_engine_tree(tmp_path, tests_body):
+    cfgpath = tmp_path / PKG / "config.py"
+    cfgpath.parent.mkdir(parents=True, exist_ok=True)
+    cfgpath.write_text('KALMAN_ENGINES = ("univariate",)\n'
+                       'SLR_ENGINES = ("ekf", "sigma")\n')
+    tdir = tmp_path / "tests"
+    tdir.mkdir(exist_ok=True)
+    (tdir / "test_parity.py").write_text(textwrap.dedent(tests_body))
+    (tmp_path / "CLAUDE.md").write_text("")
+    return LintConfig(root=str(tmp_path))
+
+
+def test_yfm007_fires_on_uncovered_slr_linearization(tmp_path):
+    # the SLR linearization-rule registry rides the same parity contract:
+    # an SLR_ENGINES entry with no oracle-backed mention must fire
+    cfg = _slr_engine_tree(tmp_path, """\
+        from .oracle import iterated_slr_filter
+        ENGINES = ("univariate", "ekf")  # 'sigma' uncovered
+    """)
+    res = run_lint(cfg, files=[], rules=["YFM007"])
+    assert [f.rule for f in res.findings] == ["YFM007"]
+    assert "'sigma'" in res.findings[0].message
+
+
+def test_yfm007_quiet_when_slr_linearizations_oracle_covered(tmp_path):
+    cfg = _slr_engine_tree(tmp_path, """\
+        from .oracle import iterated_slr_filter
+        ENGINES = ("univariate", "ekf", "sigma")
+    """)
+    res = run_lint(cfg, files=[], rules=["YFM007"])
+    assert not res.findings
+
+
 # ---------------------------------------------------------------------------
 # YFM008 — request-path hygiene
 # ---------------------------------------------------------------------------

@@ -33,6 +33,9 @@ def test_units_and_tiny_configs_run():
     w, d = naive_ref.naive_scenario_fan(R=2, G=2, D=1, Pn=8, S=2, h=2,
                                         n_paths=2)
     assert w > 0 and "fan" in d
+    # the BENCH_LONGT TVλ dual-ratio denominator (iterated-SLR naive loop)
+    w, d = naive_ref.unit_slr_pass(T=200, sweeps=2, chunk=64)
+    assert w > 0 and "sweeps" in d
 
 
 def test_naive_pf_collapses_to_kalman_loglik():

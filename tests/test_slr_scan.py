@@ -1,0 +1,500 @@
+"""Iterated-SLR engine (ops/slr_scan.py, docs/DESIGN.md §19) acceptance.
+
+Oracle-backed parity of the ``"slr"`` engine and its ``"ekf"`` linearization
+rule against the independent NumPy loops (tests/oracle.iterated_slr_filter —
+sequential affine pass A + chunked exact-EKF refinement, a DIFFERENT
+algebraic route than the engine's Woodbury elements + combine tree), the
+fixed-point contract against the sequential EKF (oracle.ekf_tvl_loglik /
+oracle.kalman_filter_loglik), NaN-panel semantics, K-sweep convergence
+monotonicity, grad parity, trace counters, the introspection seam
+(config.engines_for / tree_engine_for) with the api dispatch built on it,
+the ladder's slr rescue rung, the time-sharded objective for TVλ, the
+serving ``refilter()`` on a TVλ snapshot, and the tree-composed Newton
+tangents pinned against oracle.fd_hessian.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import yieldfactormodels_jl_tpu as yfm
+from tests import oracle
+from yieldfactormodels_jl_tpu import config
+from yieldfactormodels_jl_tpu.models import api
+from yieldfactormodels_jl_tpu.models.params import untransform_params
+from yieldfactormodels_jl_tpu.ops import slr_scan, univariate_kf
+from yieldfactormodels_jl_tpu.robustness import ladder, taxonomy as tax
+
+MATS = tuple(np.array([3, 12, 24, 60, 120, 240, 360]) / 12.0)
+
+
+def _tvl_case(rng, T=160, seed_panel=True):
+    spec, _ = yfm.create_model("TVλ", MATS, float_type="float64")
+    p = oracle.stable_tvl_params(spec)
+    if seed_panel:
+        data = oracle.simulate_dns_panel(rng, np.asarray(MATS), T=T, lam=0.5)
+    else:
+        data = 0.4 * rng.standard_normal((len(MATS), T)) + 4.0
+    return spec, p, np.asarray(data, dtype=np.float64)
+
+
+def _tvl_pieces(spec, p):
+    Ms = spec.state_dim
+    C = np.zeros((Ms, Ms))
+    rows, cols = spec.chol_indices
+    a, _ = spec.layout["chol"]
+    for k, (r, c) in enumerate(zip(rows, cols)):
+        C[r, c] = p[a + k]
+    lo, hi = spec.layout["delta"]
+    delta = np.asarray(p[lo:hi], dtype=np.float64)
+    lo, hi = spec.layout["phi"]
+    Phi = np.asarray(p[lo:hi], dtype=np.float64).reshape(Ms, Ms)
+    return Phi, delta, C @ C.T, float(p[spec.layout["obs_var"][0]])
+
+
+# ---------------------------------------------------------------------------
+# the introspection seam (config.engines_for) and registries
+# ---------------------------------------------------------------------------
+
+def test_engine_registries_and_applicability():
+    """"slr" is a first-class KALMAN_ENGINES entry, "ekf" its registered
+    linearization rule, and engines_for/tree_engine_for agree with the
+    family structure (the seam every dispatch site consults)."""
+    assert "slr" in config.KALMAN_ENGINES
+    assert config.SLR_ENGINES == ("ekf",)
+    dns, _ = yfm.create_model("1C", MATS, float_type="float64")
+    tvl, _ = yfm.create_model("TVλ", MATS, float_type="float64")
+    ns, _ = yfm.create_model("NS", MATS, float_type="float64")
+    assert config.engines_for(dns) == config.KALMAN_ENGINES
+    assert config.engines_for(tvl) == tuple(
+        e for e in config.KALMAN_ENGINES if e != "assoc")
+    assert config.engines_for(ns) == ()
+    assert config.tree_engine_for(dns) == "assoc"
+    assert config.tree_engine_for(tvl) == "slr"
+    assert config.tree_engine_for(ns) is None
+
+
+def test_api_dispatch_validation_consults_engines_for(rng):
+    """Explicit engine= outside engines_for(spec) raises naming the valid
+    set; a process-wide default that does not apply falls back to the
+    sequential default (never an error on a call that chose nothing)."""
+    spec, p, data = _tvl_case(rng, T=60)
+    dj, pj = jnp.asarray(data), jnp.asarray(p)
+    with pytest.raises(ValueError, match="engines_for"):
+        api.get_loss(spec, pj, dj, engine="assoc")
+    u = float(api.get_loss(spec, pj, dj, engine="univariate"))
+    try:
+        yfm.set_kalman_engine("assoc")   # valid globally, not for TVλ
+        v = float(api.get_loss(spec, pj, dj))
+    finally:
+        yfm.set_kalman_engine("univariate")
+    np.testing.assert_allclose(v, u, rtol=1e-12)
+
+
+def test_t_switch_upgrades_tvl_to_slr(rng, monkeypatch):
+    spec, p, data = _tvl_case(rng, T=100)
+    dj, pj = jnp.asarray(data), jnp.asarray(p)
+    calls = []
+    real = slr_scan.get_loss
+    monkeypatch.setattr(slr_scan, "get_loss",
+                        lambda *a, **k: calls.append(1) or real(*a, **k))
+    try:
+        config.set_loglik_t_switch(64)
+        api.get_loss(spec, pj, dj)                 # T=100 >= 64 → slr
+        assert len(calls) == 1
+        api.get_loss(spec, pj, dj[:, :50])         # short → sequential
+        assert len(calls) == 1
+        api.get_loss(spec, pj, dj, engine="univariate")  # explicit wins
+        assert len(calls) == 1
+    finally:
+        config.set_loglik_t_switch(0)
+
+
+# ---------------------------------------------------------------------------
+# oracle parity — the iterated semantics AND the EKF fixed point
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("sweeps", [1, 2, 3])
+def test_slr_oracle_parity_iterated_semantics(sweeps, rng):
+    """Engine vs tests/oracle.iterated_slr_filter at MATCHING (sweeps,
+    chunk) — pins the iterated two-scale semantics themselves (tree-composed
+    pass A + chunked exact refinement), not just the fixed point, at an
+    adversarially small chunk where intermediate sweeps still differ from
+    the EKF."""
+    spec, p, data = _tvl_case(rng, T=200)
+    data[:, 90:95] = np.nan
+    Phi, delta, Om, ov = _tvl_pieces(spec, p)
+    *_, want = oracle.iterated_slr_filter(Phi, delta, Om, ov,
+                                          np.asarray(MATS), data,
+                                          sweeps=sweeps, chunk=32)
+    got = float(slr_scan.get_loss(spec, jnp.asarray(p), jnp.asarray(data),
+                                  sweeps=sweeps, chunk=32,
+                                  linearization="ekf"))
+    np.testing.assert_allclose(got, want, rtol=1e-9)
+
+
+def test_slr_oracle_parity_filtered_moments(rng):
+    """The filtered trajectories (the serving re-filter surface) against the
+    oracle's, element-wise."""
+    spec, p, data = _tvl_case(rng, T=150)
+    Phi, delta, Om, ov = _tvl_pieces(spec, p)
+    betas, Ps, _, _ = oracle.iterated_slr_filter(Phi, delta, Om, ov,
+                                                 np.asarray(MATS), data,
+                                                 sweeps=2, chunk=32)
+    m, P = slr_scan.filter_means_covs(spec, jnp.asarray(p),
+                                      jnp.asarray(data), sweeps=2, chunk=32)
+    np.testing.assert_allclose(np.asarray(m), betas, atol=1e-9)
+    np.testing.assert_allclose(np.asarray(P), Ps, atol=1e-9)
+
+
+def test_slr_matches_sequential_ekf_fixed_point(rng):
+    """The engine at its DEFAULTS against the sequential EKF oracle
+    (oracle.ekf_tvl_loglik): exact to float rounding for T <= chunk (one
+    chunk covers the panel), and at parity tolerance on a multi-chunk panel
+    — with one extra sweep tightening it by orders of magnitude (the ρ^L
+    contraction)."""
+    spec, p, data = _tvl_case(rng, T=120)
+    Phi, delta, Om, ov = _tvl_pieces(spec, p)
+    want = oracle.ekf_tvl_loglik(Phi, delta, Om, ov, np.asarray(MATS), data)
+    got = float(slr_scan.get_loss(spec, jnp.asarray(p), jnp.asarray(data)))
+    np.testing.assert_allclose(got, want, rtol=1e-10)
+
+    spec, p, data = _tvl_case(rng, T=1100)
+    Phi, delta, Om, ov = _tvl_pieces(spec, p)
+    want = oracle.ekf_tvl_loglik(Phi, delta, Om, ov, np.asarray(MATS), data)
+    got2 = float(slr_scan.get_loss(spec, jnp.asarray(p), jnp.asarray(data)))
+    np.testing.assert_allclose(got2, want, rtol=1e-6)
+    got3 = float(slr_scan.get_loss(spec, jnp.asarray(p), jnp.asarray(data),
+                                   sweeps=3))
+    assert abs(got3 - want) < abs(got2 - want) or got2 == want
+    np.testing.assert_allclose(got3, want, rtol=1e-9)
+
+
+def test_slr_constant_z_collapses_to_exact_filter(rng):
+    """Constant-measurement families collapse to one sweep whose refinement
+    IS the exact filter: parity against the NumPy KF oracle and the
+    sequential engine at float rounding, any K."""
+    spec, _ = yfm.create_model("1C", MATS, float_type="float64")
+    p = oracle.stable_1c_params(spec, np.float64)
+    data = oracle.simulate_dns_panel(rng, np.asarray(MATS), T=300)
+    Z = oracle.dns_loadings(float(p[spec.layout["gamma"][0]]),
+                            np.asarray(MATS))
+    Phi, delta, Om, ov = _tvl_pieces(spec, p)
+    want = oracle.kalman_filter_loglik(Z, Phi, delta, Om, ov, data)
+    got = float(api.get_loss(spec, jnp.asarray(p), jnp.asarray(data),
+                             engine="slr"))
+    np.testing.assert_allclose(got, want, rtol=1e-9)
+    seq = float(univariate_kf.get_loss(spec, jnp.asarray(p),
+                                       jnp.asarray(data)))
+    k5 = float(slr_scan.get_loss(spec, jnp.asarray(p), jnp.asarray(data),
+                                 sweeps=5))
+    np.testing.assert_allclose(got, seq, rtol=1e-12)
+    np.testing.assert_allclose(k5, got, rtol=1e-12)
+
+
+def test_slr_sweep_convergence_monotone(rng):
+    """The K-sweep gap to the sequential EKF shrinks monotonically at an
+    adversarially small chunk (each sweep contracts boundary errors by the
+    chunk's forgetting factor)."""
+    spec, p, data = _tvl_case(rng, T=160)
+    Phi, delta, Om, ov = _tvl_pieces(spec, p)
+    want = oracle.ekf_tvl_loglik(Phi, delta, Om, ov, np.asarray(MATS), data)
+    gaps = [abs(float(slr_scan.get_loss(spec, jnp.asarray(p),
+                                        jnp.asarray(data), sweeps=k,
+                                        chunk=16)) - want)
+            for k in (1, 2, 3, 4)]
+    assert all(g1 > g2 for g1, g2 in zip(gaps, gaps[1:])), gaps
+    # the contraction factor is panel-dependent (ρ^16 here); monotone
+    # decrease plus an order of magnitude over three extra sweeps is the
+    # stable property
+    assert gaps[-1] < 0.1 * gaps[0]
+
+
+def test_slr_nan_panels(rng):
+    """Whole/partial-NaN panels: a partially-quoted column is a pure
+    prediction step (identical to dropping the whole column — the offline
+    convention every engine shares); an all-NaN panel carries the
+    MISSING_ALL_OBS code."""
+    spec, p, data = _tvl_case(rng, T=120)
+    dj, pj = jnp.asarray(data), jnp.asarray(p)
+    partial = data.copy()
+    partial[0, 60] = np.nan             # one element missing
+    whole = data.copy()
+    whole[:, 60] = np.nan               # whole column missing
+    a = float(slr_scan.get_loss(spec, pj, jnp.asarray(partial), chunk=32))
+    b = float(slr_scan.get_loss(spec, pj, jnp.asarray(whole), chunk=32))
+    np.testing.assert_allclose(a, b, rtol=1e-12)
+    # sequential parity at the single-chunk configuration (exact; the
+    # multi-chunk K-gap tolerances live in the fixed-point tests above)
+    seq = float(univariate_kf.get_loss(spec, pj, jnp.asarray(whole)))
+    one = float(slr_scan.get_loss(spec, pj, jnp.asarray(whole)))
+    np.testing.assert_allclose(one, seq, rtol=1e-10)
+    all_nan = jnp.full((len(MATS), 50), jnp.nan, dtype=jnp.float64)
+    ll, code = slr_scan.get_loss_coded(spec, pj, all_nan)
+    assert float(ll) == 0.0
+    assert "MISSING_ALL_OBS" in tax.decode(int(code))
+
+
+def test_slr_taxonomy_codes(rng):
+    """Non-finite slr losses carry decoded causes like every other engine
+    (robustness/taxonomy.py channel)."""
+    spec, p, data = _tvl_case(rng, T=80)
+    dj = jnp.asarray(data)
+    ll, code = slr_scan.get_loss_coded(spec, jnp.asarray(p), dj)
+    assert np.isfinite(float(ll)) and int(code) == tax.OK
+    bad = p.copy()
+    bad[spec.layout["obs_var"][0]] = -10.0
+    ll, code = slr_scan.get_loss_coded(spec, jnp.asarray(bad), dj)
+    assert float(ll) == -np.inf and tax.decode(code)
+    nanp = p.copy()
+    nanp[0] = np.nan
+    _, code = slr_scan.get_loss_coded(spec, jnp.asarray(nanp), dj)
+    assert "TRANSFORM_OVERFLOW" in tax.decode(code)
+    _, code = slr_scan.get_loss_coded(spec, jnp.asarray(p), dj, 5, 6)
+    assert "MISSING_ALL_OBS" in tax.decode(code)
+
+
+def test_slr_psd_floor_noop_at_stable_point(rng):
+    """psd_floor (the stabilized recovery surface) is a no-op at a healthy
+    point — projection only clips what was already indefinite."""
+    spec, p, data = _tvl_case(rng, T=90)
+    a = float(slr_scan.get_loss(spec, jnp.asarray(p), jnp.asarray(data)))
+    s = float(slr_scan.get_loss(spec, jnp.asarray(p), jnp.asarray(data),
+                                psd_floor=ladder.SQRT_RESCUE_FLOOR))
+    np.testing.assert_allclose(s, a, rtol=1e-9)
+
+
+def test_slr_validation_errors(rng):
+    spec, p, data = _tvl_case(rng, T=40)
+    with pytest.raises(ValueError, match="linearization"):
+        slr_scan.get_loss(spec, jnp.asarray(p), jnp.asarray(data),
+                          linearization="sigma-point")
+    with pytest.raises(ValueError, match="sweeps"):
+        slr_scan.get_loss(spec, jnp.asarray(p), jnp.asarray(data), sweeps=0)
+    with pytest.raises(ValueError, match="prefix"):
+        slr_scan.get_loss(spec, jnp.asarray(p), jnp.asarray(data),
+                          prefix="zigzag")
+    ns, _ = yfm.create_model("NS", MATS, float_type="float64")
+    with pytest.raises(ValueError, match="Kalman family"):
+        slr_scan.get_loss(ns, jnp.zeros(ns.n_params), jnp.asarray(data))
+
+
+# ---------------------------------------------------------------------------
+# grad parity + trace counters
+# ---------------------------------------------------------------------------
+
+def test_slr_grad_parity_vs_sequential_ekf(rng):
+    """Differentiable end-to-end: the K=2 gradient (with the tree's entry
+    states stop-gradient-ed — the ρ^L-damped adjoint cut) against the
+    sequential EKF's, and K=3 tightening it by orders of magnitude."""
+    spec, p, data = _tvl_case(rng, T=500)
+    dj, pj = jnp.asarray(data), jnp.asarray(p)
+    g_seq = np.asarray(jax.grad(
+        lambda q: univariate_kf.get_loss(spec, q, dj))(pj))
+    g2 = np.asarray(jax.grad(lambda q: slr_scan.get_loss(spec, q, dj))(pj))
+    g3 = np.asarray(jax.grad(
+        lambda q: slr_scan.get_loss(spec, q, dj, sweeps=3))(pj))
+    assert np.isfinite(g2).all()
+    n = np.linalg.norm(g_seq)
+    assert np.linalg.norm(g2 - g_seq) / n < 5e-6
+    assert np.linalg.norm(g3 - g_seq) / n < 1e-9
+
+
+def test_slr_no_recompile_trace_counter(rng):
+    """Same-shape repeat calls reuse ONE traced program; a different static
+    configuration (sweeps) traces its own."""
+    spec, p, data = _tvl_case(rng, T=96)
+    dj, pj = jnp.asarray(data), jnp.asarray(p)
+    fn = jax.jit(lambda q, d: slr_scan.get_loss(spec, q, d))
+    slr_scan.reset_trace_counts()
+    fn(pj, dj).block_until_ready()
+    fn(pj * 1.001, dj).block_until_ready()
+    fn(pj * 0.999, dj).block_until_ready()
+    assert slr_scan.trace_counts["slr_filter"] == 1
+    fn3 = jax.jit(lambda q, d: slr_scan.get_loss(spec, q, d, sweeps=3))
+    fn3(pj, dj).block_until_ready()
+    assert slr_scan.trace_counts["slr_filter"] == 2
+
+
+# ---------------------------------------------------------------------------
+# ladder: slr as the nonlinear long-panel rescue rung
+# ---------------------------------------------------------------------------
+
+def _dead_tvl_start(spec, p):
+    bad = np.asarray(p, dtype=np.float64).copy()
+    a, b = spec.layout["phi"]
+    Ms = spec.state_dim
+    Phi = 0.9 * np.eye(Ms)
+    Phi[0, 1] = Phi[1, 0] = Phi[0, 2] = Phi[2, 0] = 0.8
+    Phi[1, 2] = Phi[2, 1] = 0.8
+    bad[a:b] = Phi.reshape(-1)
+    return bad
+
+
+@pytest.mark.slow
+def test_ladder_slr_rung_rescues_long_tvl_panel(rng):
+    """A dead TVλ start on a long panel (T >= ASSOC_RESCUE_MIN_T) is
+    recovered by the slr rung — the nonlinear twin of the assoc rung — and
+    the trace says so."""
+    spec, p, data = _tvl_case(rng, T=ladder.ASSOC_RESCUE_MIN_T + 40)
+    raw_bad = np.asarray(untransform_params(
+        spec, jnp.asarray(_dead_tvl_start(spec, p))))
+    tr = ladder.escalate(spec, data, raw_bad)
+    assert [r.rung for r in tr.rungs] == ["scan", "slr"]
+    assert tr.recovered and tr.rung == "slr" and tr.engine == "slr"
+    assert np.isfinite(tr.ll)
+
+
+def test_ladder_slr_rung_skipped_on_short_panels(rng):
+    spec, p, data = _tvl_case(rng, T=60)
+    raw_bad = np.asarray(untransform_params(
+        spec, jnp.asarray(_dead_tvl_start(spec, p))))
+    tr = ladder.escalate(spec, data, raw_bad)
+    assert "slr" not in [r.rung for r in tr.rungs]
+    assert tr.recovered and tr.rung == "sqrt"
+
+
+# ---------------------------------------------------------------------------
+# estimation: time-sharded objective for the nonlinear family
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_estimate_time_sharded_objective_tvl(rng):
+    """estimate(objective="time_sharded") now covers TVλ: the iterated-SLR
+    loss over the sharded time axis (refinement chunk = shard length — the
+    aligned layout verified bit-identical to the unsharded engine) drives
+    the same multi-start artifact as the vmap objective."""
+    from yieldfactormodels_jl_tpu.estimation import optimize
+
+    jax.clear_caches()   # this module is program-heavy; see conftest note
+    spec, p, data = _tvl_case(rng, T=250)   # 250 % 8 != 0: ragged T works
+    starts = np.stack([p, p * 0.995], axis=1)
+    base = optimize.estimate(spec, data, starts, max_iters=15,
+                             objective="vmap")
+    ts = optimize.estimate(spec, data, starts, max_iters=15,
+                           objective="time_sharded")
+    assert np.isfinite(ts[1])
+    # the time-sharded objective is the K=2 chunk-(T/8) surrogate, so the
+    # two 15-iteration trajectories walk slightly different surfaces —
+    # same basin, loose ll agreement (the bit-level sharded-vs-unsharded
+    # parity is pinned separately below)
+    np.testing.assert_allclose(ts[1], base[1], rtol=2e-2)
+
+
+def test_time_sharded_loss_tvl_matches_unsharded_engine(rng):
+    """The sharded program equals the UNSHARDED slr engine at the same
+    (chunk, sweeps) bit-tight — sharding must not change the math (the
+    misaligned-chunk layout MISCOMPILED under SPMD; this pins the aligned
+    one)."""
+    from yieldfactormodels_jl_tpu.parallel.mesh import make_mesh
+    from yieldfactormodels_jl_tpu.parallel.time_parallel import (
+        _pad_time, get_loss_time_sharded)
+
+    spec, p, data = _tvl_case(rng, T=250)
+    mesh = make_mesh(axis_name="time")
+    n_dev = int(mesh.devices.size)
+    par = float(get_loss_time_sharded(spec, p, data, mesh=mesh))
+    padded = np.asarray(_pad_time(jnp.asarray(data), n_dev))
+    chunk = padded.shape[1] // n_dev
+    want = float(slr_scan.get_loss(spec, jnp.asarray(p), jnp.asarray(padded),
+                                   0, data.shape[1], prefix="interleaved",
+                                   chunk=chunk))
+    np.testing.assert_allclose(par, want, rtol=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# serving: refilter() for TVλ snapshots
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_refilter_tvl_agrees_with_accumulated_updates(rng):
+    """A TVλ service fed fully-quoted curves, then one SLR refilter: the
+    rebuilt state matches the accumulated recursive EKF state at engine
+    tolerance (the SLR fixed point IS the sequential EKF), version bumped,
+    cadence reset."""
+    from yieldfactormodels_jl_tpu.serving import (YieldCurveService,
+                                                  freeze_snapshot)
+
+    jax.clear_caches()   # this module is program-heavy; see conftest note
+    spec, p, _ = _tvl_case(rng, T=8)
+    T_cond, n_upd = 64, 240
+    panel = oracle.simulate_dns_panel(rng, np.asarray(MATS),
+                                      T=T_cond + n_upd, lam=0.5)
+    svc = YieldCurveService(freeze_snapshot(spec, p, panel[:, :T_cond]))
+    for t in range(T_cond, T_cond + n_upd):
+        svc.update(t, panel[:, t])
+    beta_acc = np.asarray(svc.snapshot.beta).copy()
+    P_acc = np.asarray(svc.snapshot.P).copy()
+    ll = svc.refilter(panel, date="rebuild")
+    assert np.isfinite(ll)
+    assert svc.version == n_upd + 1 and not svc.stale
+    np.testing.assert_allclose(np.asarray(svc.snapshot.beta), beta_acc,
+                               atol=1e-7)
+    np.testing.assert_allclose(np.asarray(svc.snapshot.P), P_acc, atol=1e-7)
+    assert svc._updates_since_refresh == 0
+
+
+# ---------------------------------------------------------------------------
+# Newton tangents on the tree (ops/newton.py × YFM_LOGLIK_T_SWITCH)
+# ---------------------------------------------------------------------------
+
+def test_newton_innovations_tree_matches_sequential(rng):
+    """The assoc-assembled innovations provider equals the sequential one
+    (values AND the Fisher quantities built from it) — the tree is an
+    engine change, not a math change."""
+    from yieldfactormodels_jl_tpu.ops import newton
+
+    spec, _ = yfm.create_model("1C", MATS, float_type="float64")
+    p = oracle.stable_1c_params(spec, np.float64)
+    data = oracle.simulate_dns_panel(rng, np.asarray(MATS), T=160)
+    data[:, 70:74] = np.nan
+    dj = jnp.asarray(data)
+    raw = jnp.asarray(untransform_params(spec, jnp.asarray(p)))
+    u = jnp.ones_like(raw) / np.sqrt(raw.shape[0])
+    H_seq = np.asarray(newton.fisher_matrix(spec, raw, dj, 0, 160))
+    h_seq = np.asarray(newton.fisher_hvp(spec, raw, u, dj, 0, 160))
+    try:
+        config.set_loglik_t_switch(1)       # every panel rides the tree
+        H_tree = np.asarray(newton.fisher_matrix(spec, raw, dj, 0, 160))
+        h_tree = np.asarray(newton.fisher_hvp(spec, raw, u, dj, 0, 160))
+    finally:
+        config.set_loglik_t_switch(0)
+    np.testing.assert_allclose(H_tree, H_seq, rtol=1e-9, atol=1e-9)
+    np.testing.assert_allclose(h_tree, h_seq, rtol=1e-9, atol=1e-9)
+
+
+@pytest.mark.slow
+def test_newton_tree_hvp_pinned_against_fd_oracle(rng):
+    """The tree-composed exact HVP (api.get_loss dispatches the nll to the
+    assoc engine under the T-switch) against the central-difference NumPy
+    Hessian oracle — the same pin test_newton.py applies to the sequential
+    recursion.  Both probes are jitted ONCE (one program each, hundreds of
+    fast calls) — this module compiles many engine variants and XLA:CPU
+    segfaults past ~200 accumulated programs (see conftest)."""
+    from yieldfactormodels_jl_tpu.ops import newton
+
+    jax.clear_caches()
+    spec, _ = yfm.create_model("1C", MATS, float_type="float64")
+    p = oracle.stable_1c_params(spec, np.float64)
+    data = oracle.simulate_dns_panel(rng, np.asarray(MATS), T=120)
+    dj = jnp.asarray(data)
+    raw = np.asarray(untransform_params(spec, jnp.asarray(p)),
+                     dtype=np.float64)
+    nll_jit = jax.jit(lambda x: newton._clamped_nll(spec, x, dj, 0, 120))
+
+    def nll_np(x):
+        return float(nll_jit(jnp.asarray(x)))
+
+    H_fd = oracle.fd_hessian(nll_np, raw, eps=1e-4)
+    try:
+        config.set_loglik_t_switch(1)
+        hvp_jit = jax.jit(lambda u: newton.exact_hvp(
+            spec, jnp.asarray(raw), u, dj, 0, 120))
+        cols = [np.asarray(hvp_jit(jnp.asarray(e)))
+                for e in np.eye(raw.shape[0])]
+    finally:
+        config.set_loglik_t_switch(0)
+    H_tree = np.stack(cols, axis=1)
+    scale = np.abs(H_fd).max()
+    np.testing.assert_allclose(H_tree, H_fd, atol=5e-3 * scale)

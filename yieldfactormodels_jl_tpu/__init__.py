@@ -31,7 +31,8 @@ any model/estimation name imports its home module, which imports jax.
 #: public name -> home module (relative); resolved on first attribute access
 _LAZY = {name: ".config" for name in (
     "default_dtype", "set_default_dtype", "kalman_engine",
-    "set_kalman_engine", "KALMAN_ENGINES")}
+    "set_kalman_engine", "KALMAN_ENGINES", "SLR_ENGINES", "engines_for",
+    "tree_engine_for")}
 _LAZY["ModelSpec"] = ".models.specs"
 _LAZY.update({name: ".models.registry" for name in
               ("create_model", "MODEL_CODES")})

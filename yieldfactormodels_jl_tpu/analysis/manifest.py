@@ -470,6 +470,16 @@ def _m_refilter():
     return _jitted_refilter(sp, T), [(f64(sp.n_params), f64(N, T))]
 
 
+@case("serving.online._jitted_refilter", label="tvl-slr")
+def _m_refilter_tvl():
+    # the nonlinear-family dispatch: TVλ snapshots rebuild on the
+    # iterated-SLR engine (ops/slr_scan, docs/DESIGN.md §19)
+    from ..serving.online import _jitted_refilter
+
+    sp = spec("kalman_tvl")
+    return _jitted_refilter(sp, T), [(f64(sp.n_params), f64(N, T))]
+
+
 @case("serving.online._jitted_scenarios")
 def _m_scenarios():
     from ..serving.online import _jitted_scenarios
@@ -511,6 +521,17 @@ def _m_assoc_rescue():
     P = npar()
     return _jitted_assoc_rescue(spec()), [(f64(P), f64(N, T),
                                            i64(), i64())]
+
+
+@case("robustness.ladder._jitted_slr_rescue")
+def _m_slr_rescue():
+    # the assoc rung's nonlinear twin (TVλ — iterated-SLR engine with
+    # PSD-projected moments, docs/DESIGN.md §19)
+    from ..robustness.ladder import _jitted_slr_rescue
+
+    sp = spec("kalman_tvl")
+    return _jitted_slr_rescue(sp), [(f64(sp.n_params), f64(N, T),
+                                     i64(), i64())]
 
 
 @case("robustness.taxonomy._jitted_diagnose")
@@ -560,6 +581,17 @@ def _m_time_sharded_loss():
     P = npar()
     fn = _jitted_time_sharded_loss(spec(), T, mesh2("time"), "time")
     return fn, [(f64(P), f64(N, T), i64(), i64())]
+
+
+@case("parallel.time_parallel._jitted_time_sharded_loss", label="tvl-slr")
+def _m_time_sharded_loss_tvl():
+    # the nonlinear-family dispatch: iterated SLR with the refinement chunk
+    # pinned to the shard length (docs/DESIGN.md §19)
+    from ..parallel.time_parallel import _jitted_time_sharded_loss
+
+    sp = spec("kalman_tvl")
+    fn = _jitted_time_sharded_loss(sp, T, mesh2("time"), "time")
+    return fn, [(f64(sp.n_params), f64(N, T), i64(), i64())]
 
 
 @case("parallel.time_parallel._jitted_time_sharded_multistart")

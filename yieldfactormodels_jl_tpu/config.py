@@ -20,8 +20,21 @@ _DEFAULT_DTYPE = jnp.float32
 #:   "sqrt"        Potter square-root form — PSD-by-construction in f32
 #:   "joint"       textbook joint update with per-step Cholesky
 #:   "assoc"       parallel-in-time associative scan (constant-Z families)
-KALMAN_ENGINES = ("univariate", "sqrt", "joint", "assoc")
+#:   "slr"         iterated square-root SLR: posterior-linearized affine
+#:                 surrogates on the same combine tree — the parallel-in-time
+#:                 engine for the STATE-DEPENDENT measurement families
+#:                 (TVλ EKF; ops/slr_scan.py, docs/DESIGN.md §19)
+KALMAN_ENGINES = ("univariate", "sqrt", "joint", "assoc", "slr")
 _KALMAN_ENGINE = "univariate"
+
+#: SLR linearization rules used by the ``"slr"`` engine (ops/slr_scan.py):
+#:   "ekf"  first-order Taylor (analytic EKF Jacobians) around the previous
+#:          sweep's predicted-mean trajectory — the posterior-linearization
+#:          rule whose fixed point is the sequential EKF
+#: Every entry must have oracle-backed parity coverage — graftlint YFM007,
+#: the same contract as KALMAN_ENGINES/NEWTON_ENGINES.  Sigma-point SLR
+#: (arXiv:2207.00426's general form) drops in here when a family needs it.
+SLR_ENGINES = ("ekf",)
 
 #: second-order (Newton-polish) HVP engines used by ``ops/newton.py`` /
 #: ``estimate(..., second_order=...)``:
@@ -31,6 +44,41 @@ _KALMAN_ENGINE = "univariate"
 #: Every entry must have oracle-backed parity coverage — graftlint YFM007,
 #: the same contract as KALMAN_ENGINES.
 NEWTON_ENGINES = ("fisher", "exact")
+
+
+def engines_for(spec) -> tuple:
+    """The ``KALMAN_ENGINES`` entries valid for one model family — THE
+    engine-applicability introspection seam (docs/DESIGN.md §19).
+
+    ``api.get_loss`` validation, the ``YFM_LOGLIK_T_SWITCH`` long-panel
+    dispatch, ``estimate(objective="time_sharded")`` and the serving
+    ``refilter()`` gate all consult this one function instead of scattering
+    per-family conditionals: the sequential engines cover every Kalman
+    family; the parallel-in-time tree is ``"assoc"`` where the measurement
+    is constant and ``"slr"`` (the iterated posterior-linearization
+    superset) everywhere — non-Kalman families run their own filters and
+    take no engine choice at all.
+    """
+    if not spec.is_kalman:
+        return ()
+    if spec.has_constant_measurement:
+        return KALMAN_ENGINES
+    return tuple(e for e in KALMAN_ENGINES if e != "assoc")
+
+
+def tree_engine_for(spec) -> str | None:
+    """The O(log T) parallel-in-time engine for a family (``"assoc"`` for
+    constant-Z, ``"slr"`` for state-dependent measurements, ``None`` when the
+    family has no tree engine) — what the ``YFM_LOGLIK_T_SWITCH`` policy
+    upgrades long panels to (api.get_loss, the ladder's rescue rung, the
+    time-sharded objective and the serving re-filter all agree through
+    this)."""
+    valid = engines_for(spec)
+    if "assoc" in valid:
+        return "assoc"
+    if "slr" in valid:
+        return "slr"
+    return None
 
 # lru-cached builders of jitted losses register here (at import time) so an
 # engine switch can invalidate every cache that traced api.get_loss — no

@@ -581,11 +581,16 @@ def _resolve_objective(spec: ModelSpec, objective: str) -> str:
     if objective == "fused" and spec.family not in _FUSED_FAMILIES:
         raise ValueError(f"fused objective unavailable for family "
                          f"{spec.family!r}; use objective='vmap'")
-    if objective == "time_sharded" and not spec.has_constant_measurement:
-        raise ValueError(
-            f"time_sharded objective needs a constant-measurement Kalman "
-            f"family (the associative-scan engine, docs/DESIGN.md §13); "
-            f"{spec.family!r} is not one — use objective='vmap'")
+    if objective == "time_sharded":
+        from .. import config
+
+        if config.tree_engine_for(spec) is None:
+            raise ValueError(
+                f"time_sharded objective needs a Kalman family with a "
+                f"parallel-in-time engine (docs/DESIGN.md §13/§19); "
+                f"config.engines_for({spec.family!r}) = "
+                f"{config.engines_for(spec)} has neither 'assoc' nor 'slr' "
+                f"— use objective='vmap'")
     return objective
 
 

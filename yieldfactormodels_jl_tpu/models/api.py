@@ -35,8 +35,12 @@ def get_loss(spec: ModelSpec, params, data, start=0, end=None, K: int = 1,
         # matmuls drop to bf16 MXU passes (≈33× faster AND more precise on
         # TPU; see ops/univariate_kf.py).  Alternatives (config.KALMAN_ENGINES)
         # are trace-time choices: "sqrt" (Potter, PSD-by-construction f32),
-        # "joint" (textbook), "assoc" (parallel-in-time; constant-Z families —
-        # falls back to univariate for TVλ).
+        # "joint" (textbook), "assoc" (parallel-in-time; constant-Z families)
+        # and "slr" (parallel-in-time iterated SLR; every Kalman family incl.
+        # the state-dependent-measurement ones).  WHICH engines apply to a
+        # family is config.engines_for(spec) — the one introspection seam
+        # (docs/DESIGN.md §19), consulted by the validation below, the error
+        # message, and the T-switch dispatch alike.
         from .. import config
         from ..ops import univariate_kf
 
@@ -44,29 +48,45 @@ def get_loss(spec: ModelSpec, params, data, start=0, end=None, K: int = 1,
         if name not in config.KALMAN_ENGINES:
             raise ValueError(
                 f"unknown kalman engine {name!r}; pick from {config.KALMAN_ENGINES}")
+        valid = config.engines_for(spec)
+        if engine is not None and engine not in valid:
+            raise ValueError(
+                f"engine {engine!r} is not applicable to family "
+                f"{spec.family!r}; config.engines_for lists {valid}")
+        if engine is None and name not in valid:
+            # the process-wide default does not apply to this family (e.g.
+            # set_kalman_engine("assoc") then a TVλ loss): fall back to the
+            # family-universal sequential default rather than erroring a
+            # call that never chose an engine itself
+            name = "univariate"
         if (engine is None and name == "univariate"
-                and spec.has_constant_measurement
                 and 0 < config.loglik_t_switch() <= data.shape[1]):
             # engine-dispatch policy (YFM_LOGLIK_T_SWITCH, docs/DESIGN.md
-            # §13): long panels ride the O(log T) associative-scan tree, short
-            # ones keep the sequential default whose constant factor wins.
-            # Only the PRODUCTION DEFAULT is upgraded — an explicit per-call
-            # engine or a deliberate process-wide "sqrt"/"joint" choice is
-            # never overridden.  T is static at trace time, so the dispatch
-            # costs nothing at run time; the jitted-loss caches that bake the
-            # choice in are invalidated by config.set_loglik_t_switch (the
+            # §13/§19): long panels ride the family's O(log T) parallel-in-
+            # time tree — "assoc" for the constant-Z families, "slr" for the
+            # nonlinear ones — short panels keep the sequential default
+            # whose constant factor wins.  Only the PRODUCTION DEFAULT is
+            # upgraded — an explicit per-call engine or a deliberate
+            # process-wide "sqrt"/"joint" choice is never overridden.  T is
+            # static at trace time, so the dispatch costs nothing at run
+            # time; the jitted-loss caches that bake the choice in are
+            # invalidated by config.set_loglik_t_switch (the
             # @register_engine_cache contract).
-            name = "assoc"
+            name = config.tree_engine_for(spec) or name
         if name == "sqrt":
             from ..ops import sqrt_kf
 
             return sqrt_kf.get_loss(spec, params, data, start, end)
         if name == "joint":
             return kalman.get_loss(spec, params, data, start, end)
-        if name == "assoc" and spec.family != "kalman_tvl":
+        if name == "assoc":
             from ..ops import assoc_scan
 
             return assoc_scan.get_loss(spec, params, data, start, end)
+        if name == "slr":
+            from ..ops import slr_scan
+
+            return slr_scan.get_loss(spec, params, data, start, end)
         return univariate_kf.get_loss(spec, params, data, start, end)
     return _engine(spec).get_loss(spec, params, data, start, end, K)
 

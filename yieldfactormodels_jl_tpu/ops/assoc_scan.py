@@ -61,7 +61,22 @@ class FilterElement(NamedTuple):
 
 
 def _mv(M, v):
-    return jnp.einsum("...ij,...j->...i", M, v)
+    """Batched tiny matvec as broadcast-multiply-reduce (see :func:`_bmm`)."""
+    return jnp.sum(M * v[..., None, :], axis=-1)
+
+
+def _bmm(a, b):
+    """Batched tiny-matrix product spelled as broadcast-multiply-reduce.
+
+    XLA:CPU special-cases batched ``dot_general`` at 3×3 into straight-line
+    vector code but dispatches 4×4/5×5 operands to a per-instance kernel
+    call — ~10× the wall of the fused elementwise form at the combine
+    tree's (T,)-batched shapes (measured: a 128-step scan of (157, Ms, Ms)
+    products runs 13.6 ms as ``@`` vs 1.3 ms as mul+sum at Ms = 4, and the
+    whole blocked prefix fell 126 → ~15 ms).  Ms ≤ 5 here, so the
+    (…, M, M, M) broadcast intermediate is trivially small.  Broadcasting
+    matches ``a @ b`` (either operand may be unbatched)."""
+    return jnp.sum(a[..., :, :, None] * b[..., None, :, :], axis=-2)
 
 
 def _solve_unrolled(D, B):
@@ -86,9 +101,12 @@ def _solve_unrolled(D, B):
 
 
 def _combine(ei: FilterElement, ej: FilterElement) -> FilterElement:
-    """Associative composition (element i happens before j)."""
+    """Associative composition (element i happens before j).  All batched
+    tiny-matrix products go through :func:`_bmm`/:func:`_mv` — the combine
+    runs T-batched inside the prefix scan, exactly the shape class where
+    XLA:CPU's batched ``dot_general`` path is ~10× the fused form."""
     I = jnp.eye(ei.A.shape[-1], dtype=ei.A.dtype)
-    D = I + ei.C @ ej.J
+    D = I + _bmm(ei.C, ej.J)
     rhs = jnp.concatenate(
         [ei.A, (ei.b + _mv(ei.C, ej.eta))[..., None], ei.C], axis=-1)
     sol = _solve_unrolled(D, rhs)                 # one elimination, 3 uses
@@ -96,17 +114,17 @@ def _combine(ei: FilterElement, ej: FilterElement) -> FilterElement:
     Dinv_Ai = sol[..., :, :Ms]
     Dinv_bCe = sol[..., :, Ms]
     Dinv_Ci = sol[..., :, Ms + 1:]
-    A = ej.A @ Dinv_Ai
+    A = _bmm(ej.A, Dinv_Ai)
     b = _mv(ej.A, Dinv_bCe) + ej.b
-    C = ej.A @ Dinv_Ci @ ej.A.swapaxes(-1, -2) + ej.C
-    E = I + ej.J @ ei.C
+    C = _bmm(_bmm(ej.A, Dinv_Ci), ej.A.swapaxes(-1, -2)) + ej.C
+    E = I + _bmm(ej.J, ei.C)
     rhs_e = jnp.concatenate(
         [ej.J, (ej.eta - _mv(ej.J, ei.b))[..., None]], axis=-1)
     sol_e = _solve_unrolled(E, rhs_e)
     Einv_Jj = sol_e[..., :, :Ms]
     Ait = ei.A.swapaxes(-1, -2)
     eta = _mv(Ait, sol_e[..., :, Ms]) + ei.eta
-    J = Ait @ Einv_Jj @ ei.A + ei.J
+    J = _bmm(_bmm(Ait, Einv_Jj), ei.A) + ei.J
     return FilterElement(A, b, C, J, eta)
 
 
@@ -227,14 +245,14 @@ def _prefix_scan(elems: FilterElement, T: int):
     # C_i = 0, so D = I and the apply collapses to the local prefix.
     Ci = prefix_in.C[None]                                # (1, C, Ms, Ms)
     bi = prefix_in.b[None]
-    D = jnp.eye(Ms, dtype=Ci.dtype) + Ci @ prefixes.J
+    D = jnp.eye(Ms, dtype=Ci.dtype) + _bmm(Ci, prefixes.J)
     rhs = jnp.concatenate(
         [(bi + _mv(Ci, prefixes.eta))[..., None],
          jnp.broadcast_to(Ci, prefixes.C.shape)], axis=-1)
     sol = _solve_unrolled(D, rhs)
     b_full = _mv(prefixes.A, sol[..., :, 0]) + prefixes.b
-    C_full = prefixes.A @ sol[..., :, 1:] @ prefixes.A.swapaxes(-1, -2) \
-        + prefixes.C
+    C_full = _bmm(_bmm(prefixes.A, sol[..., :, 1:]),
+                  prefixes.A.swapaxes(-1, -2)) + prefixes.C
     # (L, C, ...) → (T, ...)
     b_out = b_full.swapaxes(0, 1).reshape((C * L, Ms))[:T]
     C_out = C_full.swapaxes(0, 1).reshape((C * L, Ms, Ms))[:T]
@@ -299,6 +317,20 @@ def filter_means_covs(spec: ModelSpec, params, data, start=0, end=None,
     return m, covs, (Z, d, kp, state0, obs)
 
 
+def predicted_moments(m, P, kp, m0, P0):
+    """(mpred (T, Ms), Ppred (T, Ms, Ms)): one-step-ahead predicted moments
+    from filtered trajectories — filtered at t−1 shifted through the
+    transition, with the prior (m0, P0) feeding step 0.  Shared by the loss
+    pass below and the Newton tangent provider
+    (ops/newton._innovations_assoc) so the shift convention cannot
+    diverge."""
+    m_prev = jnp.concatenate([m0[None], m[:-1]], axis=0)
+    P_prev = jnp.concatenate([P0[None], P[:-1]], axis=0)
+    mpred = m_prev @ kp.Phi.T + kp.delta[None]
+    Ppred = _bmm(_bmm(kp.Phi, P_prev), kp.Phi.T) + kp.Omega_state[None]
+    return mpred, Ppred
+
+
 def _loss_coded(spec: ModelSpec, params, data, start=0, end=None,
                 psd_floor=None, prefix: str = "blocked"):
     """Shared parallel-filter loss pass.  Returns ``(loss, code, moments)``
@@ -313,13 +345,9 @@ def _loss_coded(spec: ModelSpec, params, data, start=0, end=None,
     if end is None:
         end = T
     N = spec.N
-    # predicted moments at t from filtered at t−1
-    m_prev = jnp.concatenate([state0.beta[None], m[:-1]], axis=0)
     P0 = state0.P if psd_floor is None else _psd_project(
         jnp.where(jnp.isfinite(state0.P), state0.P, 0.0), psd_floor)
-    P_prev = jnp.concatenate([P0[None], P[:-1]], axis=0)
-    mpred = m_prev @ kp.Phi.T + kp.delta[None]
-    Ppred = jnp.einsum("ij,tjk,lk->til", kp.Phi, P_prev, kp.Phi) + kp.Omega_state[None]
+    mpred, Ppred = predicted_moments(m, P, kp, state0.beta, P0)
     ysafe = jnp.where(jnp.isfinite(data.T), data.T, 0.0)
     y_eff = ysafe - d[None]
     # per-step loglik by the univariate (sequential-observation) identity
@@ -333,7 +361,7 @@ def _loss_coded(spec: ModelSpec, params, data, start=0, end=None,
     def obs_body(carry, zi_yi):
         b, Pm, ll, ok, code = carry                  # (T,Ms) (T,Ms,Ms) (T,)…
         z, y_i = zi_yi                               # (Ms,), (T,)
-        zP = jnp.einsum("tij,j->ti", Pm, z)
+        zP = _mv(Pm, z)
         f = zP @ z + kp.obs_var
         f_fin = jnp.isfinite(f)
         ok = ok & (f > 0) & f_fin

@@ -120,15 +120,64 @@ def _clamped_nll(spec: ModelSpec, raw, data, start, end):
 
 
 def _innovations(spec: ModelSpec, raw, data, start, end):
-    """(v (T, N), F (T, N, N)) through the joint-form scan — the per-step
-    innovation and its covariance, the carriers of every curvature term the
-    Fisher approximation keeps.  The joint form is used (not the univariate
-    production default) because F_t is exactly the object being weighted;
-    engine mixing is the tolerance-based regime the repo already documents
-    for the SSD value/grad split (optimize._jitted_group_opt_ssd)."""
+    """(v (T, N), F (T, N, N)) — the per-step innovation and its covariance,
+    the carriers of every curvature term the Fisher approximation keeps.
+
+    Two providers, one contract (docs/DESIGN.md §17/§19):
+
+    - sequential (the default): the joint-form scan — the joint form is
+      used (not the univariate production default) because F_t is exactly
+      the object being weighted; engine mixing is the tolerance-based
+      regime the repo already documents for the SSD value/grad split
+      (optimize._jitted_group_opt_ssd);
+    - parallel-in-time: when the ``YFM_LOGLIK_T_SWITCH`` policy puts the
+      panel on the tree (same gate as ``api.get_loss`` — constant-Z family,
+      T at/above the switch), the innovations are assembled from the
+      assoc-scan filter's composed moments instead.  ``jax.linearize``/
+      ``jvp``/``vjp`` through THIS provider sweep the combine tree, so the
+      Newton polish's tangent recursions run at O(log T) span on long
+      panels — arXiv:2207.00426's parallel-in-time second-order form, with
+      the cascade selection (``YFM_NEWTON``) unchanged.  (The nonlinear
+      families get their tree automatically through ``exact_hvp``, whose
+      ``api.get_loss`` dispatch upgrades TVλ to the iterated-SLR engine
+      under the same policy.)
+    """
+    from .. import config
+
+    if (spec.has_constant_measurement
+            and 0 < config.loglik_t_switch() <= data.shape[1]):
+        return _innovations_assoc(spec, raw, data, start, end)
     cons = transform_params(spec, raw)
     _, _, _, outs = K._scan_filter(spec, cons, data, start, end)
     return outs["v"], outs["F"]
+
+
+def _innovations_assoc(spec: ModelSpec, raw, data, start, end):
+    """(v, F) assembled from the associative-scan tree: the composed
+    filtered moments (ops/assoc_scan.filter_means_covs) are shifted through
+    the transition to predicted moments, and the innovation pair follows in
+    closed form — v_t = y_t − Z m_{t|t−1} − d, F_t = Z P_{t|t−1} Zᵀ + R.
+    Numerically the sequential provider's values (float association order
+    aside — pinned in tests/test_slr_scan.py), but the program is the
+    combine tree, so its linearization is a tree too.  Missing/out-of-window
+    steps carry v = 0; their F is well-formed but excluded by the callers'
+    ``contrib`` masks, exactly like the sequential outs."""
+    cons = transform_params(spec, raw)
+    from .assoc_scan import _bmm, filter_means_covs, predicted_moments
+
+    m, P, (Z, d, kp, state0, obs) = filter_means_covs(spec, cons, data,
+                                                      start, end)
+    # the shift convention is assoc_scan's own (shared helper); the joint
+    # innovation pair follows through _bmm — this provider exists to make
+    # the long-panel tangent sweeps fast, so it must not re-enter the
+    # batched dot_general path the combine tree just escaped
+    mpred, Ppred = predicted_moments(m, P, kp, state0.beta, state0.P)
+    ysafe = jnp.where(jnp.isfinite(data.T), data.T, 0.0)
+    v = (ysafe - mpred @ Z.T - d[None]) * obs.astype(m.dtype)[:, None]
+    N = spec.N
+    F = _bmm(_bmm(Z, Ppred), Z.T) \
+        + kp.obs_var * jnp.eye(N, dtype=m.dtype)[None]
+    return v, F
 
 
 def fisher_hvp(spec: ModelSpec, x, u, data, start, end):

@@ -32,6 +32,46 @@ from ..models.specs import ModelSpec
 from .mesh import make_mesh
 
 
+def _tree_loss_fn(spec: ModelSpec, T: int, n_dev: int):
+    """The family's O(log T) parallel-in-time loss over a TIME-SHARDED
+    panel: ``assoc_scan.get_loss`` for the constant-Z families,
+    ``slr_scan.get_loss`` (the iterated-SLR engine, docs/DESIGN.md §19)
+    for the state-dependent-measurement ones.  One dispatch through
+    ``config.tree_engine_for`` so this module, the ``api.get_loss``
+    T-switch and the ladder's rescue rungs can never disagree on
+    applicability.  Both run the ``"interleaved"`` combine schedule
+    (block-local under SPMD); the SLR engine additionally pins its
+    refinement chunk to the SHARD length T/n_dev, so the (C, L) chunk
+    reshape is exactly the sharding layout and every device refines its own
+    block — a misaligned chunk makes the partitioner rematerialize the
+    scan's slices across shards, which was observed to MISCOMPILE (wrong
+    loss, no error) on the 8-virtual-device mesh; the aligned form is
+    verified bit-identical to the unsharded engine at the same chunk."""
+    from .. import config
+
+    eng = config.tree_engine_for(spec)
+    if eng == "assoc":
+        from ..ops import assoc_scan
+
+        def loss(params, data, start, end):
+            return assoc_scan.get_loss(spec, params, data, start, end,
+                                       prefix="interleaved")
+        return loss
+    if eng == "slr":
+        from ..ops import slr_scan
+
+        chunk = max(1, T // max(n_dev, 1))
+
+        def loss(params, data, start, end):
+            return slr_scan.get_loss(spec, params, data, start, end,
+                                     prefix="interleaved", chunk=chunk)
+        return loss
+    raise ValueError(
+        f"time-sharded likelihood needs a Kalman family with a "
+        f"parallel-in-time engine; config.engines_for({spec.family!r}) "
+        f"lists none of ('assoc', 'slr')")
+
+
 def _pad_time(data, n_dev: int):
     """Pad the TIME axis with NaN columns up to a device-count multiple —
     ``NamedSharding`` placement needs the sharded dimension divisible by the
@@ -50,17 +90,16 @@ def _pad_time(data, n_dev: int):
 @register_engine_cache
 @lru_cache(maxsize=32)
 def _jitted_time_sharded_loss(spec: ModelSpec, T: int, mesh: Mesh, axis: str):
-    from ..ops import assoc_scan
+    # interleaved combine tree: block-local under SPMD (the blocked
+    # prefix's chunk reshape would cross shard boundaries — see
+    # assoc_scan.filter_means_covs); SLR refinement chunk = shard length
+    loss = _tree_loss_fn(spec, T, int(mesh.devices.size))
 
     data_sh = NamedSharding(mesh, P(None, axis))   # (N, T) sharded over time
     repl = NamedSharding(mesh, P())
 
     fn = jax.jit(
-        # interleaved combine tree: block-local under SPMD (the blocked
-        # prefix's chunk reshape would cross shard boundaries — see
-        # assoc_scan.filter_means_covs)
-        lambda params, data, start, end: assoc_scan.get_loss(
-            spec, params, data, start, end, prefix="interleaved"),
+        loss,
         in_shardings=(repl, data_sh, repl, repl),
         out_shardings=repl,
     )
@@ -74,8 +113,10 @@ def get_loss_time_sharded(spec: ModelSpec, params, data, start=0, end=None,
     Equivalent to ``assoc_scan.get_loss`` (itself equal to the sequential
     kernels — tested) but with ``data`` laid out ``P(None, "time")``: the
     parallel-prefix combine runs block-local on each device and crosses the
-    mesh O(log n_devices) times.  Constant-measurement Kalman families only
-    (the associative form needs a constant Z).
+    mesh O(log n_devices) times.  Kalman families with a parallel-in-time
+    engine (``config.engines_for``): the constant-Z families ride the assoc
+    tree, the state-dependent-measurement ones (TVλ) the iterated-SLR
+    engine.
     """
     if mesh is None:
         mesh = make_mesh(axis_name=axis_name)
@@ -106,16 +147,15 @@ def _jitted_time_sharded_multistart(spec: ModelSpec, T: int, mesh: Mesh,
     (Lazy optimizer import: estimation ← parallel would otherwise cycle.)"""
     from ..estimation import optimize as opt
     from ..models.params import transform_params
-    from ..ops import assoc_scan
 
+    # interleaved tree + shard-aligned SLR chunking (see the loss builder)
+    loss = _tree_loss_fn(spec, T, int(mesh.devices.size))
     data_sh = NamedSharding(mesh, P(None, axis))
     repl = NamedSharding(mesh, P())
 
     def single(x0, data, start, end):
         def fun(p):
-            # interleaved tree: block-local under SPMD (see the loss builder)
-            v = -assoc_scan.get_loss(spec, transform_params(spec, p), data,
-                                     start, end, prefix="interleaved")
+            v = -loss(transform_params(spec, p), data, start, end)
             return jnp.where(jnp.isfinite(v), v, 1e12)
 
         return opt._run_lbfgs(fun, x0, max_iters, g_tol, f_abstol)
@@ -129,13 +169,14 @@ def multistart_time_sharded(spec: ModelSpec, data, raw_starts, start=0,
                             end=None, mesh: Mesh | None = None,
                             max_iters: int = 1000, g_tol: float = 1e-6,
                             f_abstol: float = 1e-6, axis_name: str = "time"):
-    """Multi-start MLE on the assoc engine with the TIME axis sharded.
+    """Multi-start MLE on the family's tree engine with TIME sharded.
 
     The dual of :func:`~.mesh.multistart_sharded` (which shards the START
     axis): here every device owns a contiguous block of timesteps and the
     whole start batch rides each device — the right split when T is the big
-    axis (daily/intraday panels) and S is a handful.  Constant-measurement
-    Kalman families only (the associative form needs a constant Z).
+    axis (daily/intraday panels) and S is a handful.  Kalman families with
+    a parallel-in-time engine (``config.engines_for`` — assoc for
+    constant-Z, iterated SLR for TVλ).
     Arbitrary T: the panel is NaN-padded to a device-count multiple with
     ``end`` kept at the true length (exact — see :func:`_pad_time`).
 

@@ -19,6 +19,11 @@ automatically:
    docs/DESIGN.md §13) — the same stabilized surrogate as the sqrt rung but
    at O(log T) span, so a dead 20k-step daily panel is re-evaluated in tree
    depth instead of another 20k sequential steps; parameters unchanged;
+2b. ``slr``   the nonlinear twin of the assoc rung (same length gate): the
+   iterated-SLR engine with PSD-*projected* moments
+   (``slr_scan.get_loss_coded(psd_floor=...)``, docs/DESIGN.md §19) for the
+   Kalman families whose measurement is state-dependent (TVλ) — a dead
+   long-panel EKF start is re-evaluated at tree span too;
 3. ``sqrt``   the square-root filter with PSD-*projected* initial moments
    (``sqrt_kf.get_loss_coded(init_psd_floor=...)``): covariance breakdowns
    (NONPSD_INNOVATION / CHOL_BREAKDOWN) re-enter through a factorization
@@ -70,7 +75,7 @@ OBS_VAR_FLOOR = 1e-8
 #: reference parity: at most 10 ×0.95 shrinks (optimization.jl:173-184)
 SHRINK_TRIES = 10
 
-RUNGS = ("scan", "assoc", "sqrt", "jitter", "shrink")
+RUNGS = ("scan", "assoc", "slr", "sqrt", "jitter", "shrink")
 
 
 def escalation_enabled() -> bool:
@@ -163,6 +168,40 @@ def _assoc_rescue(spec, cons, data, start, end):
     return float(ll), int(code)
 
 
+@register_engine_cache
+@lru_cache(maxsize=64)
+def _jitted_slr_rescue(spec):
+    """The slr rung's jitted evaluator: the iterated-SLR engine
+    (ops/slr_scan, docs/DESIGN.md §19) with PSD-projected moments — the
+    assoc rung's twin for the Kalman families whose measurement is
+    state-dependent.  Keyed on spec alone, like the assoc builder (jit
+    retraces per data shape)."""
+    import jax
+
+    from ..ops import slr_scan
+
+    return jax.jit(lambda p, d, s, e: slr_scan.get_loss_coded(
+        spec, p, d, s, e, psd_floor=SQRT_RESCUE_FLOOR))
+
+
+def _slr_rescue_applies(spec, T: int) -> bool:
+    """Gate for the slr rung: a Kalman family WITHOUT a constant measurement
+    (those take the assoc rung instead — config.engines_for keeps the two
+    disjoint) on a long panel, same length gate as the assoc rung."""
+    from .. import config
+
+    return (spec.is_kalman and config.tree_engine_for(spec) == "slr"
+            and T >= ASSOC_RESCUE_MIN_T)
+
+
+def _slr_rescue(spec, cons, data, start, end):
+    import jax.numpy as jnp
+
+    runner = _jitted_slr_rescue(spec)
+    ll, code = runner(cons, data, jnp.asarray(start), jnp.asarray(end))
+    return float(ll), int(code)
+
+
 def _jittered_raw(spec, raw):
     """The jitter rung's regularized point: constrained-space Ω-Cholesky
     diagonal inflation + observation-variance floor, mapped back to raw."""
@@ -227,6 +266,16 @@ def escalate(spec, data, raw, start=0, end=None,
         if np.isfinite(ll):
             return LadderTrace(start_index, code0, tuple(rungs), True,
                                "assoc", ll, "assoc", None)
+
+    # rung 2b — the nonlinear twin: iterated-SLR engine with PSD-projected
+    # moments for the state-dependent-measurement Kalman families (TVλ) —
+    # the same O(log T) answer-while-sequential-walks rescue, same gate
+    if _slr_rescue_applies(spec, T):
+        ll, code = _slr_rescue(spec, cons_of(raw), data, start, end)
+        rungs.append(RungResult("slr", ll, code))
+        if np.isfinite(ll):
+            return LadderTrace(start_index, code0, tuple(rungs), True,
+                               "slr", ll, "slr", None)
 
     # rung 3 — square-root filter from PSD-projected moments (Kalman only)
     if spec.is_kalman:

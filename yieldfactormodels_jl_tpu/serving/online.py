@@ -394,21 +394,28 @@ def _jitted_slot_write(spec: ModelSpec, capacity: int, donate: bool = True):
 @register_engine_cache
 @lru_cache(maxsize=32)
 def _jitted_refilter(spec: ModelSpec, T: int):
-    """Re-filter-from-scratch program (docs/DESIGN.md §13): the O(log T)-span
-    associative-scan filter (ops/assoc_scan.filter_and_loss) over a full
-    (N, T) history → the final filtered (β, P), the total loglik, and the
-    ok/taxonomy pair.  This is the exact rebuild that replaces "trust k
-    accumulated O(1) updates": one program, constant-measurement Kalman
-    families only (the associative form needs a constant Z — validated at
-    the driver, serving/service.py).  Sentinel discipline as everywhere:
-    a failed pass NaN-poisons the returned state and lowers ``ok``; the
-    driver decodes ``code`` into the structured error."""
+    """Re-filter-from-scratch program (docs/DESIGN.md §13/§19): the
+    O(log T)-span parallel-in-time filter over a full (N, T) history → the
+    final filtered (β, P), the total loglik, and the ok/taxonomy pair.
+    Constant-Z families ride ``assoc_scan.filter_and_loss``; the
+    state-dependent-measurement ones (TVλ) the iterated-SLR twin
+    (``slr_scan.filter_and_loss``) — the applicability gate is
+    ``config.tree_engine_for``, validated at the driver
+    (serving/service.py).  This is the exact rebuild that replaces "trust k
+    accumulated O(1) updates".  Sentinel discipline as everywhere: a failed
+    pass NaN-poisons the returned state and lowers ``ok``; the driver
+    decodes ``code`` into the structured error."""
 
     def refit(params, data):
         note_trace("refilter")
-        from ..ops import assoc_scan
+        from .. import config as _config
 
-        m, P, ll, code = assoc_scan.filter_and_loss(spec, params, data, 0, T)
+        if _config.tree_engine_for(spec) == "slr":
+            from ..ops import slr_scan as _tree
+        else:
+            from ..ops import assoc_scan as _tree
+
+        m, P, ll, code = _tree.filter_and_loss(spec, params, data, 0, T)
         beta = m[-1]
         cov = 0.5 * (P[-1] + P[-1].T)
         ok = jnp.all(jnp.isfinite(beta)) & jnp.all(jnp.isfinite(cov)) \

@@ -422,8 +422,12 @@ class YieldCurveService:
         Semantics notes: whole columns with any NaN are treated as unobserved
         (pure prediction steps — the OFFLINE filter convention), unlike the
         per-element masking of the online ``update`` path; feed fully-quoted
-        history for bit-tight agreement.  Constant-measurement Kalman
-        families only (DNS/AFNS — the associative form needs a constant Z).
+        history for bit-tight agreement.  Kalman families with a
+        parallel-in-time engine (``config.engines_for``): DNS/AFNS rebuild
+        on the assoc tree, TVλ on the iterated-SLR engine (docs/DESIGN.md
+        §19) — the SLR fixed point is the sequential EKF, so the rebuilt
+        state agrees with the accumulated EKF recursion at engine
+        tolerance.
 
         On success the rebuilt state becomes the new last-good snapshot
         (version bumped, refresh cadence reset — an exact rebuild is the
@@ -433,11 +437,14 @@ class YieldCurveService:
         :class:`ServingError`, or stale-flag + NaN under ``self_heal``).
         """
         spec = self.snapshot.spec
-        if not spec.has_constant_measurement:
+        from .. import config as _config
+
+        if _config.tree_engine_for(spec) is None:
             raise ServingError(
-                "refilter", f"re-filter needs a constant-measurement Kalman "
-                f"family (the associative-scan engine); "
-                f"{spec.family!r} is not one", model=spec.model_string)
+                "refilter", f"re-filter needs a Kalman family with a "
+                f"parallel-in-time engine (config.engines_for"
+                f"({spec.family!r}) = {_config.engines_for(spec)} has "
+                f"neither 'assoc' nor 'slr')", model=spec.model_string)
         Y = jnp.asarray(history, dtype=spec.dtype)
         if Y.ndim != 2 or Y.shape[0] != spec.N:
             raise ServingError(
