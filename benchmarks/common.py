@@ -152,6 +152,18 @@ def ssd_nns_params(spec):
     return p
 
 
+def estimation_env_kwargs():
+    """The estimation-cascade env knobs (``YFM_NEWTON`` / ``YFM_AMORT``)
+    resolved into EXPLICIT ``estimate()`` kwargs — ONE resolution, owned by
+    ``estimation.optimize.resolve_estimation_env``, shared by run_all.py's
+    config 2 and bench.py's opt-in estimation benches so the perf ledger can
+    never measure a different cascade than the headline (ISSUE 15)."""
+    from yieldfactormodels_jl_tpu.estimation.optimize import (
+        resolve_estimation_env)
+
+    return resolve_estimation_env()
+
+
 def jitter_starts(p, n_starts, seed=1, scale=0.05):
     """(S, P) stack of jittered copies of ``p`` (multi-start initialization)."""
     rng = np.random.default_rng(seed)

@@ -506,6 +506,52 @@ def unit_newton_iteration():
                   f"iteration (P={P})")
 
 
+def unit_amort():
+    """Measured seconds for ONE naive amortized refit at the config-2 shape
+    (the BENCH_AMORT dual-ratio denominator): the surrogate forward pass as
+    straight per-step NumPy loops (tests/oracle.amortizer_forward — the
+    independent implementation the jitted "deepset" kernel is pinned
+    against) plus ONE naive per-step filter pass to evaluate the predicted
+    point.  This is what the amortized request-path refit costs without the
+    compiled batch-last forward program and the fused polish — the honest
+    1-thread floor for the SAME algorithm; the cold multi-start it replaces
+    is priced by ``unit-afns5-pass`` × its pass count."""
+    import jax
+    import jax.numpy as jnp
+
+    from yieldfactormodels_jl_tpu import create_model
+    from yieldfactormodels_jl_tpu.estimation.amortize import (
+        AmortizerConfig, init_params, raw_from_net, set_normalization)
+    from yieldfactormodels_jl_tpu.models.params import (transform_params,
+                                                        unpack_kalman)
+    from yieldfactormodels_jl_tpu.ops.particle import _measurement
+
+    spec, _ = create_model("AFNS5", tuple(common.MATURITIES),
+                           float_type="float64")
+    data = np.asarray(common.afns5_panel(), dtype=np.float64)
+    cfg = AmortizerConfig()
+    params = init_params(cfg, spec, jax.random.PRNGKey(0))
+    params = set_normalization(params, data[:, :, None])
+    params = {k: np.asarray(v) for k, v in params.items()}
+    t0 = time.perf_counter()
+    net = oracle.amortizer_forward(params, data)          # NumPy loops
+    raw = raw_from_net(spec, net[None])[0]
+    cons = np.asarray(transform_params(spec, jnp.asarray(raw)))
+    kp = unpack_kalman(spec, jnp.asarray(cons))
+    Z, d = _measurement(spec, kp, jnp.float64)
+    try:
+        ll = oracle.kalman_filter_loglik(
+            np.asarray(Z, dtype=np.float64), np.asarray(kp.Phi),
+            np.asarray(kp.delta), np.asarray(kp.Omega_state),
+            float(kp.obs_var),
+            data - np.asarray(d, dtype=np.float64)[:, None])
+    except np.linalg.LinAlgError:
+        ll = float("-inf")                                # untrained net: ok
+    wall = time.perf_counter() - t0
+    return wall, (f"1 naive forward pass + 1 naive filter eval "
+                  f"(T={data.shape[1]}, ll={ll:.1f})")
+
+
 RUNNERS = {
     "dns3-mle": naive_dns3_mle,
     "afns5-sv-pf": naive_afns5_sv_pf,
@@ -516,6 +562,7 @@ RUNNERS = {
     "unit-ssd-pass": unit_ssd_nns_pass,
     "scenario-fan": naive_scenario_fan,
     "unit-newton-iteration": unit_newton_iteration,
+    "unit-amort": unit_amort,
 }
 
 

@@ -98,13 +98,31 @@ def _run_config(name: str, scale: int):
         data = common.afns5_panel()
         S = max(1, 64 // scale)
         starts = common.jitter_starts(common.afns5_params(spec), S).T  # (P, S)
+        # cascade resolved EXPLICITLY through the one shared env helper
+        # (estimation.optimize.resolve_estimation_env): the ledger honors
+        # YFM_NEWTON/YFM_AMORT exactly the way bench.py's estimation benches
+        # do, and the work description names which cascade actually ran
+        kw = common.estimation_env_kwargs()
 
         def job():
-            _, ll, best, _ = optimize.estimate(spec, data, starts, max_iters=100)
+            _, ll, best, _ = optimize.estimate(spec, data, starts,
+                                               max_iters=100, **kw)
             return np.asarray([ll])
 
         wall, out = steady(job)
-        return wall, f"{S} starts x 100 LBFGS iters, ll={out[0]:.1f}"
+        cascade = "lbfgs" if not kw["second_order"] \
+            else f"newton:{kw['second_order']}"
+        # label from what actually RAN, not from the knob: warm_start=True
+        # resolves through the process-wide registry, and run_all never
+        # trains/registers a surrogate — the report's phase tags are the
+        # ground truth of which cascade produced the measured wall
+        if any(p.startswith("amortized")
+               for p in optimize.last_multistart_report()["phase"]):
+            cascade = "amort+" + cascade
+        elif kw["warm_start"]:
+            cascade += " (YFM_AMORT armed, no surrogate registered)"
+        return wall, (f"{S} starts x 100 LBFGS iters, cascade={cascade}, "
+                      f"ll={out[0]:.1f}")
 
     if name == "afns5-sv-pf":
         spec, _ = create_model("AFNS5", tuple(common.MATURITIES), float_type="float32")

@@ -973,3 +973,82 @@ def fd_hessian(fun, x, eps=1e-4):
                 / (4.0 * h[i] * h[j])
             H[j, i] = H[i, j]
     return H
+
+
+def amortizer_forward(params, Y):
+    """Independent NumPy mirror of the amortized-estimation surrogate's
+    forward pass (estimation/amortize._forward_core, "deepset" architecture,
+    docs/DESIGN.md §20) for ONE (N, T) panel: per-step loops, no JAX.
+
+    Per step t ≥ 1 with BOTH columns fully finite: the shared MLP features
+    tanh(W1 [ (y_t−μ)/σ ; (y_t−y_{t−1})/σ_Δ ] + b1) enter masked mean /
+    second-moment pools; per-maturity panel mean/std pool over all valid
+    columns; the pooled summary is soft-clipped at ±4 and mapped through
+    the tanh head + linear skip.  An all-invalid panel returns all-NaN (the
+    sentinel the library's forward emits).  Output is in NET space (δ slots
+    carry the steady state μ — ``raw_from_net`` is the library-side
+    inverse, round-trip-tested separately)."""
+    Y = np.asarray(Y, dtype=np.float64)
+    N, T = Y.shape
+    y_mu = np.asarray(params["y_mu"], dtype=np.float64)
+    y_sd = np.asarray(params["y_sd"], dtype=np.float64)
+    dy_sd = np.asarray(params["dy_sd"], dtype=np.float64)
+    W1 = np.asarray(params["W1"], dtype=np.float64)
+    b1 = np.asarray(params["b1"], dtype=np.float64)
+    H = W1.shape[0]
+    valid = [bool(np.all(np.isfinite(Y[:, t]))) for t in range(T)]
+    m1 = np.zeros(H)
+    m2 = np.zeros(H)
+    n_pairs = 0
+    for t in range(1, T):
+        if not (valid[t] and valid[t - 1]):
+            continue
+        yn = (Y[:, t] - y_mu) / y_sd
+        dy = (Y[:, t] - Y[:, t - 1]) / dy_sd
+        h = np.tanh(W1 @ np.concatenate([yn, dy]) + b1)
+        m1 += h
+        m2 += h * h
+        n_pairs += 1
+    my = np.zeros(N)
+    s2 = np.zeros(N)
+    n_cols = 0
+    for t in range(T):
+        if not valid[t]:
+            continue
+        yn = (Y[:, t] - y_mu) / y_sd
+        my += yn
+        s2 += yn * yn
+        n_cols += 1
+    if n_pairs == 0 or n_cols == 0:
+        return np.full(np.asarray(params["b3"]).shape[0], np.nan)
+    m1, m2 = m1 / n_pairs, m2 / n_pairs
+    my = my / n_cols
+    sy = np.sqrt(np.maximum(s2 / n_cols - my * my, 0.0))
+    Z = np.concatenate([m1, m2, my, sy])
+    Z = 4.0 * np.tanh(Z / 4.0)
+    G = np.tanh(np.asarray(params["W2"], dtype=np.float64) @ Z
+                + np.asarray(params["b2"], dtype=np.float64))
+    return np.asarray(params["W3"], dtype=np.float64) @ G \
+        + np.asarray(params["Ws"], dtype=np.float64) @ Z \
+        + np.asarray(params["b3"], dtype=np.float64)
+
+
+def amortizer_loss(params, panels, targets):
+    """NumPy mirror of the amortizer's masked training loss
+    (estimation/amortize._loss_core): mean squared error on the NET-space
+    targets over the batch, a sample weighted ZERO when its panel's forward
+    pass is non-finite (failed simulation → NaN panel) or its target row is
+    — bad samples are masked, never raised.  ``panels`` (B, N, T),
+    ``targets`` (B, P)."""
+    panels = np.asarray(panels, dtype=np.float64)
+    targets = np.asarray(targets, dtype=np.float64)
+    B, P = targets.shape
+    total = 0.0
+    n_ok = 0
+    for b in range(B):
+        pred = amortizer_forward(params, panels[b])
+        if not (np.all(np.isfinite(pred)) and np.all(np.isfinite(targets[b]))):
+            continue
+        total += float(np.sum((pred - targets[b]) ** 2))
+        n_ok += 1
+    return total / (max(n_ok, 1) * P)

@@ -1,0 +1,366 @@
+"""Amortized estimation (estimation/amortize.py, docs/DESIGN.md §20).
+
+Coverage contract (ISSUE 15): "deepset" surrogate forward/loss parity
+against the independent NumPy loops in tests/oracle.py (graftlint YFM007 —
+the AMORTIZER_ENGINES registry entry is named here), NaN-panel masking
+parity, parameter-recovery calibration at the shared stable points
+(likelihood-space: the predicted point must close most of the loglik gap
+between the prior mean and the truth), warm-start-matches-or-beats-cold,
+the bit-for-bit off switch (``YFM_AMORT`` unset), no-recompile trace
+counters, and the serving refit/publish surfaces.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests import oracle
+from yieldfactormodels_jl_tpu import create_model
+from yieldfactormodels_jl_tpu.estimation import amortize
+from yieldfactormodels_jl_tpu.estimation import optimize
+from yieldfactormodels_jl_tpu.models import api
+from yieldfactormodels_jl_tpu.models.params import (transform_params,
+                                                    untransform_params)
+
+MATS = tuple(np.array([3.0, 6.0, 12.0, 24.0, 36.0, 60.0, 84.0, 120.0]) / 12.0)
+T_PANEL = 96
+
+
+@pytest.fixture(scope="module")
+def spec():
+    s, _ = create_model("1C", MATS, float_type="float64")
+    return s
+
+
+@pytest.fixture(scope="module")
+def base_params(spec):
+    return oracle.stable_1c_params(spec, dtype=np.float64)
+
+
+@pytest.fixture(scope="module")
+def trained(spec, base_params):
+    """One cheaply-trained surrogate shared by the module (train-once is the
+    whole point); registered copies are cleaned per test, not here."""
+    return amortize.train_amortizer(spec, base_params, T_PANEL, n_rounds=20,
+                                    batch=96, steps_per_round=10, lr=1e-2,
+                                    prior_scale=0.1)
+
+
+@pytest.fixture(scope="module")
+def heldout(spec, trained):
+    """Held-out (draws, panels) the surrogate never trained on."""
+    base_raw = trained.info["base_raw"]
+    B = 32
+    draws = amortize.sample_prior_raw(spec, base_raw, B,
+                                      jax.random.PRNGKey(123), 0.1)
+    sim = amortize._jitted_sim_batch(spec, T_PANEL, B, False)
+    out = sim(jnp.asarray(draws), jax.random.split(jax.random.PRNGKey(321),
+                                                   B))
+    return np.asarray(out["raw"]), np.asarray(out["panels"])
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    amortize.clear_amortizers()
+    yield
+    amortize.clear_amortizers()
+    os.environ.pop("YFM_AMORT", None)
+
+
+# ---------------------------------------------------------------------------
+# oracle parity ("deepset" forward + masked loss)
+# ---------------------------------------------------------------------------
+
+def test_forward_matches_numpy_oracle(spec, rng):
+    """The jitted "deepset" forward pass equals the independent NumPy
+    per-step loops — including masked (partially-NaN) panels."""
+    from yieldfactormodels_jl_tpu import config
+
+    # the registry entry this parity test covers (graftlint YFM007)
+    assert "deepset" in config.AMORTIZER_ENGINES
+    cfg = amortize.AmortizerConfig()
+    params = amortize.init_params(cfg, spec, jax.random.PRNGKey(3))
+    Y = oracle.simulate_dns_panel(rng, np.asarray(MATS), T=40)
+    Y[:, 7] = np.nan          # whole column unquoted
+    Y[2, 19] = np.nan         # partial column → whole column invalid
+    params = amortize.set_normalization(params, Y[:, :, None])
+    fn = amortize._jitted_forward(cfg, spec, Y.shape[1], 1)
+    got = np.asarray(fn(params, jnp.asarray(Y)[:, :, None]))[:, 0]
+    want = oracle.amortizer_forward(params, Y)
+    np.testing.assert_allclose(got, want, rtol=1e-10, atol=1e-12)
+    # all-invalid panel → all-NaN sentinel on BOTH sides, nothing raises
+    nanp = np.full_like(Y, np.nan)
+    got_nan = np.asarray(fn(params, jnp.asarray(nanp)[:, :, None]))[:, 0]
+    assert np.all(~np.isfinite(got_nan))
+    assert np.all(~np.isfinite(oracle.amortizer_forward(params, nanp)))
+
+
+def test_nan_panel_masking_loss_parity(spec, rng):
+    """The training loss masks bad samples exactly like the NumPy oracle:
+    NaN-poisoned panels carry weight zero, never raise."""
+    cfg = amortize.AmortizerConfig()
+    params = amortize.init_params(cfg, spec, jax.random.PRNGKey(4))
+    B = 6
+    panels = np.stack([oracle.simulate_dns_panel(rng, np.asarray(MATS), T=30)
+                       for _ in range(B)], axis=0)     # (B, N, T)
+    panels[1] = np.nan                                  # dead panel
+    panels[3, :, 11] = np.nan                           # one masked column
+    targets = rng.standard_normal((B, spec.n_params))
+    targets[4] = np.nan                                 # dead target
+    params = amortize.set_normalization(params, np.moveaxis(panels, 0, -1))
+    got = float(amortize._loss_core(
+        cfg, params, jnp.asarray(np.moveaxis(panels, 0, -1)),
+        jnp.asarray(targets.T)))
+    want = oracle.amortizer_loss(params, panels, targets)
+    np.testing.assert_allclose(got, want, rtol=1e-10)
+
+
+def test_net_target_space_round_trip(spec, base_params):
+    """net_targets (δ → steady state μ) and raw_from_net (δ = (I−Φ̂)μ̂) are
+    inverses on stationary draws."""
+    base_raw = np.asarray(untransform_params(
+        spec, jnp.asarray(base_params)), dtype=np.float64)
+    draws = amortize.sample_prior_raw(spec, base_raw, 8,
+                                      jax.random.PRNGKey(5), 0.1)
+    net = amortize.net_targets(spec, draws)             # (P, B)
+    assert np.all(np.isfinite(net))
+    back = amortize.raw_from_net(spec, net.T)           # (B, P)
+    np.testing.assert_allclose(back, draws.T, rtol=1e-9, atol=1e-9)
+
+
+# ---------------------------------------------------------------------------
+# parameter-recovery calibration (likelihood space — see DESIGN §20 for why
+# raw-δ MSE is the wrong metric: its posterior noise is unknowable Φ·μ)
+# ---------------------------------------------------------------------------
+
+def test_parameter_recovery_calibration(spec, base_params, trained, heldout):
+    tgts, panels = heldout                              # (P, B), (N, T, B)
+    B = tgts.shape[1]
+    preds = trained.predict_raw_batch(np.moveaxis(panels, -1, 0))
+    ok = np.all(np.isfinite(preds), axis=1)
+    assert ok.mean() > 0.9                              # sims are stationary
+
+    loss_b = jax.jit(jax.vmap(lambda p, d: api.get_loss(spec, p, d),
+                              in_axes=(0, 0)))
+    cons = jax.vmap(lambda r: transform_params(spec, r))
+    pan = jnp.asarray(np.moveaxis(panels, -1, 0))
+    ll_pred = np.asarray(loss_b(cons(jnp.asarray(preds)), pan))
+    ll_true = np.asarray(loss_b(cons(jnp.asarray(tgts.T)), pan))
+    ll_base = np.asarray(loss_b(
+        jnp.tile(jnp.asarray(base_params)[None], (B, 1)), pan))
+    fin = ok & np.isfinite(ll_pred) & np.isfinite(ll_true) \
+        & np.isfinite(ll_base)
+    assert fin.sum() >= 20
+    # calibration: the one-forward-pass estimate closes most of the loglik
+    # gap between the prior-mean point and the simulating truth...
+    gap_closed = (ll_pred[fin] - ll_base[fin]).mean() \
+        / (ll_true[fin] - ll_base[fin]).mean()
+    assert gap_closed > 0.5, f"surrogate closes only {gap_closed:.2%}"
+    # ...and beats the prior point on nearly every held-out panel
+    assert (ll_pred[fin] > ll_base[fin]).mean() > 0.9
+    # raw-space calibration where the parameter IS identifiable: the λ
+    # driver's MSE must shrink well below the prior's
+    lo, hi = spec.layout["gamma"]
+    base_raw = trained.info["base_raw"]
+    r = np.mean((preds[ok, lo:hi] - tgts.T[ok, lo:hi]) ** 2) \
+        / np.mean((base_raw[None, lo:hi] - tgts.T[ok, lo:hi]) ** 2)
+    assert r < 0.7, f"λ recovery ratio {r:.2f}"
+
+
+# ---------------------------------------------------------------------------
+# warm-start wiring (estimate / report tags / off switch)
+# ---------------------------------------------------------------------------
+
+def _panel_and_starts(spec, trained, seed=55):
+    base_raw = trained.info["base_raw"]
+    draw = amortize.sample_prior_raw(spec, base_raw, 1,
+                                     jax.random.PRNGKey(seed), 0.1)[:, 0]
+    data = np.asarray(api.simulate(
+        spec, transform_params(spec, jnp.asarray(draw)), T_PANEL,
+        jax.random.PRNGKey(seed + 1))["data"])
+    rng = np.random.default_rng(7)
+    raws = base_raw[None] + 0.05 * rng.standard_normal((2, base_raw.shape[0]))
+    starts = np.stack([np.asarray(transform_params(spec, jnp.asarray(r)))
+                       for r in raws], axis=1)          # (P, S)
+    return data, starts
+
+
+@pytest.mark.slow
+def test_warm_start_matches_or_beats_cold(spec, trained):
+    data, starts = _panel_and_starts(spec, trained)
+    _, ll_cold, _, _ = optimize.estimate(spec, data, starts, max_iters=300,
+                                         g_tol=1e-5, f_abstol=1e-8,
+                                         warm_start=False)
+    _, ll_warm, _, _ = optimize.estimate(spec, data, starts, max_iters=300,
+                                         g_tol=1e-5, f_abstol=1e-8,
+                                         warm_start=trained,
+                                         second_order="fisher")
+    rep = optimize.last_multistart_report()
+    assert ll_warm >= ll_cold - 1e-3        # ISSUE 15 acceptance tolerance
+    assert any(p.startswith("amortized") for p in rep["phase"])
+    # the anchor row (the caller's first start) is never tagged amortized
+    assert not rep["phase"][-1].startswith("amortized")
+
+
+def test_off_switch_is_bit_for_bit(spec, trained):
+    """YFM_AMORT unset + a REGISTERED surrogate: estimate() must reproduce
+    the historical path bit-for-bit (no amortizer code runs — pinned by the
+    forward-pass trace counter)."""
+    data, starts = _panel_and_starts(spec, trained)
+    amortize.register_amortizer(trained)
+    amortize.reset_trace_counts()
+    kw = dict(max_iters=40, g_tol=1e-5, f_abstol=1e-8)
+    r_default = optimize.estimate(spec, data, starts, **kw)
+    r_off = optimize.estimate(spec, data, starts, warm_start=False, **kw)
+    assert amortize.trace_counts["forward"] == 0
+    assert r_default[1] == r_off[1]
+    np.testing.assert_array_equal(r_default[2], r_off[2])
+    assert not any(p.startswith("amortized")
+                   for p in optimize.last_multistart_report()["phase"])
+
+
+def test_env_knob_arms_registered_amortizer(spec, trained):
+    data, starts = _panel_and_starts(spec, trained)
+    amortize.register_amortizer(trained)
+    os.environ["YFM_AMORT"] = "1"
+    try:
+        kw = optimize.resolve_estimation_env()
+        assert kw["warm_start"] is True
+        optimize.estimate(spec, data, starts, max_iters=40, g_tol=1e-4,
+                          f_abstol=1e-8)
+        assert any(p.startswith("amortized")
+                   for p in optimize.last_multistart_report()["phase"])
+    finally:
+        os.environ.pop("YFM_AMORT", None)
+    # knob armed but NOTHING registered: quietly historical (other specs
+    # must not break when the knob is set process-wide)
+    amortize.clear_amortizers()
+    os.environ["YFM_AMORT"] = "1"
+    try:
+        optimize.estimate(spec, data, starts, max_iters=40, g_tol=1e-4,
+                          f_abstol=1e-8)
+        assert not any(p.startswith("amortized")
+                       for p in optimize.last_multistart_report()["phase"])
+    finally:
+        os.environ.pop("YFM_AMORT", None)
+
+
+def test_sentinel_prediction_falls_back_to_spray(spec, trained):
+    """A non-finite surrogate prediction (all-NaN panel) keeps the caller's
+    historical start spray — sentinel in, historical behavior out."""
+    data = np.full((spec.N, 30), np.nan)
+    assert trained.starts(data) is None
+    fb = np.zeros(spec.n_params)
+    sb = trained.starts_batch(np.stack([data, data]), fallback_raw=fb)
+    assert sb.shape[0] == 2 and np.allclose(sb[:, 0, :], 0.0)
+
+
+def test_no_recompile_across_predicts_and_rounds(spec, trained, heldout):
+    _, panels = heldout
+    # a panel length nothing else in the module uses: the first call must
+    # trace, the repeats must NOT (the lru-cached program is shared)
+    panels = panels[:, :77, :]
+    amortize.reset_trace_counts()
+    for i in range(3):
+        trained.predict_raw(panels[:, :, i])
+    assert amortize.trace_counts["forward"] == 1
+    trained.predict_raw_batch(np.moveaxis(panels, -1, 0))
+    assert amortize.trace_counts["forward"] == 2  # new batch size: one more
+    # simulation program: one trace across repeated rounds (donated draws)
+    amortize.reset_trace_counts()
+    sim = amortize._jitted_sim_batch(spec, 24, 4, True)
+    for i in range(3):
+        draws = amortize.sample_prior_raw(
+            spec, trained.info["base_raw"], 4, jax.random.PRNGKey(i), 0.05)
+        sim(jnp.asarray(draws), jax.random.split(jax.random.PRNGKey(i), 4))
+    assert amortize.trace_counts["sim"] == 1
+
+
+# ---------------------------------------------------------------------------
+# refit column (per-resample warm starts)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_refit_column_warm_matches_or_beats_cold(spec, trained):
+    from yieldfactormodels_jl_tpu.estimation.bootstrap import (
+        moving_block_indices)
+    from yieldfactormodels_jl_tpu.estimation.scenario import refit_column
+
+    data, starts = _panel_and_starts(spec, trained, seed=91)
+    idx = np.asarray(moving_block_indices(jax.random.PRNGKey(2), T_PANEL,
+                                          12, 3))
+    raw_starts = np.stack([np.asarray(untransform_params(
+        spec, jnp.asarray(starts[:, j]))) for j in range(starts.shape[1])])
+    xs_c, ll_c = refit_column(spec, data, idx, raw_starts, max_iters=60,
+                              warm_start=False)
+    xs_w, ll_w = refit_column(spec, data, idx, raw_starts, max_iters=60,
+                              warm_start=trained)
+    best_c = np.max(np.where(np.isfinite(ll_c), ll_c, -np.inf), axis=1)
+    best_w = np.max(np.where(np.isfinite(ll_w), ll_w, -np.inf), axis=1)
+    assert np.asarray(xs_w).shape[0] == idx.shape[0]
+    assert np.all(best_w >= best_c - 1e-3)
+
+
+# ---------------------------------------------------------------------------
+# serving surfaces
+# ---------------------------------------------------------------------------
+
+def test_service_refit_updates_params_and_version(spec, base_params,
+                                                  trained):
+    from yieldfactormodels_jl_tpu import serving
+
+    data, _ = _panel_and_starts(spec, trained, seed=33)
+    snap = serving.freeze_snapshot(spec, base_params, data)
+    svc = serving.YieldCurveService(snap)
+    with pytest.raises(serving.ServingError):
+        svc.refit(data)                    # nothing registered → structural
+    v0 = svc.version
+    ll = svc.refit(data, amortizer=trained)
+    assert np.isfinite(ll)
+    assert svc.version > v0
+    assert not np.allclose(np.asarray(svc.snapshot.params),
+                           np.asarray(base_params))
+    # the refit parameters must fit the history at least as well as the
+    # boot parameters did
+    ll_base = float(api.get_loss(spec, jnp.asarray(base_params),
+                                 jnp.asarray(data)))
+    assert ll > ll_base
+
+
+def test_store_publish_refit_rewrites_live_slot(spec, base_params, trained):
+    from yieldfactormodels_jl_tpu import serving
+    from yieldfactormodels_jl_tpu.serving.store import ShardedStateStore
+
+    data, _ = _panel_and_starts(spec, trained, seed=44)
+    snap = serving.freeze_snapshot(spec, base_params, data)
+    store = ShardedStateStore(spec, n_shards=2, shard_capacity=4)
+    key = store.register(snap)
+    raw, ll = amortize.amortized_refit(spec, data, amortizer=trained,
+                                       polish_iters=1)
+    params = np.asarray(transform_params(spec, jnp.asarray(raw)))
+    out = store.publish_refit(key, params, history=data)
+    assert out["version"] == snap.meta.version + 1
+    live = store.snapshot_of(key)
+    np.testing.assert_allclose(np.asarray(live.params), params, rtol=1e-12)
+    with pytest.raises(serving.ServingError):
+        store.publish_refit(("nope", 1), params)
+
+
+def test_gateway_refit_deadline_degrades(spec, base_params, trained):
+    from yieldfactormodels_jl_tpu import serving
+    from yieldfactormodels_jl_tpu.serving.gateway import ServingGateway
+
+    data, _ = _panel_and_starts(spec, trained, seed=66)
+    snap = serving.freeze_snapshot(spec, base_params, data)
+    svc = serving.YieldCurveService(snap)
+    gw = ServingGateway(svc, queue_age_ms=0.0)
+    out = gw.refit(data, amortizer=trained)
+    assert out["kind"] == "refit" and np.isfinite(out["ll"])
+    # measured cost now in the EWMA: an impossible budget answers degraded
+    # from the last-good snapshot instead of blowing the deadline
+    out2 = gw.refit(data, deadline_ms=1e-6, amortizer=trained)
+    assert out2.get("degraded") and out2.get("stale")
+    assert svc.counters.degraded >= 1

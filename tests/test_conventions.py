@@ -100,13 +100,15 @@ def test_every_kalman_engine_has_oracle_parity_coverage():
     # canonical coverage modules, and the Kalman registry is still the
     # five-engine set (or larger)
     engines, _ = kalman_engines_static(CFG)
-    from yieldfactormodels_jl_tpu.config import (KALMAN_ENGINES,
+    from yieldfactormodels_jl_tpu.config import (AMORTIZER_ENGINES,
+                                                 KALMAN_ENGINES,
                                                  NEWTON_ENGINES, SLR_ENGINES)
     assert tuple(engines) == tuple(KALMAN_ENGINES) + tuple(SLR_ENGINES) \
-        + tuple(NEWTON_ENGINES)
+        + tuple(NEWTON_ENGINES) + tuple(AMORTIZER_ENGINES)
     assert len(KALMAN_ENGINES) >= 5
     assert len(SLR_ENGINES) >= 1
     assert len(NEWTON_ENGINES) >= 2
+    assert len(AMORTIZER_ENGINES) >= 1
     strings = oracle_backed_test_strings(CFG)
     assert "test_assoc_estimation.py" in strings, \
         "engine-coverage guard rotted: canonical parity module not scanned"
@@ -114,3 +116,5 @@ def test_every_kalman_engine_has_oracle_parity_coverage():
         "engine-coverage guard rotted: second-order parity module not scanned"
     assert "test_slr_scan.py" in strings, \
         "engine-coverage guard rotted: SLR parity module not scanned"
+    assert "test_amortize.py" in strings, \
+        "engine-coverage guard rotted: amortizer parity module not scanned"

@@ -48,6 +48,9 @@ _LAZY.update({name: ".models.params" for name in (
 _LAZY["load_data"] = ".utils.data_management"
 _LAZY.update({name: ".estimation.optimize" for name in (
     "compute_loss", "estimate", "estimate_steps", "try_initializations")})
+_LAZY.update({name: ".estimation.amortize" for name in (
+    "Amortizer", "AmortizerConfig", "train_amortizer", "register_amortizer",
+    "get_amortizer", "amortized_refit")})
 _LAZY["run_rolling_forecasts"] = ".forecasting"
 _LAZY["run"] = ".run"
 _LAZY["save_results"] = ".persistence.io"

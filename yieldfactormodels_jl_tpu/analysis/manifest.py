@@ -242,6 +242,63 @@ def _m_window_multistart():
 
 
 # ---------------------------------------------------------------------------
+# estimation.amortize — the amortized-estimation surrogate (DESIGN §20)
+# ---------------------------------------------------------------------------
+
+AB = 8  # amortizer lane batch (audit-sized)
+
+
+def _amortizer_cfg_params():
+    """(cfg, spec, concrete init params) for the amortizer cases — the
+    params pytree is tiny and init is pure, so concrete arrays keep the
+    case simple (the manifest contract allows small concrete inputs)."""
+    import jax
+
+    from ..estimation.amortize import AmortizerConfig, init_params
+
+    sp = spec()
+    cfg = AmortizerConfig()
+    return cfg, sp, init_params(cfg, sp, jax.random.PRNGKey(0))
+
+
+@case("estimation.amortize._jitted_sim_batch", label="donated", donated=1)
+def _m_amort_sim():
+    from ..estimation.amortize import _jitted_sim_batch
+
+    sp = spec()
+    fn = _jitted_sim_batch(sp, T, AB, True)
+    # run(raw (P, B), keys); donated: raw → the "raw" pass-through output
+    return fn, [(f64(sp.n_params, AB), keys(AB))]
+
+
+@case("estimation.amortize._jitted_forward")
+def _m_amort_forward():
+    from ..estimation.amortize import _jitted_forward
+
+    cfg, sp, params = _amortizer_cfg_params()
+    fn = _jitted_forward(cfg, sp, T, AB)
+    return fn, [(params, f64(N, T, AB))]
+
+
+@case("estimation.amortize._jitted_train_step", label="donated", donated=2)
+def _m_amort_train_step():
+    import jax
+    import optax
+
+    from ..estimation.amortize import _jitted_train_step
+
+    cfg, sp, params = _amortizer_cfg_params()
+    opt_state = optax.adam(1e-3).init(params)
+    fn = _jitted_train_step(cfg, sp, T, AB, 1e-3)
+    # donated: params + opt_state pytrees (consumed, returned updated) —
+    # declared as 2 buffers minimum; the aliasing check is a ≥ bound
+    avals = jax.tree_util.tree_map(
+        lambda a: sds(a.shape, str(a.dtype)), (params, opt_state))
+    return fn, [(avals[0], avals[1], f64(N, T, AB),
+                 f64(sp.n_params, AB))]
+
+
+# ---------------------------------------------------------------------------
 # estimation.sv / estimation.bootstrap / estimation.inference
 # ---------------------------------------------------------------------------
 
@@ -350,6 +407,16 @@ def _m_refit_column():
     P = npar()
     fn = _jitted_refit_column(spec(), T, ITERS, GT, FA)
     return fn, [(f64(2, P), f64(R, N, T))]
+
+
+@case("estimation.scenario._jitted_refit_column_warm")
+def _m_refit_column_warm():
+    from ..estimation.scenario import _jitted_refit_column_warm
+
+    P = npar()
+    fn = _jitted_refit_column_warm(spec(), T, ITERS, GT, FA)
+    # per-resample start matrices: X0 is (R, S, P) — the amortized warm path
+    return fn, [(f64(R, 2, P), f64(R, N, T))]
 
 
 @case("estimation.scenario._jitted_refit_polish")

@@ -45,6 +45,17 @@ SLR_ENGINES = ("ekf",)
 #: the same contract as KALMAN_ENGINES.
 NEWTON_ENGINES = ("fisher", "exact")
 
+#: amortized-estimation surrogate architectures (``estimation/amortize.py``,
+#: docs/DESIGN.md §20):
+#:   "deepset"  permutation/length-robust deep-set summary over the panel's
+#:              time axis (masked mean/second-moment pooling of a shared
+#:              per-step MLP on (yₜ, Δyₜ) pairs) + MLP/linear head onto the
+#:              raw parameter vector in the steady-state target space
+#: Every entry must have oracle-backed parity coverage — graftlint YFM007,
+#: the same contract as KALMAN_ENGINES: the surrogate's forward/loss kernels
+#: are pinned against independent NumPy loops in tests/oracle.py.
+AMORTIZER_ENGINES = ("deepset",)
+
 
 def engines_for(spec) -> tuple:
     """The ``KALMAN_ENGINES`` entries valid for one model family — THE

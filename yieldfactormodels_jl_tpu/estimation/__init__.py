@@ -1,7 +1,7 @@
 from . import optimize, neldermead
 
 __all__ = ["optimize", "neldermead", "bootstrap", "sv", "inference",
-           "scenario"]
+           "scenario", "amortize"]
 
 
 def __getattr__(name):

@@ -1,0 +1,679 @@
+"""Amortized estimation: a neural surrogate that turns multi-start MLE into
+a one-forward-pass warm start (docs/DESIGN.md §20; ROADMAP item 1,
+arXiv:2210.07154).
+
+Multi-start MLE is the repo's end-to-end wall-clock bottleneck (BASELINE
+config 2).  This module trains a small JAX-native surrogate ONCE on simulated
+``(panel → untransformed-params)`` pairs and then maps an observed panel to a
+parameter estimate in a single jitted forward pass — the amortized point
+(plus a few jittered neighbors) replaces most of the S-start spray, and the
+existing coarse-LBFGS → trust-region-Newton cascade (docs/DESIGN.md §17)
+fine-tunes to tolerance.  Three pieces:
+
+- **Training-data pipeline** (``_jitted_sim_batch``): parameter draws from a
+  Gaussian prior in UNCONSTRAINED space (every draw is feasible by
+  construction — the transforms own the constraints) are pushed through
+  ``models/simulate.py`` as ONE vmapped compile-once program, draw axis LAST
+  per the lane rule.  The draw matrix is DONATED and flows back out as the
+  ``raw`` output (the lattice's pass-through aliasing invariant,
+  docs/DESIGN.md §14), so recurring rounds are alloc-light.  A draw whose
+  simulation fails (non-stationary Φ → Cholesky breakdown) yields a NaN
+  panel — a coded training sample, never an exception (YFM001).
+- **Summary network + head** (``_forward_core``): a permutation/length-robust
+  deep-set over the panel's time axis — a shared per-step MLP over
+  ``(yₜ, Δyₜ)`` pairs, mean/second-moment pooled over VALID columns (a
+  column with any non-finite entry is masked; masked counts normalize, so
+  the same weights serve any T), concatenated with per-maturity panel
+  moments, then a two-layer MLP head onto the raw parameter vector.  Pure
+  pytree params, f64-safe, batch on the trailing axis throughout.  An
+  all-invalid panel pools 0/0 → a NaN prediction — the sentinel downstream
+  consumers test for.
+- **Adam training loop** (``_jitted_train_step``): masked MSE on raw params
+  over the whole lane batch; a sample whose panel (or prediction) is
+  non-finite gets weight zero — bad simulated panels are masked, never
+  raised.  ``params``/``opt_state`` are donated (consumed and returned), so
+  a training round allocates nothing but the loss scalar.
+
+Consumption surfaces: ``optimize.estimate``/``estimate_steps``/
+``estimate_windows`` and ``scenario.refit_column`` accept ``warm_start=``
+(None defers to the ``YFM_AMORT`` env knob against the process-wide
+:func:`register_amortizer` registry); the serving layer's ``refit`` verbs
+(``YieldCurveService.refit``, the gateways, ``ShardedStateStore.
+publish_refit``) ride :func:`amortized_refit` — forward pass + one polish
+step — for a request-path re-estimation.
+
+``YFM_AMORT`` unset (or ``warm_start=False``) is the historical estimation
+path bit-for-bit: no amortizer code runs beyond the env check.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from functools import lru_cache
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import make_trace_counter, register_engine_cache
+from ..models.specs import ModelSpec
+
+# trace counters (config.make_trace_counter): incremented INSIDE traced
+# bodies so they count actual (re)compilations — the no-recompile tests pin
+# them across repeated predict/train rounds
+trace_counts, note_trace, reset_trace_counts = make_trace_counter()
+
+
+# ---------------------------------------------------------------------------
+# configuration
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class AmortizerConfig:
+    """Static architecture/warm-start configuration (frozen + hashable — it
+    keys the jitted-program caches alongside the spec).
+
+    ``hidden``/``head`` size the per-step MLP and the head; ``n_warm`` is the
+    number of starts :meth:`Amortizer.starts` emits (the amortized point plus
+    ``n_warm − 1`` jittered neighbors); ``jitter`` scales the neighbors'
+    Gaussian perturbation in raw space; ``seed`` fixes initialization AND the
+    default start-jitter stream, so a warm-started estimation is
+    deterministic end to end (checkpoint resume stays bit-for-bit)."""
+
+    hidden: int = 32
+    head: int = 32
+    n_warm: int = 4
+    jitter: float = 0.02
+    seed: int = 0
+
+
+def n_features(cfg: AmortizerConfig, spec: ModelSpec) -> int:
+    """Pooled summary width: deep-set mean + second moment (2·hidden) plus
+    per-maturity panel mean/std (2·N)."""
+    return 2 * cfg.hidden + 2 * spec.N
+
+
+def init_params(cfg: AmortizerConfig, spec: ModelSpec, key) -> Dict:
+    """Fresh surrogate weights (pytree of ``spec.dtype`` arrays).
+
+    ``y_mu``/``y_sd``/``dy_sd`` are input-normalization constants — identity
+    until :func:`set_normalization` fits them to the first simulated batch;
+    they ride the pytree but are ``stop_gradient``-ed in the forward pass, so
+    Adam never moves them.  ``b3`` (the output bias) starts at zero and is
+    usually re-anchored to the prior mean by :func:`train_amortizer`, so an
+    undertrained surrogate degrades toward the prior point, not garbage."""
+    dtype = spec.dtype
+    N, P, H, H2 = spec.N, spec.n_params, cfg.hidden, cfg.head
+    F = n_features(cfg, spec)
+    k1, k2, k3 = jax.random.split(jnp.asarray(key), 3)
+
+    def glorot(k, shape):
+        lim = np.sqrt(6.0 / (shape[0] + shape[1]))
+        return jax.random.uniform(k, shape, dtype=dtype, minval=-lim,
+                                  maxval=lim)
+
+    return {
+        "y_mu": jnp.zeros((N,), dtype=dtype),
+        "y_sd": jnp.ones((N,), dtype=dtype),
+        "dy_sd": jnp.ones((N,), dtype=dtype),
+        "W1": glorot(k1, (H, 2 * N)),
+        "b1": jnp.zeros((H,), dtype=dtype),
+        "W2": glorot(k2, (H2, F)),
+        "b2": jnp.zeros((H2,), dtype=dtype),
+        "W3": glorot(k3, (P, H2)) * 0.1,
+        "Ws": jnp.zeros((P, F), dtype=dtype),
+        "b3": jnp.zeros((P,), dtype=dtype),
+    }
+
+
+def set_normalization(params: Dict, panels) -> Dict:
+    """Fit the input-normalization constants from a (N, T, B) panel batch
+    (host-side, driver layer): per-maturity mean/std of the valid yields and
+    std of their first differences.  Floors keep a degenerate batch from
+    planting zero divisors."""
+    Y = np.asarray(panels, dtype=np.float64)
+    finite = np.isfinite(Y)
+    Ysafe = np.where(finite, Y, np.nan)
+    with np.errstate(all="ignore"):
+        mu = np.nanmean(Ysafe, axis=(1, 2))
+        sd = np.nanstd(Ysafe, axis=(1, 2))
+        dsd = np.nanstd(Ysafe[:, 1:] - Ysafe[:, :-1], axis=(1, 2))
+    mu = np.where(np.isfinite(mu), mu, 0.0)
+    sd = np.where(np.isfinite(sd) & (sd > 1e-8), sd, 1.0)
+    dsd = np.where(np.isfinite(dsd) & (dsd > 1e-8), dsd, 1.0)
+    dtype = params["y_mu"].dtype
+    out = dict(params)
+    out["y_mu"] = jnp.asarray(mu, dtype=dtype)
+    out["y_sd"] = jnp.asarray(sd, dtype=dtype)
+    out["dy_sd"] = jnp.asarray(dsd, dtype=dtype)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the summary network + head (plain inlinable cores)
+# ---------------------------------------------------------------------------
+
+def _forward_core(cfg: AmortizerConfig, params: Dict, Y):
+    """Panel batch (N, T, B) → raw-parameter predictions (P, B).
+
+    Deep-set over time: shared per-step MLP on the normalized ``(yₜ, Δyₜ)``
+    pair, pooled by masked mean/second moment over the valid columns — the
+    same weights serve any panel length, and time-permutation of the
+    (yₜ₋₁, yₜ) pairs leaves the summary unchanged.  Masking: a column with
+    ANY non-finite entry is invalid; an all-invalid panel pools 0/0 and the
+    prediction comes out NaN (the sentinel contract — the driver layer
+    decides what to do, nothing raises here)."""
+    dtype = Y.dtype
+    sg = jax.lax.stop_gradient
+    y_mu = sg(params["y_mu"])[:, None, None]
+    y_sd = sg(params["y_sd"])[:, None, None]
+    dy_sd = sg(params["dy_sd"])[:, None, None]
+    finite = jnp.isfinite(Y)
+    valid = jnp.all(finite, axis=0)                       # (T, B)
+    Ysafe = jnp.where(finite, Y, 0.0)
+    Yn = (Ysafe - y_mu) / y_sd
+    # (yₜ, Δyₜ) pair features on the T−1 transition steps
+    pair_ok = (valid[1:] & valid[:-1]).astype(dtype)      # (T-1, B)
+    dY = (Ysafe[:, 1:] - Ysafe[:, :-1]) / dy_sd
+    X = jnp.concatenate([Yn[:, 1:], dY], axis=0)          # (2N, T-1, B)
+    X = jnp.where(pair_ok[None] > 0, X, 0.0)
+    H1 = jnp.tanh(jnp.einsum("hf,ftb->htb", params["W1"], X)
+                  + params["b1"][:, None, None])          # (H, T-1, B)
+    w = pair_ok[None]
+    cnt = jnp.sum(w, axis=1)                              # (1, B)
+    wv = valid.astype(dtype)[None]                        # (1, T, B)
+    cv = jnp.sum(wv, axis=1)
+    # SAFE denominators inside, sentinel only at the output: dividing by a
+    # zero count here would make the whole weight gradient NaN for every
+    # batch containing one dead panel (0/0 rides the chain rule), and the
+    # train step's NaN→0 guard would then silently freeze all the weights —
+    # measured: only the output bias trained.  The dead lanes are instead
+    # poisoned at the END via jnp.where, which keeps the NaN sentinel for
+    # consumers without contaminating the live lanes' gradients.
+    dead = (cnt < 0.5) | (cv < 0.5)                       # (1, B)
+    cnt_s = jnp.maximum(cnt, 1.0)
+    cv_s = jnp.maximum(cv, 1.0)
+    m1 = jnp.sum(H1 * w, axis=1) / cnt_s                  # (H, B)
+    m2 = jnp.sum(H1 * H1 * w, axis=1) / cnt_s
+    my = jnp.sum(Yn * wv, axis=1) / cv_s                  # (N, B)
+    sy = jnp.sqrt(jnp.maximum(
+        jnp.sum(Yn * Yn * wv, axis=1) / cv_s - my * my, 0.0))
+    Z = jnp.concatenate([m1, m2, my, sy], axis=0)         # (F, B)
+    # soft-clip the pooled summary at ±4 (features are ≈unit-scale after
+    # normalization): a near-unit-root draw's panel can sit tens of σ out,
+    # and an unbounded feature lets the linear head extrapolate wildly on
+    # exactly the panels it knows least about (measured: held-out MSE 5-11×
+    # the prior's before the clip, 0.6× after)
+    Z = 4.0 * jnp.tanh(Z / 4.0)
+    G = jnp.tanh(params["W2"] @ Z + params["b2"][:, None])
+    # head = nonlinear MLP + a zero-initialized LINEAR skip from the pooled
+    # summary: the linear regression component of panel → params (level
+    # curve → δ, curvature → λ) is learned in a few dozen Adam steps, the
+    # tanh path only has to model the residual interactions
+    out = params["W3"] @ G + params["Ws"] @ Z + params["b3"][:, None]
+    return jnp.where(dead, jnp.asarray(jnp.nan, dtype=dtype), out)
+
+
+def _loss_core(cfg: AmortizerConfig, params: Dict, Y, targets):
+    """Masked MSE on raw params over the lane batch: a sample whose panel
+    produced a NaN prediction (failed simulation / all-invalid columns) or
+    whose target is non-finite carries weight zero — bad simulated panels
+    are masked, never raised (YFM001).  The mask is applied by ``jnp.where``
+    BEFORE the square (double-where), so a masked sample's NaN cannot leak
+    into the gradient either."""
+    pred = _forward_core(cfg, params, Y)                  # (P, B)
+    ok = jnp.all(jnp.isfinite(pred), axis=0) \
+        & jnp.all(jnp.isfinite(targets), axis=0)          # (B,)
+    keep = ok[None] & jnp.isfinite(pred) & jnp.isfinite(targets)
+    err = jnp.where(keep, pred - jnp.where(keep, targets, 0.0), 0.0)
+    n = jnp.maximum(jnp.sum(ok.astype(Y.dtype)), 1.0)
+    return jnp.sum(err * err) / (n * targets.shape[0])
+
+
+# ---------------------------------------------------------------------------
+# jitted programs (compile-once; @register_engine_cache + @lru_cache)
+# ---------------------------------------------------------------------------
+
+@register_engine_cache
+@lru_cache(maxsize=16)
+def _jitted_sim_batch(spec: ModelSpec, T: int, B: int, donate: bool):
+    """The training-data program: raw parameter draws (P, B) + per-draw PRNG
+    keys → ``{"raw", "panels"}`` with panels (N, T, B), draw axis LAST (the
+    lane rule).  The draw matrix is DONATED and passes through as the
+    ``raw`` output (value-use + shape-matched alias — the scenario lattice's
+    donation invariant, docs/DESIGN.md §14), so each training round re-feeds
+    buffers instead of allocating; a failed simulation (Cholesky breakdown
+    on a non-stationary draw) yields a NaN panel, never an exception."""
+    from ..models.params import transform_params
+    from ..models.simulate import simulate
+
+    def run(raw, keys):
+        note_trace("sim")
+
+        def one(r, k):
+            cons = transform_params(spec, r)
+            return simulate(spec, cons, T, k)["data"]     # (N, T)
+
+        panels = jax.vmap(one, in_axes=(1, 0), out_axes=-1)(raw, keys)
+        return {"raw": raw, "panels": panels}
+
+    return jax.jit(run, donate_argnums=(0,) if donate else ())
+
+
+@register_engine_cache
+@lru_cache(maxsize=32)
+def _jitted_forward(cfg: AmortizerConfig, spec: ModelSpec, T: int, B: int):
+    """One surrogate forward pass over a (N, T, B) panel batch → (P, B) raw
+    predictions.  Keyed by (cfg, spec, T, B): serving refits at a fixed
+    history length reuse one executable; a new panel length retraces once."""
+    def run(params, Y):
+        note_trace("forward")
+        return _forward_core(cfg, params, Y)
+
+    return jax.jit(run)
+
+
+@register_engine_cache
+@lru_cache(maxsize=16)
+def _jitted_train_step(cfg: AmortizerConfig, spec: ModelSpec, T: int, B: int,
+                       lr: float):
+    """One Adam step over the whole lane batch.  ``params`` and ``opt_state``
+    are DONATED (consumed and returned updated — their values flow through
+    ``optax.apply_updates`` into the outputs), so the training loop's
+    recurring state reuses its allocations; non-finite gradients are zeroed
+    (the masked loss already excludes bad samples — this guards the
+    all-masked-batch edge where the loss itself is degenerate)."""
+    import optax
+
+    opt = optax.adam(lr)
+
+    def step(params, opt_state, Y, targets):
+        note_trace("train_step")
+        loss, grads = jax.value_and_grad(
+            lambda p: _loss_core(cfg, p, Y, targets))(params)
+        grads = jax.tree_util.tree_map(
+            lambda g: jnp.where(jnp.isfinite(g), g, 0.0), grads)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+# ---------------------------------------------------------------------------
+# the net's target space: steady-state parameterization of the δ block
+# ---------------------------------------------------------------------------
+#
+# The net does NOT regress the raw δ block directly.  δ's posterior noise is
+# dominated by the unknowable (Φ − Φ̄)·μ cross-term, and a componentwise
+# posterior mean (δ̂, Φ̂) is INCONSISTENT as a pair: the implied steady state
+# (I − Φ̂)⁻¹δ̂ amplifies δ̂'s residual ~10× and the predicted point lands
+# thousands of nats below even the prior mean (measured).  Training targets
+# therefore carry μ = (I − Φ)⁻¹δ in the δ slots (the steady state, directly
+# observable in the panel's level), and prediction reconstructs
+# δ̂ = (I − Φ̂)μ̂ — whatever Φ̂'s error, the PAIR is consistent with the
+# recovered steady state, which is what the likelihood rewards.
+
+
+def _phi_matrices(spec: ModelSpec, raw_BP: np.ndarray) -> np.ndarray:
+    """(B, P) raw → (B, Ms, Ms) constrained transition matrices (Kalman
+    layout: row-major Φ block, tanh on the diagonal)."""
+    from ..models.params import transform_params
+
+    lo_p, hi_p = spec.layout["phi"]
+    Ms = spec.state_dim
+    cons = np.asarray(jax.vmap(lambda r: transform_params(spec, r))(
+        jnp.asarray(raw_BP, dtype=jnp.float64)), dtype=np.float64)
+    return cons[:, lo_p:hi_p].reshape(-1, Ms, Ms)
+
+
+def net_targets(spec: ModelSpec, raw_PB: np.ndarray) -> np.ndarray:
+    """Raw draws (P, B) → net-space targets: δ slots replaced by the draw's
+    steady state μ = (I − Φ)⁻¹δ.  A draw whose (I − Φ) is singular gets NaN
+    μ — a masked training sample (weight zero in the loss), never an
+    error."""
+    raw = np.asarray(raw_PB, dtype=np.float64)
+    if not spec.is_kalman:
+        return raw
+    lo_d, hi_d = spec.layout["delta"]
+    Ms = spec.state_dim
+    Phi = _phi_matrices(spec, raw.T)                      # (B, Ms, Ms)
+    A = np.eye(Ms)[None] - Phi
+    delta = raw[lo_d:hi_d].T                              # (B, Ms)
+    mu = np.full_like(delta, np.nan)
+    for b in range(delta.shape[0]):
+        try:
+            mu[b] = np.linalg.solve(A[b], delta[b])
+        except np.linalg.LinAlgError:
+            pass  # NaN target row → masked sample
+    out = raw.copy()
+    out[lo_d:hi_d] = mu.T
+    return out
+
+
+def raw_from_net(spec: ModelSpec, net_BP: np.ndarray) -> np.ndarray:
+    """Net-space predictions (B, P) → raw parameter vectors: δ̂ = (I − Φ̂)μ̂
+    (no inverse — always well defined)."""
+    net = np.asarray(net_BP, dtype=np.float64)
+    if not spec.is_kalman:
+        return net
+    lo_d, hi_d = spec.layout["delta"]
+    Ms = spec.state_dim
+    Phi = _phi_matrices(spec, net)                        # Φ slots are raw Φ
+    mu = net[:, lo_d:hi_d]
+    delta = np.einsum("bij,bj->bi", np.eye(Ms)[None] - Phi, mu)
+    out = net.copy()
+    out[:, lo_d:hi_d] = delta  # δ transforms are identity: raw == constrained
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the trained surrogate
+# ---------------------------------------------------------------------------
+
+class Amortizer:
+    """A trained panel → raw-params surrogate for ONE model spec.
+
+    Holds the weight pytree plus the warm-start policy; prediction is a
+    single jitted forward pass (:meth:`predict_raw`), and :meth:`starts`
+    turns it into the (n_warm, P) start matrix the estimation layer consumes
+    — the amortized point first, jittered neighbors after, ``None`` when the
+    prediction is non-finite (the caller keeps its historical start spray:
+    sentinel in, historical behavior out)."""
+
+    def __init__(self, spec: ModelSpec, cfg: AmortizerConfig, params: Dict,
+                 info: Optional[Dict] = None):
+        self.spec = spec
+        self.cfg = cfg
+        self.params = params
+        self.info = dict(info or {})
+
+    # ---- prediction -------------------------------------------------------
+
+    def predict_raw_batch(self, panels) -> np.ndarray:
+        """(B, N, T) panels → (B, P) raw predictions (NaN rows = sentinel).
+
+        The forward pass emits NET-space vectors (δ slots carry the steady
+        state μ̂); :func:`raw_from_net` reconstructs the consistent
+        δ̂ = (I − Φ̂)μ̂ pair before anything downstream sees the vector."""
+        spec = self.spec
+        Y = jnp.asarray(panels, dtype=spec.dtype)
+        if Y.ndim != 3 or Y.shape[1] != spec.N:
+            raise ValueError(f"panels must be (B, N, T) with N={spec.N}; "
+                             f"got {tuple(Y.shape)}")
+        B, _, T = Y.shape
+        fn = _jitted_forward(self.cfg, spec, int(T), int(B))
+        out = np.asarray(fn(self.params, jnp.moveaxis(Y, 0, -1)),
+                         dtype=np.float64).T              # (B, P) net space
+        return raw_from_net(spec, out)
+
+    def predict_raw(self, data) -> np.ndarray:
+        """(N, T) panel → (P,) raw (unconstrained) prediction."""
+        return self.predict_raw_batch(np.asarray(data)[None])[0]
+
+    def predict(self, data) -> np.ndarray:
+        """(N, T) panel → constrained parameter vector (driver convenience;
+        non-finite raw predictions stay NaN through the transforms)."""
+        from ..models.params import transform_params
+
+        raw = self.predict_raw(data)
+        return np.asarray(transform_params(
+            self.spec, jnp.asarray(raw, dtype=self.spec.dtype)),
+            dtype=np.float64)
+
+    # ---- warm-start matrices ---------------------------------------------
+
+    def _jittered(self, raw0: np.ndarray, key) -> np.ndarray:
+        S = max(1, int(self.cfg.n_warm))
+        if key is None:
+            key = jax.random.PRNGKey(self.cfg.seed)
+        if S == 1:
+            return raw0[None]
+        # neighbors via the STRUCTURED prior sampler around the amortized
+        # point (Φ projected stationary, δ jittered in steady-state space)
+        # — a plain isotropic raw jitter lands most AFNS neighbors on the
+        # −Inf plateau (non-stationary Φ) where they are dead lanes
+        nb = sample_prior_raw(self.spec, raw0, S - 1, key,
+                              scale=self.cfg.jitter).T
+        return np.concatenate([raw0[None], nb], axis=0)
+
+    def starts(self, data, key=None) -> Optional[np.ndarray]:
+        """(N, T) panel → (n_warm, P) raw start matrix, or ``None`` when the
+        surrogate prediction is non-finite (caller falls back to its
+        historical start spray)."""
+        raw0 = self.predict_raw(np.asarray(data))
+        if not np.all(np.isfinite(raw0)):
+            return None
+        return self._jittered(raw0, key)
+
+    def starts_batch(self, panels, fallback_raw, key=None) -> np.ndarray:
+        """(R, N, T) panels → (R, n_warm, P) per-panel warm starts, one
+        batched forward pass for all R.  A panel whose prediction is
+        non-finite gets ``fallback_raw`` as its amortized point instead (the
+        per-row version of :meth:`starts`' None)."""
+        preds = self.predict_raw_batch(panels)            # (R, P)
+        fb = np.asarray(fallback_raw, dtype=np.float64).reshape(1, -1)
+        bad = ~np.all(np.isfinite(preds), axis=1)
+        preds = np.where(bad[:, None], fb, preds)
+        return np.stack([self._jittered(p, key) for p in preds], axis=0)
+
+
+# ---------------------------------------------------------------------------
+# training
+# ---------------------------------------------------------------------------
+
+def sample_prior_raw(spec: ModelSpec, base_raw, B: int, key,
+                     scale: float = 0.1) -> np.ndarray:
+    """(P, B) unconstrained prior draws around the base point.
+
+    Gaussian jitter in RAW space (the transforms make every draw feasible by
+    construction — the multi-start spray's trick), with two structural
+    adjustments for the Kalman families that keep the PRIOR PREDICTIVE sane:
+
+    - the transition block Φ gets 0.3·``scale``: its effect on the panel is
+      amplified through ``(I − Φ)⁻¹`` (≈10× at the stable points' 0.9
+      diagonal), and full-scale off-diagonal jitter swings panel levels by
+      hundreds — a prior predictive so dispersed that δ becomes statistically
+      INDEPENDENT of the panel (measured: corr(panel mean, δ) ≈ 0.001) and
+      no summary can amortize it;
+    - Φ draws are PROJECTED back inside the unit circle (ρ(Φ) ≥ 0.995 →
+      rescaled to 0.99): at a 0.98 base diagonal the stationarity margin is
+      0.02, and an unprojected off-diagonal jitter makes a large fraction
+      of draws non-stationary — NaN panels that waste training lanes (the
+      loss masks them) and poison held-out evaluation;
+    - δ is drawn in STEADY-STATE space: μ* = μ_base + ``scale``·max(1, |μ|)·ε
+      elementwise, then δ = (I − Φ_draw) μ* per draw — the panel's level
+      moves WITH the draw's δ at observable magnitude instead of being
+      hostage to the Φ draw.
+
+    Non-Kalman specs (and layouts without a (δ, Φ) block) keep the plain
+    isotropic jitter."""
+    base = np.asarray(base_raw, dtype=np.float64).reshape(-1)
+    key = jnp.asarray(key)
+    k1, k2 = jax.random.split(key)
+    noise = scale * np.asarray(
+        jax.random.normal(k1, (base.shape[0], B)), dtype=np.float64)
+    if not spec.is_kalman:
+        return base[:, None] + noise
+    from ..models.params import transform_params, untransform_params
+
+    lo_p, hi_p = spec.layout["phi"]
+    lo_d, hi_d = spec.layout["delta"]
+    Ms = spec.state_dim
+    noise[lo_p:hi_p] *= 0.3
+    draws = base[:, None] + noise
+    cons = np.array(jax.vmap(
+        lambda r: transform_params(spec, r))(
+            jnp.asarray(draws.T, dtype=jnp.float64)), dtype=np.float64)
+    # Kalman Φ is stored row-major (models/params.unpack_kalman)
+    Phi = cons[:, lo_p:hi_p].reshape(B, Ms, Ms)
+    rho = np.max(np.abs(np.linalg.eigvals(Phi)), axis=1)
+    shrink = np.where(rho >= 0.995, 0.99 / np.maximum(rho, 1e-12), 1.0)
+    Phi = Phi * shrink[:, None, None]
+    Phi0 = np.asarray(transform_params(
+        spec, jnp.asarray(base, dtype=jnp.float64)),
+        dtype=np.float64)[lo_p:hi_p].reshape(Ms, Ms)
+    mu0 = np.linalg.solve(np.eye(Ms) - Phi0, base[lo_d:hi_d])
+    eps = np.asarray(jax.random.normal(k2, (B, Ms)), dtype=np.float64)
+    mu = mu0[None] + scale * np.maximum(1.0, np.abs(mu0))[None] * eps
+    delta = np.einsum("bij,bj->bi", np.eye(Ms)[None] - Phi, mu)
+    cons[:, lo_p:hi_p] = Phi.reshape(B, -1)
+    cons[:, lo_d:hi_d] = delta
+    # back through the library's inverse bijections (the Φ diagonal rides
+    # R_TO_11 — hand-rolling its inverse here would drift from the spec)
+    return np.asarray(jax.vmap(
+        lambda c: untransform_params(spec, c))(
+            jnp.asarray(cons, dtype=jnp.float64)), dtype=np.float64).T
+
+
+def train_amortizer(spec: ModelSpec, base_params, T: int, *,
+                    cfg: Optional[AmortizerConfig] = None,
+                    n_rounds: int = 8, batch: int = 64,
+                    steps_per_round: int = 25, lr: float = 3e-3,
+                    prior_scale: float = 0.1, key=None) -> Amortizer:
+    """Train a surrogate ONCE for ``spec`` on simulated panels of length
+    ``T`` around ``base_params`` (constrained — e.g. a previously fitted
+    point or the shared stable test points).
+
+    Each round draws ``batch`` raw parameter vectors from the prior, pushes
+    them through the donated simulation program (fresh panels every round —
+    the net never sees a pair twice), and takes ``steps_per_round`` donated
+    Adam steps on the masked-MSE loss.  Everything is compile-once: one
+    simulation program + one train-step program for the whole run.  Returns
+    the trained :class:`Amortizer`; ``.info`` carries the loss trajectory
+    and the prior so benches can report the train-once cost honestly."""
+    if not spec.is_kalman:
+        raise ValueError(
+            f"train_amortizer needs a Kalman family (the simulator's "
+            f"generative model); {spec.family!r} has none")
+    from .optimize import _sanitize
+    from ..models.params import untransform_params
+
+    cfg = cfg if cfg is not None else AmortizerConfig()
+    if key is None:
+        key = jax.random.PRNGKey(cfg.seed)
+    key = jnp.asarray(key)
+    base_raw = _sanitize(np.asarray(untransform_params(
+        spec, jnp.asarray(np.asarray(base_params, dtype=np.float64).reshape(-1),
+                          dtype=spec.dtype)), dtype=np.float64))
+    key, k_init = jax.random.split(key)
+    params = init_params(cfg, spec, k_init)
+    # anchor the output bias at the prior mean IN NET SPACE (δ slots carry
+    # μ): the untrained net already predicts a feasible point, and training
+    # only has to learn the residual
+    base_net = net_targets(spec, base_raw[:, None])[:, 0]
+    params["b3"] = jnp.asarray(np.where(np.isfinite(base_net), base_net,
+                                        base_raw), dtype=spec.dtype)
+
+    sim = _jitted_sim_batch(spec, int(T), int(batch), True)
+    step = _jitted_train_step(cfg, spec, int(T), int(batch), float(lr))
+    opt_state = None
+    losses = []
+    for r in range(n_rounds):
+        key, k_draw, k_sim = jax.random.split(key, 3)
+        draws = sample_prior_raw(spec, base_raw, batch, k_draw,
+                                 scale=prior_scale)
+        out = sim(jnp.asarray(draws, dtype=spec.dtype),
+                  jax.random.split(k_sim, batch))
+        panels = out["panels"]
+        # net-space targets: δ slots → the draw's steady state (see the
+        # "target space" block above); NaN rows are masked samples
+        targets = jnp.asarray(net_targets(spec, np.asarray(out["raw"])),
+                              dtype=spec.dtype)
+        if r == 0:
+            # input normalization from the FIRST simulated batch (host-side,
+            # driver layer) — fixed for the rest of training and serving
+            params = set_normalization(params, np.asarray(panels))
+            import optax
+
+            opt_state = optax.adam(float(lr)).init(params)
+        for _ in range(steps_per_round):
+            params, opt_state, loss = step(params, opt_state, panels, targets)
+        losses.append(float(loss))
+    return Amortizer(spec, cfg, params,
+                     info={"losses": losses, "T": int(T),
+                           "prior_scale": float(prior_scale),
+                           "base_raw": base_raw, "n_rounds": int(n_rounds),
+                           "batch": int(batch),
+                           "steps_per_round": int(steps_per_round)})
+
+
+# ---------------------------------------------------------------------------
+# process-wide registry + the YFM_AMORT knob
+# ---------------------------------------------------------------------------
+
+_REG_LOCK = threading.Lock()
+_REGISTRY: Dict[ModelSpec, Amortizer] = {}
+
+
+def amortization_enabled() -> bool:
+    """``YFM_AMORT=1`` arms the amortized warm start for every estimation
+    entry whose caller leaves ``warm_start=None`` (default off — the
+    historical multi-start path, bit-for-bit)."""
+    return os.environ.get("YFM_AMORT", "0") not in ("0", "")
+
+
+def register_amortizer(am: Amortizer) -> Amortizer:
+    """Install a trained surrogate as the process-wide warm-start provider
+    for its spec (what ``YFM_AMORT=1`` / ``warm_start=True`` consult)."""
+    with _REG_LOCK:
+        _REGISTRY[am.spec] = am
+    return am
+
+
+def get_amortizer(spec: ModelSpec) -> Optional[Amortizer]:
+    with _REG_LOCK:
+        return _REGISTRY.get(spec)
+
+
+def clear_amortizers() -> None:
+    with _REG_LOCK:
+        _REGISTRY.clear()
+
+
+# ---------------------------------------------------------------------------
+# the one-forward-pass refit (the serving layer's entry)
+# ---------------------------------------------------------------------------
+
+def amortized_refit(spec: ModelSpec, data, *, amortizer: Optional[Amortizer]
+                    = None, polish_iters: int = 1, g_tol: float = 1e-6,
+                    f_abstol: float = 1e-8, mode: str = "fisher"):
+    """One amortized re-estimation: surrogate forward pass + ``polish_iters``
+    trust-region Newton steps (ops/newton.py through the cached polish
+    program) — the millisecond-refit primitive behind the serving layer's
+    ``refit`` verbs.
+
+    Returns ``(raw_params (P,), loglik)``; ``(None, -inf)`` when the
+    surrogate prediction is non-finite (sentinel — the caller owns the
+    degrade policy).  ``polish_iters=0`` skips the polish and just evaluates
+    the predicted point."""
+    am = amortizer if amortizer is not None else get_amortizer(spec)
+    if am is None:
+        raise ValueError(
+            f"no trained amortizer registered for {spec.model_string!r} — "
+            f"train one (estimation.amortize.train_amortizer) and "
+            f"register_amortizer() it, or pass amortizer=")
+    data = jnp.asarray(data, dtype=spec.dtype)
+    T = int(data.shape[1])
+    raw0 = am.predict_raw(np.asarray(data))
+    if not np.all(np.isfinite(raw0)):
+        return None, float("-inf")
+    from .optimize import _jitted_loss, _jitted_newton_polish
+    from ..models.params import transform_params
+
+    if polish_iters > 0:
+        runner = _jitted_newton_polish(spec, T, int(polish_iters), g_tol,
+                                       f_abstol, mode)
+        res = runner(jnp.asarray(raw0[None], dtype=spec.dtype), data,
+                     jnp.asarray(0), jnp.asarray(T))
+        took = bool(np.asarray(res.iters)[0] > 0) \
+            or bool(np.asarray(res.converged)[0])
+        f = float(np.asarray(res.f)[0])
+        if took and np.isfinite(f):
+            return np.asarray(res.x, dtype=np.float64)[0], -f
+    ll = float(_jitted_loss(spec, T)(
+        transform_params(spec, jnp.asarray(raw0, dtype=spec.dtype)), data,
+        jnp.asarray(0), jnp.asarray(T)))
+    return raw0, ll

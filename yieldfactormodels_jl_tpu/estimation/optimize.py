@@ -402,6 +402,16 @@ def try_initializations(spec: ModelSpec, best_params, data, max_tries: int = 0,
 #: reaches −2.1k), and no polish can recover a basin never reached.
 _NEWTON_COARSE_ITERS = 80
 _NEWTON_COARSE_G_TOL = 1e-4
+#: coarse budget when the start matrix came from the AMORTIZED surrogate
+#: (docs/DESIGN.md §20): a token first-order cleanup only.  The coarse
+#: phase's job — reach the basin — is already done by the forward pass, and
+#: MORE first-order iterations from a warm point are actively harmful on
+#: the razor-thin AFNS surface: the backtracking L-BFGS's non-Armijo
+#: fallback step at a huge-gradient point can catapult the iterate six
+#: orders of magnitude uphill (measured: ll +10.6k → −6.6e6 in 30 coarse
+#: iters, which the trust-region polish then had to claw back).  The
+#: polish's radius control is the right tool from a warm point.
+_AMORT_COARSE_ITERS = 5
 #: polish-phase budget: outer trust-region iterations and the per-iteration
 #: Steihaug CG (= HVP) cap
 _NEWTON_POLISH_ITERS = 40
@@ -432,6 +442,67 @@ def _resolve_second_order(second_order) -> str:
                          f"pick from {_config.NEWTON_ENGINES} (or "
                          f"True/False)")
     return second_order
+
+
+def _resolve_warm_start(spec: ModelSpec, warm_start):
+    """The amortized warm-start switch → an ``amortize.Amortizer`` or None.
+
+    ``warm_start=None`` (the default everywhere) defers to the ``YFM_AMORT``
+    env knob against the process-wide registry (docs/DESIGN.md §20);
+    ``False`` is the historical multi-start path bit-for-bit (no amortizer
+    code runs beyond this check); ``True`` consults the registry per call;
+    an :class:`~.amortize.Amortizer` instance is used directly.  A knob or
+    ``True`` with no surrogate registered for THIS spec quietly resolves to
+    None — arming the knob process-wide must not break specs nobody
+    trained."""
+    if warm_start is False:
+        return None
+    if warm_start is None:
+        if os.environ.get("YFM_AMORT", "0") in ("0", ""):
+            return None
+        warm_start = True
+    if warm_start is True:
+        from . import amortize as _amortize
+
+        return _amortize.get_amortizer(spec)
+    return warm_start
+
+
+def _warm_start_matrix(am, data, raw, key=None):
+    """Replace most of the S-start spray with the surrogate's warm starts:
+    the amortized point + jittered neighbors, plus the caller's FIRST start
+    as the anchor row (so a mistrained surrogate can never do worse than a
+    single-start run from the canonical init).  Returns ``(raw', origin)``
+    with ``origin`` marking amortizer-born rows for the report's phase tags;
+    a non-finite surrogate prediction keeps the historical spray untouched
+    (sentinel in, historical behavior out)."""
+    warm = am.starts(np.asarray(data), key=key)
+    if warm is None:
+        return raw, np.zeros(raw.shape[0], dtype=bool)
+    warm = np.asarray(warm, dtype=np.float64)
+    out = np.concatenate([warm, raw[:1]], axis=0)
+    return out, np.concatenate([np.ones(warm.shape[0], dtype=bool),
+                                np.zeros(1, dtype=bool)])
+
+
+def _tag_amortized(phase, origin):
+    """Phase labels for amortizer-born rows: ``"amortized"`` (first-order)
+    or ``"amortized+<phase>"`` — consumers test membership ("newton" in p),
+    so the cascade's own labels stay visible."""
+    return [(("amortized" if p == "lbfgs" else f"amortized+{p}")
+             if origin[i] else p) for i, p in enumerate(phase)]
+
+
+def resolve_estimation_env() -> Dict:
+    """The estimation-cascade env knobs resolved into EXPLICIT ``estimate()``
+    kwargs: ``{"second_order": <engine or False>, "warm_start": <bool>}`` —
+    exactly what the ``None`` defaults would do.  The perf ledger
+    (benchmarks/run_all.py config 2) and bench.py's opt-in estimation benches
+    share THIS resolution (via ``benchmarks/common.estimation_env_kwargs``),
+    so the ledger can never measure a different cascade than the headline."""
+    so = _resolve_second_order(None)
+    return {"second_order": so if so else False,
+            "warm_start": os.environ.get("YFM_AMORT", "0") not in ("0", "")}
 
 
 @register_engine_cache
@@ -622,7 +693,7 @@ def _jitted_multistart_lbfgs(spec: ModelSpec, T: int, max_iters: int,
 def estimate(spec: ModelSpec, data, all_params, start=0, end=None,
              max_iters: int = 1000, g_tol: float = 1e-6, f_abstol: float = 1e-6,
              printing: bool = False, objective: str = "auto",
-             second_order=None):
+             second_order=None, warm_start=None):
     """Multi-start LBFGS MLE.  ``all_params``: (P, S) constrained starts.
 
     All S starts run simultaneously — either as a vmapped per-start LBFGS
@@ -648,6 +719,13 @@ def estimate(spec: ModelSpec, data, all_params, start=0, end=None,
     entry keeps its first-order point, and the escalation ladder
     (``YFM_ESCALATE=1``) rescues it exactly as before.
 
+    ``warm_start`` arms the amortized warm start (docs/DESIGN.md §20): the
+    surrogate's one-forward-pass estimate (plus jittered neighbors and the
+    caller's first start as anchor) replaces the S-start spray, and the
+    phases above fine-tune it — report rows carry the ``"amortized"`` tag.
+    ``None`` defers to ``YFM_AMORT``; ``False`` is the historical spray
+    bit-for-bit.
+
     Returns (init_params, ll, best_params, Convergence(converged, iterations))
     like the reference's estimate! — the last element carries the *actual*
     optimizer exit state (optimization.jl:375-407), not a placeholder.
@@ -662,11 +740,23 @@ def estimate(spec: ModelSpec, data, all_params, start=0, end=None,
     raw = np.stack(
         [_sanitize(np.asarray(untransform_params(spec, c))) for c in all_params.T], axis=0
     )  # (S, P)
+    warm_origin = np.zeros(raw.shape[0], dtype=bool)
+    am = _resolve_warm_start(spec, warm_start)
+    if am is not None:
+        # the surrogate conditions on the ESTIMATION WINDOW only — feeding
+        # the full panel would leak future columns into the warm start of a
+        # rolling out-of-sample window (the forward pass is length-robust,
+        # so the sliced panel is a first-class input)
+        raw, warm_origin = _warm_start_matrix(
+            am, np.asarray(data)[:, int(start):int(end)], raw)
     kind = _resolve_objective(spec, objective)
     so_mode = _resolve_second_order(second_order)
     if so_mode:
-        # phase-1 budget: coarse iterations to the basin only
-        p1_iters = min(max_iters, _NEWTON_COARSE_ITERS)
+        # phase-1 budget: coarse iterations to the basin only (shorter still
+        # when the surrogate already placed the starts in the basin)
+        coarse = _AMORT_COARSE_ITERS if warm_origin.any() \
+            else _NEWTON_COARSE_ITERS
+        p1_iters = min(max_iters, coarse)
         p1_g_tol = max(g_tol, _NEWTON_COARSE_G_TOL)
         p1_f_abstol = f_abstol
     else:
@@ -698,6 +788,7 @@ def estimate(spec: ModelSpec, data, all_params, start=0, end=None,
         phase = ["newton" if n_took[i] else "lbfgs"
                  for i in range(fs.shape[0])]
         newton_counters = {"iters": n_it, "cg_iters": n_cg, "code": n_code}
+    phase = _tag_amortized(phase, warm_origin)
     lls = -fs
     traces = []
     recovered = np.zeros(lls.shape[0], dtype=bool)
@@ -715,7 +806,7 @@ def estimate(spec: ModelSpec, data, all_params, start=0, end=None,
         lls = np.where(recovered, dead, lls)
         fs = np.where(recovered, -dead, fs)
     j = int(np.nanargmax(np.where(np.isfinite(lls), lls, -np.inf)))
-    if kind == "fused" and not recovered[j] and phase[j] != "newton":
+    if kind == "fused" and not recovered[j] and "newton" not in phase[j]:
         # trust-but-verify the kernel-reported optimum: ONE scan-engine eval
         # of the winner.  Motivated by the round-3 window-1 anomaly (device
         # config-2 optimum collapsed 16,100 → −30,278 with the restructured
@@ -733,7 +824,8 @@ def estimate(spec: ModelSpec, data, all_params, start=0, end=None,
             if _fused_check_mode() == "fallback":
                 return estimate(spec, data, all_params, start, end, max_iters,
                                 g_tol, f_abstol, printing, objective="vmap",
-                                second_order=second_order)
+                                second_order=second_order,
+                                warm_start=warm_start)
     for t in traces:
         if t.recovered:
             phase[t.start] = f"ladder:{t.rung}"
@@ -977,7 +1069,7 @@ def estimate_steps(spec: ModelSpec, data, all_params, param_groups: Sequence[str
                    optimizers: Optional[Dict[str, Tuple[str, dict]]] = None,
                    start=0, end=None, max_tries: int = 0, printing: bool = False,
                    _force_scan: bool = False, checkpoint=None,
-                   second_order=None):
+                   second_order=None, warm_start=None):
     """Block-coordinate estimation over parameter groups.
 
     Faithful to the reference control flow: improved initializations for the
@@ -995,6 +1087,11 @@ def estimate_steps(spec: ModelSpec, data, all_params, param_groups: Sequence[str
     (docs/DESIGN.md §17; non-Kalman families ride the family-generic
     "exact" HVP recursion).  A polished start is accepted only when its
     re-evaluated loglik improves, so the cascade's monotonicity survives.
+
+    ``warm_start`` (None = defer to ``YFM_AMORT``, as in :func:`estimate`)
+    replaces the initialization spray with the amortized surrogate's warm
+    starts + the caller's first start as anchor (docs/DESIGN.md §20); the
+    warm columns' report rows carry the ``"amortized"`` phase tag.
 
     ``checkpoint`` (an ``orchestration.checkpoint.WindowCheckpoint``):
     persists the full lockstep state after every group iteration and, on a
@@ -1014,6 +1111,11 @@ def estimate_steps(spec: ModelSpec, data, all_params, param_groups: Sequence[str
     all_params = np.asarray(all_params, dtype=np.float64)
     if all_params.ndim == 1:
         all_params = all_params[:, None]
+    # the CALLER's start matrix, before the warm-start/init machinery
+    # mutates all_params: the fused-fallback recursion below must restart
+    # from this, or the re-run's "anchor" would be the amortized point
+    # instead of the canonical init
+    caller_params = all_params
 
     _loss = _jitted_loss(spec, T)
     _start_j, _end_j = jnp.asarray(start), jnp.asarray(end)
@@ -1022,6 +1124,10 @@ def estimate_steps(spec: ModelSpec, data, all_params, param_groups: Sequence[str
         return _loss(transform_params(spec, p), data, _start_j, _end_j)
 
     use_ssd = _ssd_kernel_enabled(spec) and not _force_scan
+    # resolved BEFORE the checkpoint signature: a warm-started cascade and a
+    # historical one follow different trajectories, and a resumed checkpoint
+    # from the other mode would silently splice them
+    am = _resolve_warm_start(spec, warm_start)
     sig = None
     state = None
     if checkpoint is not None:
@@ -1039,9 +1145,12 @@ def estimate_steps(spec: ModelSpec, data, all_params, param_groups: Sequence[str
                    max_group_iters=int(max_group_iters),
                    max_tries=int(max_tries), P=int(all_params.shape[0]),
                    init=init_digest,
-                   engine="ssd" if use_ssd else "scan")
+                   engine="ssd" if use_ssd else "scan",
+                   warm="1" if am is not None else "0")
         state = checkpoint.load(sig)
+    n_warm_cols = 0
     if state is not None:
+        n_warm_cols = int(state.get("n_warm", 0))
         raw = np.asarray(state["raw"], dtype=np.float64)       # (P, S)
         X = jnp.asarray(state["X"])                            # (S, P)
         prev_ll = np.asarray(state["prev_ll"], dtype=np.float64)
@@ -1052,10 +1161,24 @@ def estimate_steps(spec: ModelSpec, data, all_params, param_groups: Sequence[str
         it0 = int(state["next_it"])
         first_group_of_run = False  # ≥1 iteration completed before the save
     else:
-        all_params = try_initializations(spec, all_params[:, 0], data,
-                                         max_tries=max_tries,
-                                         start=start, end=end,
-                                         _force_scan=_force_scan)
+        # window-sliced for the same future-leak reason as estimate()
+        warm_raw = am.starts(np.asarray(data)[:, int(start):int(end)]) \
+            if am is not None else None
+        if warm_raw is not None:
+            # the amortized point + neighbors replace the init spray (the
+            # caller's first start stays as the anchor column); the warm
+            # rows are deterministic (Amortizer.starts' fixed key), so a
+            # checkpoint resume replays them bit-for-bit
+            cols = [np.asarray(transform_params(
+                spec, jnp.asarray(w, dtype=spec.dtype)), dtype=np.float64)
+                for w in np.asarray(warm_raw, dtype=np.float64)]
+            all_params = np.stack(cols + [all_params[:, 0]], axis=1)
+            n_warm_cols = len(cols)
+        else:
+            all_params = try_initializations(spec, all_params[:, 0], data,
+                                             max_tries=max_tries,
+                                             start=start, end=end,
+                                             _force_scan=_force_scan)
         raw = np.stack(
             [_sanitize(np.asarray(untransform_params(spec, jnp.asarray(c))))
              for c in all_params.T],
@@ -1151,7 +1274,7 @@ def estimate_steps(spec: ModelSpec, data, all_params, param_groups: Sequence[str
             checkpoint.save(sig, dict(
                 raw=raw, X=np.asarray(X), prev_ll=prev_ll, done=done,
                 converged=converged, iters_done=iters_done, ll0=ll0,
-                next_it=it + 1))
+                next_it=it + 1, n_warm=n_warm_cols))
         _chaos.maybe_fail("estimate")
     if printing:
         for j in range(S):
@@ -1224,12 +1347,15 @@ def estimate_steps(spec: ModelSpec, data, all_params, param_groups: Sequence[str
                 # keep checkpointing through the scan re-run: its signature
                 # carries engine="scan", so it ignores the fused state and
                 # overwrites the file with its own resumable progress
-                return estimate_steps(spec, data, all_params, param_groups,
+                return estimate_steps(spec, data, caller_params, param_groups,
                                       max_group_iters, tol, optimizers,
                                       start, end, max_tries, printing,
                                       _force_scan=True, checkpoint=checkpoint,
-                                      second_order=second_order)
+                                      second_order=second_order,
+                                      warm_start=warm_start)
     phase = ["newton" if newton_took[j] else "lbfgs" for j in range(S)]
+    phase = _tag_amortized(
+        phase, np.arange(S) < n_warm_cols)  # warm cols lead, anchor is last
     for t in ladder_traces:
         if t.recovered:
             phase[t.start] = f"ladder:{t.rung}"
@@ -1276,7 +1402,8 @@ def _jitted_fused_windows(spec: ModelSpec, T: int, max_iters: int,
 
 def estimate_windows(spec: ModelSpec, data, raw_starts, window_starts, window_ends,
                      max_iters: int = 1000, g_tol: float = 1e-6, f_abstol: float = 1e-6,
-                     objective: str = "auto", second_order=None):
+                     objective: str = "auto", second_order=None,
+                     warm_start=None):
     """Re-estimate over W rolling windows × S starts in ONE jitted program.
 
     Masked windows are exactly equivalent to truncation (see models/kalman.py
@@ -1291,11 +1418,21 @@ def estimate_windows(spec: ModelSpec, data, raw_starts, window_starts, window_en
     coarse budget, then ONE window-vmapped trust-region Newton-CG program
     polishes every (window, start) cell to the caller's tolerances.
 
+    ``warm_start`` (None = defer to ``YFM_AMORT``): one surrogate forward
+    pass on the FULL panel replaces the shared start spray with the
+    amortized point + neighbors (+ the caller's first start as anchor) for
+    every window — the windows share starts exactly as before, just better
+    ones (docs/DESIGN.md §20).
+
     Returns (params (W, S, P) unconstrained, logliks (W, S)) — higher is
     better; pick per-window starts with argmax.
     """
     data = jnp.asarray(data, dtype=spec.dtype)
     T = data.shape[1]
+    am = _resolve_warm_start(spec, warm_start)
+    if am is not None:
+        raw_np = np.asarray(raw_starts, dtype=np.float64)
+        raw_starts, _ = _warm_start_matrix(am, data, raw_np)
     kind = _resolve_objective(spec, objective)
     so_mode = _resolve_second_order(second_order)
     if so_mode:
@@ -1352,7 +1489,8 @@ def estimate_windows(spec: ModelSpec, data, raw_starts, window_starts, window_en
                 return estimate_windows(spec, data, raw_starts, window_starts,
                                         window_ends, max_iters, g_tol,
                                         f_abstol, objective="vmap",
-                                        second_order=second_order)
+                                        second_order=second_order,
+                                        warm_start=False)
         return xs.reshape(W, S, Pn), lls
     runner = _jitted_window_multistart(spec, T, *p1)
     xs, fs, its, convs = runner(

@@ -476,6 +476,25 @@ def _jitted_refit_column(spec: ModelSpec, T: int, max_iters: int,
 
 @register_engine_cache
 @lru_cache(maxsize=16)
+def _jitted_refit_column_warm(spec: ModelSpec, T: int, max_iters: int,
+                              g_tol: float, f_abstol: float):
+    """The amortized-warm-start twin of :func:`_jitted_refit_column`: each
+    resample brings its OWN start matrix (the surrogate's per-panel warm
+    starts, docs/DESIGN.md §20), so the start axis is vmapped per resample
+    instead of shared — X0 is (R, S, P) rather than (S, P)."""
+    from .optimize import _finite_objective, _run_lbfgs
+
+    def single(x0, panel):
+        fun = lambda p: _finite_objective(spec, panel, p, 0, T)
+        return _run_lbfgs(fun, x0, max_iters, g_tol, f_abstol)
+
+    over_starts = jax.vmap(single, in_axes=(0, None))      # starts
+    over_resamples = jax.vmap(over_starts, in_axes=(0, 0))  # resamples
+    return jax.jit(over_resamples)
+
+
+@register_engine_cache
+@lru_cache(maxsize=16)
 def _jitted_refit_polish(spec: ModelSpec, T: int, max_iters: int,
                          g_tol: float, f_abstol: float, mode: str):
     """Resample-vmapped trust-region Newton-CG polish for the refit column
@@ -491,7 +510,7 @@ def _jitted_refit_polish(spec: ModelSpec, T: int, max_iters: int,
 
 def refit_column(spec: ModelSpec, data, resample_idx, raw_starts, *,
                  max_iters: int = 100, g_tol: float = 1e-6,
-                 f_abstol: float = 1e-6, second_order=None):
+                 f_abstol: float = 1e-6, second_order=None, warm_start=None):
     """Re-ESTIMATE the model on every bootstrap resample — the lattice's
     refit column (parameter-uncertainty CIs, vs the fixed-parameter loss
     plane ``evaluate_lattice`` evaluates).
@@ -501,14 +520,20 @@ def refit_column(spec: ModelSpec, data, resample_idx, raw_starts, *,
     ``raw_starts`` (S, P) unconstrained starts shared by every resample.
     All R×S optimizations run as one jitted program; ``second_order``
     (None = the ``YFM_NEWTON`` knob, as in ``optimize.estimate``) arms the
-    coarse-LBFGS → Newton-polish cascade per resample.
+    coarse-LBFGS → Newton-polish cascade per resample.  ``warm_start``
+    (None = the ``YFM_AMORT`` knob) replaces the shared spray with
+    PER-RESAMPLE amortized starts: ONE batched surrogate forward pass over
+    all R resampled panels, each resample's amortized point + jittered
+    neighbors (+ the caller's first start as anchor) — the warm twin
+    program vmaps the start axis per resample (docs/DESIGN.md §20).
 
     Returns ``(params (R, S, P) unconstrained, logliks (R, S))`` — pick
     per-resample winners with argmax, same contract as
     ``optimize.estimate_windows``.
     """
     from .optimize import (_NEWTON_COARSE_G_TOL, _NEWTON_COARSE_ITERS,
-                           _NEWTON_POLISH_ITERS, _resolve_second_order)
+                           _NEWTON_POLISH_ITERS, _resolve_second_order,
+                           _resolve_warm_start)
 
     data = jnp.asarray(data, dtype=spec.dtype)
     T = data.shape[1]
@@ -524,7 +549,17 @@ def refit_column(spec: ModelSpec, data, resample_idx, raw_starts, *,
               max(g_tol, _NEWTON_COARSE_G_TOL), f_abstol)
     else:
         p1 = (max_iters, g_tol, f_abstol)
-    runner = _jitted_refit_column(spec, T, *p1)
+    am = _resolve_warm_start(spec, warm_start)
+    if am is not None:
+        raw_np = np.asarray(raw_starts, dtype=np.float64)
+        R = int(panels.shape[0])
+        warm = am.starts_batch(np.asarray(panels), fallback_raw=raw_np[0])
+        anchor = np.broadcast_to(raw_np[None, :1], (R, 1, raw_np.shape[1]))
+        X0 = jnp.asarray(np.concatenate([warm, anchor], axis=1),
+                         dtype=spec.dtype)               # (R, S_w, P)
+        runner = _jitted_refit_column_warm(spec, T, *p1)
+    else:
+        runner = _jitted_refit_column(spec, T, *p1)
     xs, fs, its, convs = runner(X0, panels)
     if so_mode:
         polish = _jitted_refit_polish(spec, T, _NEWTON_POLISH_ITERS,

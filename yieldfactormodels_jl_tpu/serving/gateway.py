@@ -120,6 +120,7 @@ class ServingGateway:
         self._results: Dict[int, dict] = {}
         self._next_ticket = 0
         self._flush_cost = 0.0      # EWMA seconds of one pump's batched flush
+        self._refit_cost = 0.0      # EWMA seconds of one amortized refit
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._worker: Optional[threading.Thread] = None
@@ -385,6 +386,67 @@ class ServingGateway:
         self._flush_cost = elapsed if self._flush_cost == 0.0 \
             else 0.8 * self._flush_cost + 0.2 * elapsed
 
+    # ---- amortized refit (docs/DESIGN.md §20) ------------------------------
+
+    def _refit_within_deadline(self, kind, deadline_ms, degraded_fn, run_fn):
+        """Deadline budget for the refit verb, same machinery as batch
+        formation (DESIGN §12): the measured EWMA cost of past refits is
+        checked against the caller's budget BEFORE the work starts — an
+        unmeetable refit is answered immediately from the last-good state,
+        stale-flagged, instead of blowing the deadline; the estimate decays
+        (×0.5) on every degraded answer so one compile outlier cannot lock
+        permanent degradation."""
+        dl = self.deadline_ms if deadline_ms is None else float(deadline_ms)
+        # the whole verb runs under the PUMP lock: the service/store has no
+        # internal locks — every other state-mutating verb is serialized
+        # through the queue + _pump_locked, and an unserialized refit racing
+        # a flushing update would tear the (snapshot, state, bank) triple.
+        # The lock wait itself counts against the measured cost (honest: a
+        # busy gateway's refits ARE that slow), and the EWMA read-modify-
+        # write rides the same lock.
+        with self._pump_lock:
+            if dl and self._refit_cost and self._refit_cost * 1e3 > dl:
+                self._refit_cost = 0.5 * self._refit_cost
+                return degraded_fn(
+                    f"refit cost ~{self._refit_cost * 2e3:.0f} ms exceeds "
+                    f"the {dl:.0f} ms deadline")
+            t0 = self._clock()
+            out = run_fn()
+            elapsed = self._clock() - t0
+            self._refit_cost = elapsed if self._refit_cost == 0.0 \
+                else 0.8 * self._refit_cost + 0.2 * elapsed
+            return out
+
+    def refit(self, history, deadline_ms: Optional[float] = None, *,
+              amortizer=None, polish_iters: int = 1, date=None) -> dict:
+        """Request-path re-estimation: the amortized surrogate's forward
+        pass + one Newton polish step + state rebuild, inside the deadline
+        budget (``YieldCurveService.refit`` does the work; this wrapper owns
+        the §12 deadline/degrade accounting).  Returns the update-shaped
+        response dict — ``{"ll", "version", "stale"}`` fresh, or the
+        degraded last-good answer when the measured refit cost cannot make
+        the deadline."""
+        def run():
+            try:
+                ll = self.service.refit(history, amortizer=amortizer,
+                                        polish_iters=polish_iters, date=date)
+            except ServingError as e:
+                self.counters.errors += 1
+                return {"error": e}
+            if np.isfinite(ll):
+                self.counters.completed += 1
+                return {"kind": "refit", "ll": float(ll),
+                        "version": self.service.version,
+                        "stale": self.service.stale}
+            self.counters.degraded += 1
+            return {"kind": "refit", "ll": float(ll), "degraded": True,
+                    "stale": True, "version": self.service.version}
+
+        req = _Pending(-1, "refit", None, self._clock(), None)
+        return self._refit_within_deadline(
+            "refit", deadline_ms,
+            lambda reason: self._degraded_answer(req, reason), run)
+
     # ---- background worker -------------------------------------------------
 
     def start(self, poll_s: float = 0.005) -> "ServingGateway":
@@ -486,6 +548,54 @@ class ShardedGateway(ServingGateway):
     def _submit_read(self, req: _Pending) -> int:
         key, payload = req.payload
         return self.store.batcher.submit(self.store.snapshot_of(key), payload)
+
+    def refit(self, history, deadline_ms=None, *, key=None, amortizer=None,
+              polish_iters: int = 1, date=None) -> dict:
+        """Key-addressed amortized refit: surrogate forward pass + one
+        polish step (``estimation.amortize.amortized_refit``), published
+        STRAIGHT into the key's live slot through
+        ``store.publish_refit`` (ROADMAP 2c) — the state stays mesh-resident
+        and continuously servable.  Deadline semantics as the base gateway:
+        an unmeetable refit answers from THIS key's banked last-good
+        state."""
+        if key is None:
+            raise ServingError("refit", "sharded refits need key= (the "
+                               "(model_string, task_id) state address)")
+        store = self.store
+        spec = store.spec
+
+        def run():
+            from ..estimation import amortize as _amortize
+
+            try:
+                raw, ll = _amortize.amortized_refit(
+                    spec, history, amortizer=amortizer,
+                    polish_iters=polish_iters)
+            except ValueError as e:  # no trained amortizer registered
+                self.counters.errors += 1
+                return {"error": ServingError("refit", str(e), key=key)}
+            if raw is None:
+                # surrogate sentinel: keep the slot, answer degraded
+                return self._degraded_answer(
+                    req, "surrogate prediction is non-finite")
+            from ..models.params import transform_params
+            import jax.numpy as _jnp
+
+            params = np.asarray(transform_params(
+                spec, _jnp.asarray(raw, dtype=spec.dtype)))
+            try:
+                out = store.publish_refit(key, params, history=history,
+                                          beta=None, P=None)
+            except ServingError as e:
+                self.counters.errors += 1
+                return {"error": e}
+            self.counters.completed += 1
+            return {"kind": "refit", "key": key, "ll": float(ll), **out}
+
+        req = _Pending(-1, "refit", (key, None), self._clock(), None)
+        return self._refit_within_deadline(
+            "refit", deadline_ms,
+            lambda reason: self._degraded_answer(req, reason), run)
 
     def _degraded_answer(self, req: _Pending, reason: str) -> dict:
         key = req.payload[0]

@@ -491,6 +491,105 @@ class YieldCurveService:
         self._updates_since_refresh = 0
         return float(ll)
 
+    def refit(self, history, *, amortizer=None, polish_iters: int = 1,
+              date=None) -> float:
+        """Amortized re-ESTIMATION from raw history (docs/DESIGN.md §20):
+        one surrogate forward pass proposes fresh model parameters, one
+        trust-region Newton polish step (``ops/newton.py``) fine-tunes them,
+        and the O(log T) re-filter rebuilds the serving state UNDER THE NEW
+        PARAMETERS — "re-estimate this user's curve model" as a request-path
+        operation instead of a batch job.
+
+        ``history`` is the full (N, T) conditioning panel (the
+        :meth:`refilter` contract: whole columns with any NaN are treated as
+        unobserved).  ``amortizer`` defaults to the process-wide registry
+        entry for this spec (``estimation.amortize.register_amortizer``);
+        no registered surrogate is a structural error.  ``polish_iters=0``
+        serves the raw surrogate point (the absolute-latency floor).
+
+        On success the refit parameters AND the rebuilt state become the new
+        snapshot (version bumped, refresh cadence reset); the total history
+        loglik under the new parameters is returned.  A non-finite surrogate
+        prediction, a failed re-filter pass, or a rebuilt state that fails
+        the §11 health watch KEEPS the current parameters/state and runs the
+        standard degrade path (structured :class:`ServingError`, or
+        stale-flag + NaN under ``self_heal``)."""
+        spec = self.snapshot.spec
+        from .. import config as _config
+        from ..estimation import amortize as _amortize
+        from ..models.params import transform_params
+
+        am = amortizer if amortizer is not None \
+            else _amortize.get_amortizer(spec)
+        if am is None:
+            raise ServingError(
+                "refit", f"no trained amortizer registered for "
+                f"{spec.model_string!r} — train one "
+                f"(estimation.amortize.train_amortizer) and "
+                f"register_amortizer() it, or pass amortizer=",
+                model=spec.model_string)
+        if _config.tree_engine_for(spec) is None:
+            raise ServingError(
+                "refit", f"refit needs a Kalman family with a "
+                f"parallel-in-time engine (config.engines_for"
+                f"({spec.family!r}) = {_config.engines_for(spec)})",
+                model=spec.model_string)
+        Y = jnp.asarray(history, dtype=spec.dtype)
+        if Y.ndim != 2 or Y.shape[0] != spec.N:
+            raise ServingError(
+                "refit", f"history has shape {tuple(Y.shape)}, expected "
+                f"({spec.N}, T)", date=date)
+        with self.timer.stage("refit"):
+            raw, _ = _amortize.amortized_refit(spec, Y, amortizer=am,
+                                               polish_iters=polish_iters)
+            if raw is None:
+                self._degrade(
+                    "refit", tax.NAN_STATE,
+                    "surrogate prediction is non-finite — parameters kept "
+                    "at the last good version", date=date,
+                    version=self.version)
+                return float("nan")
+            new_params = jnp.asarray(np.asarray(transform_params(
+                spec, jnp.asarray(raw, dtype=spec.dtype))), dtype=spec.dtype)
+            runner = _jitted_refilter(spec, int(Y.shape[1]))
+            b, c, ll, ok, code = runner(new_params, Y)
+            ok = bool(ok)  # device sync: the driver decides, not the kernel
+            code = int(code)
+        if not ok:
+            self._degrade(
+                "refit", code,
+                f"re-filter under the refit parameters failed "
+                f"({tax.describe(code)}) — parameters kept at the last good "
+                f"version", date=date, version=self.version)
+            return float("nan")
+        h = rh.state_health(b, c, "univariate")  # (β, P) moments form
+        if h["code"] != tax.OK:
+            self._degrade(
+                "refit", h["code"],
+                f"refit state failed the health watch "
+                f"({tax.describe(h['code'])}) — parameters kept",
+                date=date, version=self.version)
+            return float("nan")
+        snap = dataclasses.replace(
+            self.snapshot, params=np.asarray(new_params)).advanced(b, c)
+        prev = (self.snapshot, self._state)
+        try:
+            self._set_snapshot(snap)  # sqrt engine re-factors P here
+        except ServingError:
+            self.snapshot, self._state = prev
+            self._degrade("refit", tax.NONPSD_COV,
+                          "refit covariance is not PSD under the serving "
+                          "engine's factorization — parameters kept",
+                          date=date, version=self.version)
+            return float("nan")
+        self._bank_last_good()
+        self.stale = False
+        self._last_code = code
+        if date is not None:
+            self.last_update = date
+        self._updates_since_refresh = 0
+        return float(ll)
+
     def forecast(self, h: int, quantiles: Optional[Tuple[float, ...]] = None
                  ) -> dict:
         """h-step predictive density from the current state: ``means``

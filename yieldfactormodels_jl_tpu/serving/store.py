@@ -354,6 +354,71 @@ class ShardedStateStore:
             self._bank.pop(key, None)
             self._stale.discard(key)
 
+    def publish_refit(self, key: Key, params, history=None, beta=None,
+                      P=None) -> dict:
+        """Publish an estimate-side refit STRAIGHT into the live slot
+        (ROADMAP 2c — the old path was evict → freeze → re-register): new
+        model parameters, optionally fresh filtered moments, one donated
+        ``_jitted_slot_write`` scatter — O(slot), the shard never gathered,
+        the key stays continuously servable (readers between the decision
+        and the write see the previous consistent state).
+
+        Moment source, in order: ``history`` (an (N, T) panel — the state is
+        rebuilt under the NEW params via the freeze filter, the
+        amortized-refit flow of docs/DESIGN.md §20), explicit ``(beta, P)``
+        (a caller who already filtered), or neither (the slot keeps its
+        resident moments — a pure parameter swap).  Structural failures
+        (unknown key, failed filter pass, non-PSD covariance) raise
+        :class:`ServingError` with the slot UNTOUCHED."""
+        with self._lock:
+            if key not in self._slot:
+                raise ServingError("store",
+                                   f"no state registered for {key}", key=key)
+            s, sl = self._slot[key]
+        p = np.asarray(params, dtype=np.float64).reshape(-1)
+        if p.shape[0] != self.spec.n_params:
+            raise ServingError(
+                "store", f"refit params have {p.shape[0]} entries, spec has "
+                f"{self.spec.n_params}", key=key)
+        cov = None
+        if history is not None:
+            from .snapshot import freeze_snapshot
+
+            snap = freeze_snapshot(self.spec, p, history)
+            beta, P = snap.beta, snap.P
+        if beta is not None:
+            # expensive work (filter pass, factorization) stays OUTSIDE the
+            # lock; the refit's history/moments are authoritative over any
+            # update that lands meanwhile (refit semantics)
+            try:
+                cov = np.asarray(factor_cov(P, self.engine, self.spec.dtype),
+                                 dtype=np.float64)
+            except ValueError:
+                raise ServingError("store", "refit covariance is not PSD — "
+                                   "cannot start the sqrt engine", key=key)
+            beta = np.asarray(beta, dtype=np.float64)
+        with self.timer.stage("refit_publish"):
+            with self._lock:
+                if self._slot.get(key) != (s, sl):  # evicted mid-flight
+                    raise ServingError(
+                        "store", f"{key} was evicted during the refit",
+                        key=key)
+                if beta is None:
+                    # pure parameter swap: the slot keeps its resident
+                    # moments — read UNDER the lock (an unlocked read could
+                    # tear against a concurrent update's slot write and pair
+                    # β from one version with cov from another), and reuse
+                    # the resident ENGINE representation as-is
+                    sh = self._shards[s]
+                    beta = np.asarray(sh["beta"][:, sl], dtype=np.float64)
+                    cov = np.asarray(sh["cov"][:, :, sl], dtype=np.float64)
+                meta = self._meta[key].bump()
+                self._write_state(s, sl, beta, cov, meta.version, params=p)
+                self._meta[key] = meta
+                self._bank[key] = (beta, cov)
+                self._stale.discard(key)
+        return {"key": key, "version": meta.version, "stale": False}
+
     def _rebuild_slot(self, key: Key, s: int, sl: int) -> None:
         """The §11 heal path at slot granularity: rewrite the slot from the
         banked last-good host copies, falling back to the frozen registry
