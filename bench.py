@@ -792,7 +792,10 @@ def _longt_line():
     NONLINEAR column (docs/DESIGN.md §19): the sequential TVλ EKF vs the
     iterated-SLR engine on single-chain value+grad at the same T grid, and
     the second-order tangent split (sequential vs tree-composed Fisher HVP
-    under the T-switch) at T = 5k.  Callable both in-process (TPU rounds)
+    under the T-switch) at T = 5k, and — unless ``BENCH_LONGT_MSED=0`` —
+    the SCORE-DRIVEN column: the sequential MSED scan vs the score-tree
+    engine (ops/score_scan.py) on single-chain value+grad at the same T
+    grid.  Callable both in-process (TPU rounds)
     and from the ``--longt-bench`` subprocess (CPU fallback rounds)."""
     import jax
     import jax.numpy as jnp
@@ -937,12 +940,48 @@ def _longt_line():
         except Exception as e:
             parts.append(f"newton-tangent failed ({type(e).__name__})")
 
+    # ---- score-driven (MSED) column: sequential scan vs score tree ----
+    msed_ratio_at_max = float("nan")
+    if os.environ.get("BENCH_LONGT_MSED", "1") not in ("0", ""):
+        try:
+            from tests.oracle import stable_msed_params
+            from yieldfactormodels_jl_tpu.models import score_driven as _sd
+            from yieldfactormodels_jl_tpu.ops import score_scan
+
+            mspec, _ = create_model("SD-NS", tuple(MATURITIES),
+                                    float_type="float32")
+            mparam = jnp.asarray(stable_msed_params(mspec, np.float32))
+        except Exception as e:
+            # same isolation contract as the TVλ setup above
+            parts.append(f"msed setup failed ({type(e).__name__})")
+            mspec = None
+        for T in Ts if mspec is not None else ():
+            try:
+                data = jnp.asarray(make_panel(seed=7, T=T),
+                                   dtype=mspec.dtype)
+                t_seq, v_seq = timed(jax.jit(jax.value_and_grad(
+                    lambda p: _sd.get_loss(mspec, p, data))), mparam)
+                t_tree, v_tree = timed(jax.jit(jax.value_and_grad(
+                    lambda p: score_scan.get_loss(mspec, p, data))), mparam)
+                agree = bool(np.isfinite(float(v_seq[0]))
+                             and np.isclose(float(v_seq[0]),
+                                            float(v_tree[0]), rtol=2e-2))
+                parts.append(
+                    f"msed T={T} grad[1-chain] seq {t_seq * 1e3:.0f} | tree "
+                    f"{t_tree * 1e3:.0f} ms (agree={agree})")
+                if T == max(Ts):
+                    msed_ratio_at_max = t_seq / t_tree
+            except Exception as e:
+                parts.append(f"msed T={T} failed ({type(e).__name__})")
+
     plat = jax.devices()[0].platform
     return (f"longt-bench[AFNS5, {plat} x{n_dev}]: " + "; ".join(parts)
             + f"; assoc/seq 1-chain value+grad speedup @T={max(Ts)}: "
               f"{ratio_at_max:.2f}x"
             + f"; slr/seq tvl 1-chain value+grad speedup @T={max(Ts)}: "
-              f"{tvl_ratio_at_max:.2f}x")
+              f"{tvl_ratio_at_max:.2f}x"
+            + f"; score_tree/seq msed 1-chain value+grad speedup "
+              f"@T={max(Ts)}: {msed_ratio_at_max:.2f}x")
 
 
 def _longt_bench():

@@ -348,6 +348,37 @@ def unit_slr_pass(T=20000, sweeps=2, chunk=128):
                   f"(K={sweeps} sweeps, chunk={chunk}), ll={ll:.1f}")
 
 
+def unit_msed_pass(T=20000, sweeps=2, chunk=256):
+    """Score-driven long-panel unit (the BENCH_LONGT MSED dual-ratio wall):
+    one naive 1-thread NumPy SCORE-TREE evaluation — the FD-linearized
+    affine γ/β prefix passes plus ``sweeps`` chunked exact-recursion
+    refinement sweeps (tests/oracle.linearized_score_filter, the
+    independent loop the engine is pinned against) — at the T=20,000
+    daily/intraday scale.  What a user of the reference pays to run the
+    same algorithm as per-step loops: ~(2 + sweeps) sequential T-step
+    walks, each an OLS solve + analytic score per step.  Pairs with
+    bench.py's ``BENCH_LONGT=1`` seq-vs-tree MSED line for the BASELINE.md
+    dual-ratio row."""
+    from yieldfactormodels_jl_tpu import create_model
+
+    spec, _ = create_model("SD-NS", tuple(common.MATURITIES),
+                           float_type="float32")
+    p = oracle.stable_msed_params(spec)
+    struct = {"A": np.array([p[0]]), "B": np.array([p[1]]),
+              "omega": np.array([p[2]]), "delta": p[3:6],
+              "Phi": p[6:15].reshape(3, 3).T}
+    mats = np.asarray(common.MATURITIES, dtype=np.float64)
+    rng = np.random.default_rng(7)
+    data = oracle.simulate_dns_panel(rng, mats, T=T, lam=0.5)
+    t0 = time.perf_counter()
+    preds, _, _ = oracle.linearized_score_filter(struct, mats, data,
+                                                 sweeps=sweeps, chunk=chunk)
+    wall = time.perf_counter() - t0
+    loss = oracle.msed_loss_from_preds(preds, data)
+    return wall, (f"one naive score-tree pass at T={T} "
+                  f"(K={sweeps} sweeps, chunk={chunk}), loss={loss:.6f}")
+
+
 def naive_scenario_fan(R=256, G=16, D=8, Pn=128, S=6, h=12, n_paths=32,
                        block_len=12):
     """Scenario-lattice wall (the ``BENCH_SCEN`` dual-ratio denominator): a
@@ -559,6 +590,7 @@ RUNNERS = {
     "unit-afns5-pass": unit_afns5_pass,
     "unit-longt-pass": unit_longt_pass,
     "unit-slr-pass": unit_slr_pass,
+    "unit-msed-pass": unit_msed_pass,
     "unit-ssd-pass": unit_ssd_nns_pass,
     "scenario-fan": naive_scenario_fan,
     "unit-newton-iteration": unit_newton_iteration,

@@ -391,6 +391,132 @@ def msed_loss_from_preds(preds, data):
     return mse / N / T
 
 
+def linearized_score_filter(params_struct, maturities, data, sweeps=2,
+                            chunk=128, detach_inner_beta=True, fd_eps=1e-6):
+    """Independent NumPy mirror of the two-scale score-tree engine
+    (ops/score_scan.py) for the λ model — pass A composes per-step affine
+    surrogates of the TRUE γ map linearized at ω (central finite
+    differences here vs the engine's ``jacfwd`` — an independent route; the
+    β chain is exactly affine given the γ path), pass B re-runs ``sweeps``
+    chunked exact-recursion refinements with the Jacobi entry shift.  At
+    the fixed point this is :func:`msed_lambda_filter` (plain-gradient
+    path), step for step.
+
+    ``detach_inner_beta`` mirrors the spec flag: the engine's surrogate
+    Jacobian sees β̄ through ``stop_gradient`` when set, so the FD map here
+    freezes β̄ at the reference point; False re-fits β̄ at each FD point.
+
+    Returns ``(preds (N, T), gammas (T, 1), betas (T, 3))`` — the
+    post-transition trajectories of the final sweep."""
+    A = params_struct["A"]
+    B = params_struct["B"]
+    omega = np.asarray(params_struct["omega"], dtype=np.float64)
+    delta = np.asarray(params_struct["delta"], dtype=np.float64)
+    Phi = params_struct["Phi"]
+    mu = (np.eye(3) - Phi) @ delta
+    nu = np.zeros_like(omega) if B is None else (1 - B) * omega
+    N, T = data.shape
+    L_g = omega.shape[0]
+
+    def gamma_update(g, ysafe, obs, beta_fixed=None):
+        """plain_gamma_update: OLS β̄ (or a frozen one), analytic score."""
+        if not obs:
+            return g
+        bb = beta_fixed
+        if bb is None:
+            bb = _ols(dns_loadings(g[0], maturities), ysafe)
+        return g + _dns_score(g, bb, ysafe, maturities) * A
+
+    def transition(g):
+        return g if B is None else nu + B * g
+
+    # --- pass A, γ: FD-linearized elements of the post-transition map at ω
+    J_el = np.zeros((T, L_g, L_g))
+    b_el = np.zeros((T, L_g))
+    for t in range(T):
+        y = data[:, t]
+        obs = bool(np.isfinite(y[0]))
+        ysafe = np.where(np.isfinite(y), y, 0.0)
+        if not obs:
+            J_el[t] = np.eye(L_g) if B is None else np.diag(B)
+            b_el[t] = nu
+            continue
+        b_ref = (_ols(dns_loadings(omega[0], maturities), ysafe)
+                 if detach_inner_beta else None)
+        Ju = np.zeros((L_g, L_g))
+        for j in range(L_g):
+            e = np.zeros(L_g)
+            e[j] = fd_eps
+            Ju[:, j] = (gamma_update(omega + e, ysafe, obs, b_ref)
+                        - gamma_update(omega - e, ysafe, obs, b_ref)) \
+                / (2 * fd_eps)
+        Jt = Ju if B is None else B[:, None] * Ju
+        val = transition(gamma_update(omega, ysafe, obs))
+        J_el[t] = Jt
+        b_el[t] = val - Jt @ omega
+    gs = np.zeros((T, L_g))  # composed prefix == sequential affine recursion
+    g_run = omega.copy()
+    for t in range(T):
+        g_run = J_el[t] @ g_run + b_el[t]
+        gs[t] = g_run
+
+    # --- pass A, β: exact affine chain given the surrogate γ path
+    bs = np.zeros((T, 3))
+    b_run = delta.copy()
+    for t in range(T):
+        y = data[:, t]
+        obs = bool(np.isfinite(y[0]))
+        ysafe = np.where(np.isfinite(y), y, 0.0)
+        poison = np.nan if (obs and not np.all(np.isfinite(y))) else 1.0
+        gprev = omega if t == 0 else gs[t - 1]
+        g_obs = gamma_update(gprev, ysafe, obs)
+        beta_reols = _ols(dns_loadings(g_obs[0], maturities), ysafe)
+        of = 1.0 if obs else 0.0
+        b_run = ((1.0 - of) * poison) * (Phi @ b_run) \
+            + mu + (of * poison) * (Phi @ beta_reols)
+        bs[t] = b_run
+
+    # --- pass B: K exact-recursion sweeps over NaN-padded chunks
+    L = min(chunk, T)
+    Cn = -(-T // L)
+    pad = Cn * L - T
+    data_p = np.concatenate(
+        [data, np.full((N, pad), np.nan)], axis=1) if pad else data
+    in_win_p = np.concatenate([np.ones(T, bool), np.zeros(pad, bool)])
+
+    def true_step(gamma, beta, y, in_win):
+        obs = bool(in_win) and bool(np.isfinite(y[0]))
+        ysafe = np.where(np.isfinite(y), y, 0.0)
+        poison = np.nan if (obs and not np.all(np.isfinite(y))) else 1.0
+        gamma_obs = gamma_update(gamma, ysafe, obs)
+        beta_reols = _ols(dns_loadings(gamma_obs[0], maturities), ysafe)
+        beta_obs = (beta_reols if obs else beta) * poison
+        gamma_next = transition(gamma_obs)
+        beta_next = mu + Phi @ beta_obs
+        pred = dns_loadings(gamma_next[0], maturities) @ beta_next
+        return gamma_next, beta_next, pred
+
+    entry_g = np.concatenate([omega[None],
+                              gs[np.arange(1, Cn) * L - 1]], axis=0)
+    entry_b = np.concatenate([delta[None],
+                              bs[np.arange(1, Cn) * L - 1]], axis=0)
+    preds = np.zeros((Cn * L, N))
+    gam = np.zeros((Cn * L, L_g))
+    bet = np.zeros((Cn * L, 3))
+    for k in range(sweeps):
+        if k > 0:  # Jacobi shift: previous sweep's chunk exits
+            exits = np.arange(Cn - 1) * L + (L - 1)
+            entry_g = np.concatenate([omega[None], gam[exits]], axis=0)
+            entry_b = np.concatenate([delta[None], bet[exits]], axis=0)
+        for c in range(Cn):
+            g_c, b_c = entry_g[c].copy(), entry_b[c].copy()
+            for i in range(L):
+                t = c * L + i
+                g_c, b_c, p = true_step(g_c, b_c, data_p[:, t], in_win_p[t])
+                gam[t], bet[t], preds[t] = g_c, b_c, p
+    return preds[:T].T, gam[:T], bet[:T]
+
+
 def static_filter(gamma_Z, delta, Phi, data):
     """models/filter.jl:93-110 with fixed Z."""
     Z = gamma_Z
@@ -566,6 +692,25 @@ def generic_stable_params(spec, rng):
     lo, hi = spec.layout.get("phi", (0, 0))
     m = int(round((hi - lo) ** 0.5))
     p[lo:hi] = (0.9 * np.eye(m)).reshape(-1)
+    return p
+
+
+def stable_msed_params(spec, dtype=np.float64):
+    """A finite-loss parameter point for the plain-gradient λ-MSED specs
+    (SD-NS / RWSD-NS) — A = 1e-3, B = 0.97, ω = ln 0.5 (γ's transition
+    fixed point), δ = level/slope/curve start, Φ mildly coupled.  Shared by
+    the score-tree parity tests (tests/test_score_scan.py) and the
+    BENCH_LONGT MSED column (one copy, CLAUDE.md rule)."""
+    vals = [1e-3]
+    if not spec.random_walk:
+        vals.append(0.97)
+    vals.append(np.log(0.5))
+    vals.extend([0.3, -0.1, 0.05])
+    Phi = np.array([[0.95, 0.02, 0.0], [0.01, 0.9, 0.03],
+                    [0.0, 0.02, 0.85]])
+    vals.extend(Phi.T.reshape(-1))
+    p = np.asarray(vals, dtype=dtype)
+    assert p.shape[0] == spec.n_params
     return p
 
 
@@ -929,6 +1074,158 @@ def iterated_slr_filter(Phi, delta, Omega_state, obs_var, maturities, data,
                 y = data[:, j]
                 if np.all(np.isfinite(y)):
                     Z, d = _tvl_linearize(beta, maturities, exact_jacobian)
+                    v = y - (Z @ beta + d)
+                    F = Z @ P @ Z.T + Omega_obs
+                    F_inv = np.linalg.inv(F)
+                    K = P @ Z.T @ F_inv
+                    _, logdet = np.linalg.slogdet(F)
+                    lls[j] = -0.5 * (logdet + v @ F_inv @ v + N * LOG_2PI)
+                    beta = beta + K @ v
+                    P = (np.eye(Ms) - K @ Z) @ P
+                betas[j] = beta
+                Ps[j] = P
+            exits.append((beta.copy(), P.copy()))
+        entries = [(beta0.copy(), P0.copy())] + exits[:-1]
+
+    obs = np.all(np.isfinite(data), axis=0)
+    contrib = (np.arange(T) >= 1) & (np.arange(T) <= T - 2) & obs
+    return betas, Ps, lls, float(np.sum(np.where(contrib, lls, 0.0)))
+
+
+def _tvl_sigma_linearize(m, P, maturities):
+    """(Z (N, Ms), d (N,), mu (N,)) — sigma-point STATISTICAL linearization
+    of the TVλ measurement at (m, P): the oracle definition of the ``"ukf"``
+    rule in ``config.SLR_ENGINES`` (ops/slr_scan._sigma_linearize).
+
+    Unscented cubature with κ = 1 (c = Ms+1, w₀ = 1/c, wᵢ = 1/(2c), points
+    m ± √c·L·eᵢ with P = LLᵀ); the regression slope here goes the textbook
+    route — accumulate Ψ = Σ wᵢ (χᵢ−m)(h(χᵢ)−μ)ᵀ point by point and solve
+    against the FULL P — where the engine collapses Ψ to a triangular solve
+    against L, so agreement checks the statistics, not a transliteration.
+    Same deliberate divergence as the engine: the SLR residual covariance Ω
+    is omitted (R stays diagonal), so the fixed point both define is the
+    statistically linearized filter with unmodified R."""
+    Ms = m.shape[0]
+    c = Ms + 1.0
+    sc = np.sqrt(c)
+    Lc = np.linalg.cholesky(P)
+
+    def h(b):
+        lam = LAMBDA_FLOOR + np.exp(b[3])
+        tau = lam * maturities
+        z = np.exp(-tau)
+        z2 = (1 - z) / tau
+        z3 = z2 - z
+        return b[0] + z2 * b[1] + z3 * b[2]
+
+    pts = [m] + [m + sc * Lc[:, i] for i in range(Ms)] \
+        + [m - sc * Lc[:, i] for i in range(Ms)]
+    hs = [h(p) for p in pts]
+    w0, wi = 1.0 / c, 1.0 / (2.0 * c)
+    mu = w0 * hs[0]
+    for hv in hs[1:]:
+        mu = mu + wi * hv
+    Psi = np.zeros((Ms, len(maturities)))
+    for i, p in enumerate(pts):
+        w = w0 if i == 0 else wi
+        Psi += w * np.outer(p - m, hs[i] - mu)
+    Z = np.linalg.solve(P, Psi).T
+    d = mu - Z @ m
+    return Z, d, mu
+
+
+def sigma_point_filter(Phi, delta, Omega_state, obs_var, maturities, data):
+    """Sequential statistically-linearized (sigma-point, diagonal-R) filter
+    for the TVλ family — independent NumPy float64 loop, the FIXED POINT the
+    ``"ukf"`` iterated-SLR engine converges to (each step linearizes at its
+    own predicted moments, exactly what the engine's chunk refinement does).
+    Same windowing/NaN conventions as :func:`iterated_slr_filter`.
+
+    Returns ``(betas (T, Ms), Ps (T, Ms, Ms), lls (T,), loglik)``."""
+    N, T = data.shape
+    Ms = Phi.shape[0]
+    beta, P = kalman_init(Phi, delta, Omega_state)
+    beta0, P0 = beta.copy(), P.copy()
+    Omega_obs = obs_var * np.eye(N)
+    betas = np.zeros((T, Ms))
+    Ps = np.zeros((T, Ms, Ms))
+    lls = np.zeros(T)
+    for t in range(T):
+        beta = delta + Phi @ beta
+        P = Phi @ P @ Phi.T + Omega_state
+        y = data[:, t]
+        if np.all(np.isfinite(y)):
+            Z, d, _ = _tvl_sigma_linearize(beta, P, maturities)
+            v = y - (Z @ beta + d)
+            F = Z @ P @ Z.T + Omega_obs
+            F_inv = np.linalg.inv(F)
+            K = P @ Z.T @ F_inv
+            _, logdet = np.linalg.slogdet(F)
+            lls[t] = -0.5 * (logdet + v @ F_inv @ v + N * LOG_2PI)
+            beta = beta + K @ v
+            P = (np.eye(Ms) - K @ Z) @ P
+        betas[t] = beta
+        Ps[t] = P
+    obs = np.all(np.isfinite(data), axis=0)
+    contrib = (np.arange(T) >= 1) & (np.arange(T) <= T - 2) & obs
+    del beta0, P0
+    return betas, Ps, lls, float(np.sum(np.where(contrib, lls, 0.0)))
+
+
+def iterated_sigma_slr_filter(Phi, delta, Omega_state, obs_var, maturities,
+                              data, sweeps=2, chunk=128):
+    """Iterated two-scale SLR filter under the SIGMA-POINT rule — the
+    ``"ukf"`` twin of :func:`iterated_slr_filter`, mirroring the engine's
+    sweep semantics step for step: pass A linearizes ONCE at the stationary
+    predicted moments (constant reference mean AND covariance) and runs a
+    sequential affine filter under that frozen surrogate (a different
+    algebraic route than the engine's Woodbury-element combine tree); the K
+    refinement sweeps re-run the TRUE statistically-linearized recursion
+    within chunks (predict, sigma-point linearize at the chunk's own
+    predicted moments, joint update via explicit inverses) with the Jacobi
+    boundary shift.  Converges to :func:`sigma_point_filter` in K."""
+    N, T = data.shape
+    Ms = Phi.shape[0]
+    beta0, P0 = kalman_init(Phi, delta, Omega_state)
+    Omega_obs = obs_var * np.eye(N)
+
+    # pass A — sequential affine filter under the constant-moment surrogate
+    Ppred1 = Phi @ P0 @ Phi.T + Omega_state
+    Zc, dc, _ = _tvl_sigma_linearize(Phi @ beta0 + delta, Ppred1, maturities)
+    beta, P = beta0.copy(), P0.copy()
+    filt = []
+    for t in range(T):
+        beta = delta + Phi @ beta
+        P = Phi @ P @ Phi.T + Omega_state
+        y = data[:, t]
+        if np.all(np.isfinite(y)):
+            v = y - (Zc @ beta + dc)
+            F = Zc @ P @ Zc.T + Omega_obs
+            K = P @ Zc.T @ np.linalg.inv(F)
+            beta = beta + K @ v
+            P = (np.eye(Ms) - K @ Zc) @ P
+        filt.append((beta.copy(), P.copy()))
+
+    L = min(chunk, T)
+    n_chunks = -(-T // L)
+    entries = [(beta0.copy(), P0.copy())]
+    entries += [tuple(np.copy(a) for a in filt[c * L - 1])
+                for c in range(1, n_chunks)]
+
+    # K refinement sweeps — exact sigma-point recursion within chunks
+    for _ in range(sweeps):
+        betas = np.zeros((T, Ms))
+        Ps = np.zeros((T, Ms, Ms))
+        lls = np.zeros(T)
+        exits = []
+        for c in range(n_chunks):
+            beta, P = (np.copy(a) for a in entries[c])
+            for j in range(c * L, min((c + 1) * L, T)):
+                beta = delta + Phi @ beta
+                P = Phi @ P @ Phi.T + Omega_state
+                y = data[:, j]
+                if np.all(np.isfinite(y)):
+                    Z, d, _ = _tvl_sigma_linearize(beta, P, maturities)
                     v = y - (Z @ beta + d)
                     F = Z @ P @ Z.T + Omega_obs
                     F_inv = np.linalg.inv(F)

@@ -102,11 +102,14 @@ def test_every_kalman_engine_has_oracle_parity_coverage():
     engines, _ = kalman_engines_static(CFG)
     from yieldfactormodels_jl_tpu.config import (AMORTIZER_ENGINES,
                                                  KALMAN_ENGINES,
+                                                 MSED_ENGINES,
                                                  NEWTON_ENGINES, SLR_ENGINES)
     assert tuple(engines) == tuple(KALMAN_ENGINES) + tuple(SLR_ENGINES) \
-        + tuple(NEWTON_ENGINES) + tuple(AMORTIZER_ENGINES)
+        + tuple(MSED_ENGINES) + tuple(NEWTON_ENGINES) \
+        + tuple(AMORTIZER_ENGINES)
     assert len(KALMAN_ENGINES) >= 5
-    assert len(SLR_ENGINES) >= 1
+    assert len(SLR_ENGINES) >= 2       # "ekf" + the sigma-point "ukf" rule
+    assert len(MSED_ENGINES) >= 2      # "scan" + the "score_tree" engine
     assert len(NEWTON_ENGINES) >= 2
     assert len(AMORTIZER_ENGINES) >= 1
     strings = oracle_backed_test_strings(CFG)
@@ -116,5 +119,7 @@ def test_every_kalman_engine_has_oracle_parity_coverage():
         "engine-coverage guard rotted: second-order parity module not scanned"
     assert "test_slr_scan.py" in strings, \
         "engine-coverage guard rotted: SLR parity module not scanned"
+    assert "test_score_scan.py" in strings, \
+        "engine-coverage guard rotted: score-tree parity module not scanned"
     assert "test_amortize.py" in strings, \
         "engine-coverage guard rotted: amortizer parity module not scanned"

@@ -1,0 +1,353 @@
+"""Score-tree engine (ops/score_scan.py, docs/DESIGN.md §19) acceptance.
+
+Oracle-backed parity of the ``"score_tree"`` MSED engine against the
+independent NumPy loops (tests/oracle.linearized_score_filter — central-FD
+surrogate Jacobians + sequential affine recursion, a DIFFERENT algebraic
+route than the engine's ``jacfwd`` elements + combine tree), the fixed-point
+contract against the sequential ``"scan"`` recursion
+(models/score_driven.py), NaN-panel/window semantics, K-sweep convergence
+monotonicity, grad parity (the tree is differentiated end-to-end — the
+deliberate no-stop_gradient divergence from the SLR engine), trace counters,
+the introspection seam (config.engines_for / tree_engine_for) with the api
+dispatch and its K=1-only gate, the ladder's score_tree rescue rung, and the
+time-sharded objective's shard-aligned-chunk bit-parity.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import yieldfactormodels_jl_tpu as yfm
+from tests import oracle
+from yieldfactormodels_jl_tpu import config
+from yieldfactormodels_jl_tpu.models import api
+from yieldfactormodels_jl_tpu.models import score_driven as sd
+from yieldfactormodels_jl_tpu.models.params import untransform_params
+from yieldfactormodels_jl_tpu.ops import score_scan
+from yieldfactormodels_jl_tpu.robustness import ladder, taxonomy as tax
+
+MATS = tuple(np.array([3, 12, 24, 60, 120, 240, 360]) / 12.0)
+
+
+def _msed_case(rng, T=160, code="SD-NS"):
+    spec, _ = yfm.create_model(code, MATS, float_type="float64")
+    p = oracle.stable_msed_params(spec)
+    data = oracle.simulate_dns_panel(rng, np.asarray(MATS), T=T, lam=0.5)
+    return spec, p, np.asarray(data, dtype=np.float64)
+
+
+def _struct(spec, p):
+    """The oracle's parameter dict (msedriven/paramteroperations.jl layout:
+    A, B unless random-walk, ω, δ, col-major Φ)."""
+    if spec.random_walk:
+        return {"A": np.array([p[0]]), "B": None, "omega": np.array([p[1]]),
+                "delta": p[2:5], "Phi": p[5:14].reshape(3, 3).T}
+    return {"A": np.array([p[0]]), "B": np.array([p[1]]),
+            "omega": np.array([p[2]]), "delta": p[3:6],
+            "Phi": p[6:15].reshape(3, 3).T}
+
+
+# ---------------------------------------------------------------------------
+# the introspection seam (config.engines_for) and registries
+# ---------------------------------------------------------------------------
+
+def test_engine_registries_and_applicability():
+    """"score_tree" is a first-class MSED_ENGINES entry and
+    engines_for/tree_engine_for agree with the capability flag
+    (spec.supports_score_tree: plain-gradient specs only — the EWMA
+    scale_grad lineage keeps the sequential scan)."""
+    assert config.MSED_ENGINES == ("scan", "score_tree")
+    sdns, _ = yfm.create_model("SD-NS", MATS, float_type="float64")
+    rwsd, _ = yfm.create_model("RWSD-NS", MATS, float_type="float64")
+    ssd, _ = yfm.create_model("SSD-NS", MATS, float_type="float64")
+    assert sdns.supports_score_tree and rwsd.supports_score_tree
+    assert not ssd.supports_score_tree
+    assert config.engines_for(sdns) == config.MSED_ENGINES
+    assert config.engines_for(rwsd) == config.MSED_ENGINES
+    assert config.engines_for(ssd) == ("scan",)
+    assert config.tree_engine_for(sdns) == "score_tree"
+    assert config.tree_engine_for(rwsd) == "score_tree"
+    assert config.tree_engine_for(ssd) is None
+
+
+def test_api_dispatch_validation_consults_engines_for(rng):
+    """Explicit engine= outside engines_for(spec) raises naming the valid
+    set; K-replay losses cannot ride the tree (K >= 2 CONTINUES the
+    sequential recursion — no tree semantics) and the gate is loud."""
+    spec, p, data = _msed_case(rng, T=60)
+    dj, pj = jnp.asarray(data), jnp.asarray(p)
+    ssd, _ = yfm.create_model("SSD-NS", MATS, float_type="float64")
+    with pytest.raises(ValueError, match="engines_for"):
+        api.get_loss(ssd, jnp.zeros(ssd.n_params), dj, engine="score_tree")
+    with pytest.raises(ValueError, match="K=1"):
+        api.get_loss(spec, pj, dj, K=2, engine="score_tree")
+    a = float(api.get_loss(spec, pj, dj, engine="scan"))
+    b = float(api.get_loss(spec, pj, dj))
+    np.testing.assert_allclose(a, b, rtol=1e-12)
+
+
+def test_t_switch_upgrades_msed_to_score_tree(rng, monkeypatch):
+    spec, p, data = _msed_case(rng, T=100)
+    dj, pj = jnp.asarray(data), jnp.asarray(p)
+    calls = []
+    real = score_scan.get_loss
+    monkeypatch.setattr(score_scan, "get_loss",
+                        lambda *a, **k: calls.append(1) or real(*a, **k))
+    try:
+        config.set_loglik_t_switch(64)
+        api.get_loss(spec, pj, dj)                   # T=100 >= 64 → tree
+        assert len(calls) == 1
+        api.get_loss(spec, pj, dj[:, :50])           # short → sequential
+        assert len(calls) == 1
+        api.get_loss(spec, pj, dj, engine="scan")    # explicit wins
+        assert len(calls) == 1
+        api.get_loss(spec, pj, dj, K=2)              # K-replay stays scan
+        assert len(calls) == 1
+        ssd, _ = yfm.create_model("SSD-NS", MATS, float_type="float64")
+        with np.errstate(all="ignore"):              # not capable → scan
+            api.get_loss(ssd, jnp.zeros(ssd.n_params), dj)
+        assert len(calls) == 1
+    finally:
+        config.set_loglik_t_switch(0)
+
+
+# ---------------------------------------------------------------------------
+# oracle parity — the iterated semantics AND the sequential fixed point
+# ---------------------------------------------------------------------------
+
+def test_score_tree_single_chunk_is_sequential(rng):
+    """One chunk covering the panel + one sweep IS the sequential recursion
+    (pass B replays every step from the exact start state) — float-rounding
+    parity against models/score_driven.get_loss."""
+    spec, p, data = _msed_case(rng, T=160)
+    dj, pj = jnp.asarray(data), jnp.asarray(p)
+    seq = float(sd.get_loss(spec, pj, dj))
+    one = float(score_scan.get_loss(spec, pj, dj, sweeps=1, chunk=160))
+    np.testing.assert_allclose(one, seq, rtol=1e-12)
+
+
+@pytest.mark.parametrize("sweeps", [1, 2, 3])
+def test_score_tree_oracle_parity_iterated_semantics(sweeps, rng):
+    """Engine vs tests/oracle.linearized_score_filter at MATCHING (sweeps,
+    chunk) — pins the iterated two-scale semantics themselves (composed
+    FD-linearized affine surrogates + chunked true-recursion refinement with
+    the Jacobi entry shift), not just the fixed point, at an adversarially
+    small chunk where intermediate sweeps still differ from the sequential
+    scan.  Loss AND the post-transition state trajectories."""
+    spec, p, data = _msed_case(rng, T=160)
+    preds_o, g_o, b_o = oracle.linearized_score_filter(
+        _struct(spec, p), np.asarray(MATS), data, sweeps=sweeps, chunk=32)
+    want = oracle.msed_loss_from_preds(preds_o, data)
+    got = float(score_scan.get_loss(spec, jnp.asarray(p), jnp.asarray(data),
+                                    sweeps=sweeps, chunk=32))
+    np.testing.assert_allclose(got, want, rtol=1e-12)
+    g_e, b_e = score_scan.filter_states(spec, jnp.asarray(p),
+                                        jnp.asarray(data), sweeps=sweeps,
+                                        chunk=32)
+    np.testing.assert_allclose(np.asarray(g_e), g_o, atol=1e-10)
+    np.testing.assert_allclose(np.asarray(b_e), b_o, atol=1e-10)
+
+
+def test_score_tree_matches_sequential_fixed_point(rng):
+    """The engine at its DEFAULTS against the sequential scan on a
+    multi-chunk panel: K=2 at parity tolerance, one extra sweep tightening
+    it by orders of magnitude (the ≈B^L contraction)."""
+    spec, p, data = _msed_case(rng, T=1100)
+    dj, pj = jnp.asarray(data), jnp.asarray(p)
+    want = float(sd.get_loss(spec, pj, dj))
+    got2 = float(score_scan.get_loss(spec, pj, dj))
+    np.testing.assert_allclose(got2, want, rtol=1e-8)
+    got3 = float(score_scan.get_loss(spec, pj, dj, sweeps=3))
+    assert abs(got3 - want) < abs(got2 - want) or got2 == want
+    np.testing.assert_allclose(got3, want, rtol=1e-10)
+
+
+def test_score_tree_sweep_convergence_monotone(rng):
+    """The K-sweep gap to the sequential scan shrinks monotonically, and by
+    about the chunk's own ≈B^L forgetting per sweep (0.97³² ≈ 0.38 here, so
+    three extra sweeps buy an order of magnitude).  A is inflated ×50 so the
+    γ path genuinely wanders from ω — at the stable point the pass-A
+    surrogate is so accurate the K=1 gap is already float noise and there is
+    nothing left to contract."""
+    spec, p, data = _msed_case(rng, T=1100)
+    p = p.copy()
+    p[0] *= 50.0
+    dj, pj = jnp.asarray(data), jnp.asarray(p)
+    want = float(sd.get_loss(spec, pj, dj))
+    gaps = [abs(float(score_scan.get_loss(spec, pj, dj, sweeps=k, chunk=32))
+                - want)
+            for k in (1, 2, 3, 4)]
+    assert all(g1 > g2 for g1, g2 in zip(gaps, gaps[1:])), gaps
+    assert gaps[-1] < 0.1 * gaps[0]
+
+
+def test_score_tree_random_walk_family(rng):
+    """The RWSD lineage (B absorbed — γ is a pure random walk, the affine
+    elements have J = I off-observation): sequential parity at the fixed
+    point and oracle parity at matched (sweeps, chunk)."""
+    spec, p, data = _msed_case(rng, T=160, code="RWSD-NS")
+    dj, pj = jnp.asarray(data), jnp.asarray(p)
+    seq = float(sd.get_loss(spec, pj, dj))
+    tr = float(score_scan.get_loss(spec, pj, dj, sweeps=2, chunk=32))
+    np.testing.assert_allclose(tr, seq, rtol=1e-6)
+    preds_o, _, _ = oracle.linearized_score_filter(
+        _struct(spec, p), np.asarray(MATS), data, sweeps=2, chunk=32)
+    want = oracle.msed_loss_from_preds(preds_o, data)
+    np.testing.assert_allclose(tr, want, rtol=1e-12)
+
+
+def test_score_tree_nan_semantics(rng):
+    """Window/NaN contract shared with the sequential engine: an in-window
+    NaN target poisons the loss to the −Inf sentinel on BOTH engines (the
+    reference masks via start/end windows, not NaN skipping); excluding the
+    block by window restores finite parity; a partially-quoted observed
+    column poisons the state (code carries the cause)."""
+    spec, p, data = _msed_case(rng, T=160)
+    pj = jnp.asarray(p)
+    pan = data.copy()
+    pan[:, 40:44] = np.nan
+    seq = float(sd.get_loss(spec, pj, jnp.asarray(pan)))
+    tr = float(score_scan.get_loss(spec, pj, jnp.asarray(pan), sweeps=2,
+                                   chunk=32))
+    assert seq == -np.inf and tr == -np.inf
+    seq_w = float(sd.get_loss(spec, pj, jnp.asarray(pan), start=45, end=160))
+    tr_w = float(score_scan.get_loss(spec, pj, jnp.asarray(pan), start=45,
+                                     end=160, sweeps=2, chunk=32))
+    np.testing.assert_allclose(tr_w, seq_w, rtol=1e-8)
+    poi = data.copy()
+    poi[3, 50] = np.nan                  # partial: y[0] still finite
+    ll, code = score_scan.get_loss_coded(spec, pj, jnp.asarray(poi))
+    assert float(ll) == -np.inf
+    assert "STATE_EXPLODED" in tax.decode(int(code))
+
+
+# ---------------------------------------------------------------------------
+# grad parity + trace counters
+# ---------------------------------------------------------------------------
+
+def test_score_tree_grad_parity_vs_sequential(rng):
+    """Differentiable end-to-end INCLUDING the tree (the deliberate
+    no-stop_gradient divergence from the SLR engine — the state is tiny and
+    B^L forgetting is weak at B → 1, so the full adjoint is both cheap and
+    needed): K=2 gradient against the sequential scan's, K=3 tightening
+    it."""
+    spec, p, data = _msed_case(rng, T=500)
+    dj, pj = jnp.asarray(data), jnp.asarray(p)
+    g_seq = np.asarray(jax.grad(lambda q: sd.get_loss(spec, q, dj))(pj))
+    g2 = np.asarray(jax.grad(
+        lambda q: score_scan.get_loss(spec, q, dj))(pj))
+    g3 = np.asarray(jax.grad(
+        lambda q: score_scan.get_loss(spec, q, dj, sweeps=3))(pj))
+    assert np.isfinite(g2).all()
+    scale = np.abs(g_seq).max()
+    assert np.abs(g2 - g_seq).max() / scale < 1e-8
+    assert np.abs(g3 - g_seq).max() / scale < 1e-10
+
+
+def test_score_tree_no_recompile_trace_counter(rng):
+    """Same-shape repeat calls reuse ONE traced program; a different static
+    configuration (sweeps) traces its own."""
+    spec, p, data = _msed_case(rng, T=96)
+    dj, pj = jnp.asarray(data), jnp.asarray(p)
+    fn = jax.jit(lambda q, d: score_scan.get_loss(spec, q, d))
+    score_scan.reset_trace_counts()
+    fn(pj, dj).block_until_ready()
+    fn(pj * 1.001, dj).block_until_ready()
+    fn(pj * 0.999, dj).block_until_ready()
+    assert score_scan.trace_counts["score_filter"] == 1
+    fn3 = jax.jit(lambda q, d: score_scan.get_loss(spec, q, d, sweeps=3))
+    fn3(pj, dj).block_until_ready()
+    assert score_scan.trace_counts["score_filter"] == 2
+
+
+def test_score_tree_validation_errors(rng):
+    spec, p, data = _msed_case(rng, T=40)
+    dj, pj = jnp.asarray(data), jnp.asarray(p)
+    with pytest.raises(ValueError, match="sweeps"):
+        score_scan.get_loss(spec, pj, dj, sweeps=0)
+    with pytest.raises(ValueError, match="prefix"):
+        score_scan.get_loss(spec, pj, dj, prefix="zigzag")
+    with pytest.raises(ValueError, match="chunk"):
+        score_scan.get_loss(spec, pj, dj, chunk=0)
+    ssd, _ = yfm.create_model("SSD-NS", MATS, float_type="float64")
+    with pytest.raises(ValueError, match="supports_score_tree"):
+        score_scan.get_loss(ssd, jnp.zeros(ssd.n_params), dj)
+
+
+# ---------------------------------------------------------------------------
+# ladder: score_tree as the MSED long-panel rescue rung
+# ---------------------------------------------------------------------------
+
+def test_ladder_score_tree_rung_rescues_long_panel(rng, monkeypatch):
+    """A start the scan-engine diagnosis declares dead on a long panel
+    (T >= ASSOC_RESCUE_MIN_T) is re-evaluated on the score-tree rung — the
+    MSED twin of the assoc/slr rungs — and the trace says so.  The dead
+    diagnosis is injected (tax.diagnose stubbed to −Inf) so the rung's
+    gating and recovery wiring are pinned deterministically, independent of
+    hunting for a point where only the fused sequential artifact dies."""
+    spec, p, data = _msed_case(rng, T=ladder.ASSOC_RESCUE_MIN_T + 40)
+    raw = np.asarray(untransform_params(spec, jnp.asarray(p)))
+    monkeypatch.setattr(tax, "diagnose",
+                        lambda *a, **k: (float("-inf"), 0))
+    tr = ladder.escalate(spec, data, raw)
+    assert [r.rung for r in tr.rungs] == ["scan", "score_tree"]
+    assert tr.recovered and tr.rung == "score_tree"
+    assert tr.engine == "score_tree" and tr.raw is None
+    want = float(score_scan.get_loss(spec, jnp.asarray(p),
+                                     jnp.asarray(data)))
+    np.testing.assert_allclose(tr.ll, want, rtol=1e-12)
+
+
+def test_ladder_score_tree_rung_skipped_on_short_panels(rng, monkeypatch):
+    """Below the length gate the rung must not run (the sequential rungs are
+    cheap there); an MSED spec has no sqrt/jitter rungs, so a still-dead
+    start falls through to the reference-parity shrink."""
+    spec, p, data = _msed_case(rng, T=60)
+    raw = np.asarray(untransform_params(spec, jnp.asarray(p)))
+    monkeypatch.setattr(tax, "diagnose",
+                        lambda *a, **k: (float("-inf"), 0))
+    tr = ladder.escalate(spec, data, raw)
+    assert "score_tree" not in [r.rung for r in tr.rungs]
+    assert [r.rung for r in tr.rungs] == ["scan", "shrink"]
+    assert not tr.recovered
+
+
+# ---------------------------------------------------------------------------
+# estimation: the time-sharded objective's shard-aligned chunk
+# ---------------------------------------------------------------------------
+
+def test_time_sharded_loss_msed_matches_unsharded_engine(rng):
+    """The sharded program equals the UNSHARDED score-tree engine at the
+    same (chunk, sweeps) bit-tight — the refinement's (C, L) reshape IS the
+    sharding layout (the same shard-aligned-chunk pin the SLR engine
+    carries; a misaligned chunk was observed to MISCOMPILE under SPMD)."""
+    from yieldfactormodels_jl_tpu.parallel.mesh import make_mesh
+    from yieldfactormodels_jl_tpu.parallel.time_parallel import (
+        _pad_time, get_loss_time_sharded)
+
+    spec, p, data = _msed_case(rng, T=250)   # 250 % 8 != 0: ragged T works
+    mesh = make_mesh(axis_name="time")
+    n_dev = int(mesh.devices.size)
+    par = float(get_loss_time_sharded(spec, p, data, mesh=mesh))
+    padded = np.asarray(_pad_time(jnp.asarray(data), n_dev))
+    chunk = padded.shape[1] // n_dev
+    want = float(score_scan.get_loss(spec, jnp.asarray(p),
+                                     jnp.asarray(padded), 0, data.shape[1],
+                                     prefix="interleaved", chunk=chunk))
+    np.testing.assert_allclose(par, want, rtol=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# serving: refilter() stays a moment-engine surface
+# ---------------------------------------------------------------------------
+
+def test_refilter_rejects_momentless_tree_engines():
+    """The serving refilter needs filtered MOMENTS (mean + covariance) —
+    the score tree emits states only, so the builder's explicit dispatch
+    must refuse an MSED spec loudly instead of silently falling back."""
+    from yieldfactormodels_jl_tpu.serving.online import _jitted_refilter
+
+    spec, _ = yfm.create_model("SD-NS", MATS, float_type="float64")
+    with pytest.raises(ValueError, match="refilter"):
+        _jitted_refilter(spec, 64)

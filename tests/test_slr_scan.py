@@ -4,6 +4,9 @@ Oracle-backed parity of the ``"slr"`` engine and its ``"ekf"`` linearization
 rule against the independent NumPy loops (tests/oracle.iterated_slr_filter —
 sequential affine pass A + chunked exact-EKF refinement, a DIFFERENT
 algebraic route than the engine's Woodbury elements + combine tree), the
+``"ukf"`` sigma-point rule against its own oracle pair
+(oracle.iterated_sigma_slr_filter / oracle.sigma_point_filter — textbook
+full-Ψ regression vs the engine's triangular shortcut), the
 fixed-point contract against the sequential EKF (oracle.ekf_tvl_loglik /
 oracle.kalman_filter_loglik), NaN-panel semantics, K-sweep convergence
 monotonicity, grad parity, trace counters, the introspection seam
@@ -62,7 +65,7 @@ def test_engine_registries_and_applicability():
     linearization rule, and engines_for/tree_engine_for agree with the
     family structure (the seam every dispatch site consults)."""
     assert "slr" in config.KALMAN_ENGINES
-    assert config.SLR_ENGINES == ("ekf",)
+    assert config.SLR_ENGINES == ("ekf", "ukf")
     dns, _ = yfm.create_model("1C", MATS, float_type="float64")
     tvl, _ = yfm.create_model("TVλ", MATS, float_type="float64")
     ns, _ = yfm.create_model("NS", MATS, float_type="float64")
@@ -278,6 +281,107 @@ def test_slr_validation_errors(rng):
     ns, _ = yfm.create_model("NS", MATS, float_type="float64")
     with pytest.raises(ValueError, match="Kalman family"):
         slr_scan.get_loss(ns, jnp.zeros(ns.n_params), jnp.asarray(data))
+
+
+# ---------------------------------------------------------------------------
+# the "ukf" linearization rule — sigma-point SLR (registry-selected)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("sweeps", [1, 2, 3])
+def test_ukf_oracle_parity_iterated_semantics(sweeps, rng):
+    """Engine under ``linearization="ukf"`` vs tests/oracle.
+    iterated_sigma_slr_filter at MATCHING (sweeps, chunk) — the oracle
+    regresses the full Ψ = Σ wᵢ(χᵢ−m)(h(χᵢ)−μ)ᵀ statistic against P where
+    the engine collapses it to a triangular solve against L, so agreement
+    pins the sigma-point statistics and the combine tree, not a
+    transliteration."""
+    spec, p, data = _tvl_case(rng, T=200)
+    data[:, 90:95] = np.nan
+    Phi, delta, Om, ov = _tvl_pieces(spec, p)
+    *_, want = oracle.iterated_sigma_slr_filter(Phi, delta, Om, ov,
+                                                np.asarray(MATS), data,
+                                                sweeps=sweeps, chunk=32)
+    got = float(slr_scan.get_loss(spec, jnp.asarray(p), jnp.asarray(data),
+                                  sweeps=sweeps, chunk=32,
+                                  linearization="ukf"))
+    np.testing.assert_allclose(got, want, rtol=1e-9)
+
+
+def test_ukf_oracle_parity_filtered_moments(rng):
+    """The sigma-point rule's filtered trajectories against the oracle's."""
+    spec, p, data = _tvl_case(rng, T=150)
+    Phi, delta, Om, ov = _tvl_pieces(spec, p)
+    betas, Ps, _, _ = oracle.iterated_sigma_slr_filter(Phi, delta, Om, ov,
+                                                       np.asarray(MATS), data,
+                                                       sweeps=2, chunk=32)
+    m, P = slr_scan.filter_means_covs(spec, jnp.asarray(p),
+                                      jnp.asarray(data), sweeps=2, chunk=32,
+                                      linearization="ukf")
+    np.testing.assert_allclose(np.asarray(m), betas, atol=1e-9)
+    np.testing.assert_allclose(np.asarray(P), Ps, atol=1e-9)
+
+
+def test_ukf_matches_sequential_sigma_point_fixed_point(rng):
+    """The "ukf" rule at its defaults against the sequential
+    statistically-linearized filter oracle (oracle.sigma_point_filter) — the
+    acceptance contract: K=2 within 1e-6 relative on a multi-chunk panel,
+    K=3 tightening it (the ρ^L contraction), and the single-chunk sweep
+    exact to float rounding."""
+    spec, p, data = _tvl_case(rng, T=500)
+    Phi, delta, Om, ov = _tvl_pieces(spec, p)
+    want = oracle.sigma_point_filter(Phi, delta, Om, ov, np.asarray(MATS),
+                                     data)[-1]
+    one = float(slr_scan.get_loss(spec, jnp.asarray(p), jnp.asarray(data),
+                                  sweeps=1, chunk=500, linearization="ukf"))
+    np.testing.assert_allclose(one, want, rtol=1e-10)
+
+    spec, p, data = _tvl_case(rng, T=1100)
+    Phi, delta, Om, ov = _tvl_pieces(spec, p)
+    want = oracle.sigma_point_filter(Phi, delta, Om, ov, np.asarray(MATS),
+                                     data)[-1]
+    got2 = float(slr_scan.get_loss(spec, jnp.asarray(p), jnp.asarray(data),
+                                   linearization="ukf"))
+    np.testing.assert_allclose(got2, want, rtol=1e-6)
+    got3 = float(slr_scan.get_loss(spec, jnp.asarray(p), jnp.asarray(data),
+                                   sweeps=3, linearization="ukf"))
+    assert abs(got3 - want) < abs(got2 - want) or got2 == want
+    np.testing.assert_allclose(got3, want, rtol=1e-9)
+
+
+def test_ukf_grad_parity_vs_sequential_sigma_point(rng):
+    """The default-K "ukf" gradient against the single-chunk sequential
+    sigma-point recursion's (the rule's own exact reference — same
+    linearization, no chunk boundaries)."""
+    spec, p, data = _tvl_case(rng, T=500)
+    dj, pj = jnp.asarray(data), jnp.asarray(p)
+    g_seq = np.asarray(jax.grad(lambda q: slr_scan.get_loss(
+        spec, q, dj, sweeps=1, chunk=500, linearization="ukf"))(pj))
+    g2 = np.asarray(jax.grad(lambda q: slr_scan.get_loss(
+        spec, q, dj, linearization="ukf"))(pj))
+    assert np.isfinite(g2).all()
+    assert np.linalg.norm(g2 - g_seq) / np.linalg.norm(g_seq) < 5e-6
+
+
+def test_ukf_rules_disagree_then_both_converge(rng):
+    """Non-vacuity for the registry: the two linearization rules produce
+    genuinely different losses at K=1 on a curved panel (different
+    surrogates), yet land on nearby fixed points as K grows (both are
+    statistical linearizations of the same filter)."""
+    spec, p, data = _tvl_case(rng, T=300)
+    pj, dj = jnp.asarray(p), jnp.asarray(data)
+    e1 = float(slr_scan.get_loss(spec, pj, dj, sweeps=1, chunk=32,
+                                 linearization="ekf"))
+    u1 = float(slr_scan.get_loss(spec, pj, dj, sweeps=1, chunk=32,
+                                 linearization="ukf"))
+    assert e1 != u1
+    e4 = float(slr_scan.get_loss(spec, pj, dj, sweeps=4, chunk=32,
+                                 linearization="ekf"))
+    u4 = float(slr_scan.get_loss(spec, pj, dj, sweeps=4, chunk=32,
+                                 linearization="ukf"))
+    # distinct fixed points (EKF vs statistically-linearized filter — a few
+    # percent apart on a curved panel), but the same filter to leading order
+    assert np.isfinite(e4) and np.isfinite(u4)
+    np.testing.assert_allclose(u4, e4, rtol=5e-2)
 
 
 # ---------------------------------------------------------------------------

@@ -601,6 +601,17 @@ def _m_slr_rescue():
                                      i64(), i64())]
 
 
+@case("robustness.ladder._jitted_score_rescue")
+def _m_score_rescue():
+    # the assoc/slr rungs' score-driven twin (the O(log T) score-tree
+    # engine, ops/score_scan, docs/DESIGN.md §19)
+    from ..robustness.ladder import _jitted_score_rescue
+
+    sp = spec("msed_lambda", duplicator=(0,))
+    return _jitted_score_rescue(sp), [(f64(sp.n_params), f64(N, T),
+                                       i64(), i64())]
+
+
 @case("robustness.taxonomy._jitted_diagnose")
 def _m_diagnose():
     from ..robustness.taxonomy import _jitted_diagnose
@@ -659,6 +670,22 @@ def _m_time_sharded_loss_tvl():
     sp = spec("kalman_tvl")
     fn = _jitted_time_sharded_loss(sp, T, mesh2("time"), "time")
     return fn, [(f64(sp.n_params), f64(N, T), i64(), i64())]
+
+
+@case("parallel.time_parallel._jitted_time_sharded_loss",
+      label="msed-score-tree")
+def _m_time_sharded_loss_msed():
+    # the score-driven dispatch: the score-tree engine with the refinement
+    # chunk pinned to the shard length (docs/DESIGN.md §19).  TWO
+    # aval-identical stagings under max_programs=1 (the YFM105 retrace
+    # census): a repeat call at the same avals must hit the one compiled
+    # program, not trace a sibling (the PR-8 staging-mismatch bug class).
+    from ..parallel.time_parallel import _jitted_time_sharded_loss
+
+    sp = spec("msed_lambda", duplicator=(0,))
+    fn = _jitted_time_sharded_loss(sp, T, mesh2("time"), "time")
+    args = (f64(sp.n_params), f64(N, T), i64(), i64())
+    return fn, [args, args]
 
 
 @case("parallel.time_parallel._jitted_time_sharded_multistart")

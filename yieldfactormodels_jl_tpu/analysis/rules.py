@@ -427,11 +427,11 @@ def yfm006_env_knob_docs(mod: SourceModule,
 # ---------------------------------------------------------------------------
 
 #: engine registries in config.py whose every entry must be oracle-backed —
-#: the Kalman loglik engines, the SLR linearization rules, the second-order
-#: (Newton HVP) engines and the amortized-estimation surrogate architectures
-#: share one parity contract
-_ENGINE_REGISTRIES = ("KALMAN_ENGINES", "SLR_ENGINES", "NEWTON_ENGINES",
-                      "AMORTIZER_ENGINES")
+#: the Kalman loglik engines, the SLR linearization rules, the score-driven
+#: engines, the second-order (Newton HVP) engines and the
+#: amortized-estimation surrogate architectures share one parity contract
+_ENGINE_REGISTRIES = ("KALMAN_ENGINES", "SLR_ENGINES", "MSED_ENGINES",
+                      "NEWTON_ENGINES", "AMORTIZER_ENGINES")
 
 
 def kalman_engines_static(config: LintConfig):

@@ -31,10 +31,30 @@ _KALMAN_ENGINE = "univariate"
 #:   "ekf"  first-order Taylor (analytic EKF Jacobians) around the previous
 #:          sweep's predicted-mean trajectory — the posterior-linearization
 #:          rule whose fixed point is the sequential EKF
+#:   "ukf"  sigma-point statistical linearization (arXiv:2207.00426's
+#:          headline variant): the unscented cubature rule (2·Ms+1 points,
+#:          all-positive weights, points on the trailing/lane axis) regressed
+#:          into the same affine surrogate — fixed point is the sequential
+#:          sigma-point (statistically linearized) filter, the better rule
+#:          in curvature-heavy regimes where one Jacobian under-spans the
+#:          posterior spread (docs/QUICKSTART.md has the chooser)
 #: Every entry must have oracle-backed parity coverage — graftlint YFM007,
-#: the same contract as KALMAN_ENGINES/NEWTON_ENGINES.  Sigma-point SLR
-#: (arXiv:2207.00426's general form) drops in here when a family needs it.
-SLR_ENGINES = ("ekf",)
+#: the same contract as KALMAN_ENGINES/NEWTON_ENGINES.
+SLR_ENGINES = ("ekf", "ukf")
+
+#: loss engines for the score-driven (MSED) families (models/score_driven.py
+#: vs ops/score_scan.py, docs/DESIGN.md §19):
+#:   "scan"        the sequential ``lax.scan`` recursion — reference parity,
+#:                 the production default
+#:   "score_tree"  the O(log T) parallel-in-time engine: per-step affine
+#:                 surrogate of the score recursion composed on the combine
+#:                 tree + K chunked TRUE-recursion refinement sweeps —
+#:                 available where the spec's state is the plain gradient
+#:                 recursion (``spec.supports_score_tree``; the EWMA
+#:                 ``scale_grad`` lineage keeps the sequential scan)
+#: Every entry must have oracle-backed parity coverage — graftlint YFM007,
+#: the same contract as KALMAN_ENGINES.
+MSED_ENGINES = ("scan", "score_tree")
 
 #: second-order (Newton-polish) HVP engines used by ``ops/newton.py`` /
 #: ``estimate(..., second_order=...)``:
@@ -58,37 +78,46 @@ AMORTIZER_ENGINES = ("deepset",)
 
 
 def engines_for(spec) -> tuple:
-    """The ``KALMAN_ENGINES`` entries valid for one model family — THE
+    """The loss-engine names valid for one model family — THE
     engine-applicability introspection seam (docs/DESIGN.md §19).
 
     ``api.get_loss`` validation, the ``YFM_LOGLIK_T_SWITCH`` long-panel
     dispatch, ``estimate(objective="time_sharded")`` and the serving
     ``refilter()`` gate all consult this one function instead of scattering
-    per-family conditionals: the sequential engines cover every Kalman
-    family; the parallel-in-time tree is ``"assoc"`` where the measurement
-    is constant and ``"slr"`` (the iterated posterior-linearization
-    superset) everywhere — non-Kalman families run their own filters and
-    take no engine choice at all.
+    per-family conditionals.  The engine matrix is TOTAL over the filtered
+    families: Kalman families pick from ``KALMAN_ENGINES`` (the sequential
+    engines cover every Kalman family; the parallel-in-time tree is
+    ``"assoc"`` where the measurement is constant and ``"slr"`` — the
+    iterated posterior-linearization superset — everywhere); the
+    score-driven families pick from ``MSED_ENGINES`` (``"score_tree"``
+    where the spec's capability flag ``supports_score_tree`` holds, the
+    sequential ``"scan"`` always).  Only the static families — closed-form
+    regressions with no state recursion to parallelize — take no engine
+    choice and return ``()``.
     """
-    if not spec.is_kalman:
-        return ()
-    if spec.has_constant_measurement:
-        return KALMAN_ENGINES
-    return tuple(e for e in KALMAN_ENGINES if e != "assoc")
+    if spec.is_kalman:
+        if spec.has_constant_measurement:
+            return KALMAN_ENGINES
+        return tuple(e for e in KALMAN_ENGINES if e != "assoc")
+    if getattr(spec, "is_msed", False):
+        if spec.supports_score_tree:
+            return MSED_ENGINES
+        return tuple(e for e in MSED_ENGINES if e != "score_tree")
+    return ()
 
 
 def tree_engine_for(spec) -> str | None:
     """The O(log T) parallel-in-time engine for a family (``"assoc"`` for
-    constant-Z, ``"slr"`` for state-dependent measurements, ``None`` when the
+    constant-Z Kalman, ``"slr"`` for state-dependent measurements,
+    ``"score_tree"`` for the capable score-driven specs, ``None`` when the
     family has no tree engine) — what the ``YFM_LOGLIK_T_SWITCH`` policy
-    upgrades long panels to (api.get_loss, the ladder's rescue rung, the
+    upgrades long panels to (api.get_loss, the ladder's rescue rungs, the
     time-sharded objective and the serving re-filter all agree through
     this)."""
     valid = engines_for(spec)
-    if "assoc" in valid:
-        return "assoc"
-    if "slr" in valid:
-        return "slr"
+    for name in ("assoc", "slr", "score_tree"):
+        if name in valid:
+            return name
     return None
 
 # lru-cached builders of jitted losses register here (at import time) so an
@@ -170,8 +199,10 @@ _LOGLIK_T_SWITCH: int | None = None
 
 
 def loglik_t_switch() -> int:
-    """Panel length at/above which ``api.get_loss`` auto-dispatches the
-    constant-measurement Kalman families to the ``"assoc"`` engine (0 = off).
+    """Panel length at/above which ``api.get_loss`` auto-dispatches a
+    family to its O(log T) tree engine (:func:`tree_engine_for` — "assoc"
+    for constant-Z Kalman, "slr" for TVλ, "score_tree" for the capable
+    score-driven specs; 0 = off).
 
     Resolved lazily from ``YFM_LOGLIK_T_SWITCH`` so env-configured runs need
     no code; :func:`set_loglik_t_switch` overrides it process-wide.  Read at

@@ -657,11 +657,11 @@ def _resolve_objective(spec: ModelSpec, objective: str) -> str:
 
         if config.tree_engine_for(spec) is None:
             raise ValueError(
-                f"time_sharded objective needs a Kalman family with a "
+                f"time_sharded objective needs a family with a "
                 f"parallel-in-time engine (docs/DESIGN.md §13/§19); "
                 f"config.engines_for({spec.family!r}) = "
-                f"{config.engines_for(spec)} has neither 'assoc' nor 'slr' "
-                f"— use objective='vmap'")
+                f"{config.engines_for(spec)} has none of 'assoc', 'slr', "
+                f"'score_tree' — use objective='vmap'")
     return objective
 
 
@@ -700,10 +700,12 @@ def estimate(spec: ModelSpec, data, all_params, start=0, end=None,
     (``objective="vmap"``), as ONE natively-batched LBFGS whose every
     function/gradient eval is a single fused Pallas kernel launch
     (``objective="fused"``, constant-measurement Kalman families on TPU), or
-    as a vmapped LBFGS over the O(log T) associative-scan loglik with the
-    panel's TIME axis sharded across the device mesh
-    (``objective="time_sharded"``, constant-Z families — the long-panel path,
-    docs/DESIGN.md §13).  ``"auto"`` picks fused whenever it is available.
+    as a vmapped LBFGS over the family's O(log T) parallel-in-time loss with
+    the panel's TIME axis sharded across the device mesh
+    (``objective="time_sharded"``, any family with a tree engine — assoc for
+    constant-Z Kalman, iterated SLR for TVλ, score_tree for the capable
+    score-driven specs — the long-panel path, docs/DESIGN.md §13/§19).
+    ``"auto"`` picks fused whenever it is available.
     Independently of the objective, the loss ENGINE inside the vmap path
     follows ``config.set_kalman_engine`` / the ``YFM_LOGLIK_T_SWITCH``
     dispatch policy through ``api.get_loss``.

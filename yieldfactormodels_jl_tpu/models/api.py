@@ -88,6 +88,48 @@ def get_loss(spec: ModelSpec, params, data, start=0, end=None, K: int = 1,
 
             return slr_scan.get_loss(spec, params, data, start, end)
         return univariate_kf.get_loss(spec, params, data, start, end)
+    if spec.is_msed:
+        # The score-driven families carry the same engine seam
+        # (config.MSED_ENGINES): "scan" is the sequential reference-parity
+        # default, "score_tree" the O(log T) parallel-in-time engine for
+        # the capable specs (spec.supports_score_tree — docs/DESIGN.md §19).
+        from .. import config
+
+        valid = config.engines_for(spec)
+        if engine is not None and engine not in valid:
+            raise ValueError(
+                f"engine {engine!r} is not applicable to family "
+                f"{spec.family!r}; config.engines_for lists {valid}")
+        name = engine or "scan"
+        if name == "score_tree" and K != 1:
+            # the tree has no K-replay semantics (K >= 2 CONTINUES the
+            # sequential filter from its end state — a second pass, not a
+            # restart); keep the contract loud instead of approximating
+            raise ValueError(
+                "engine 'score_tree' supports K=1 only; use the sequential "
+                "'scan' engine for K-replay losses")
+        if (engine is None and K == 1
+                and 0 < config.loglik_t_switch() <= data.shape[1]
+                and config.tree_engine_for(spec) == "score_tree"):
+            # the same YFM_LOGLIK_T_SWITCH policy as the Kalman branch:
+            # long panels ride the family's tree engine, short panels keep
+            # the sequential default; only the production default upgrades
+            name = "score_tree"
+        if name == "score_tree":
+            from ..ops import score_scan
+
+            return score_scan.get_loss(spec, params, data, start, end)
+        return score_driven.get_loss(spec, params, data, start, end, K)
+    if engine is not None:
+        # static families are closed-form regressions with no state
+        # recursion to parallelize — engines_for(spec) is () and an
+        # explicit choice is a caller error, not a silent ignore
+        from .. import config
+
+        raise ValueError(
+            f"engine {engine!r} is not applicable to family "
+            f"{spec.family!r}; config.engines_for lists "
+            f"{config.engines_for(spec)}")
     return _engine(spec).get_loss(spec, params, data, start, end, K)
 
 

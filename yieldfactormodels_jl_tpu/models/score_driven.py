@@ -75,6 +75,31 @@ def _score(spec: ModelSpec, gamma, beta_detached, y):
     return jax.grad(neg_sq_err)(gamma)
 
 
+def plain_gamma_update(spec: ModelSpec, mp: MSEDParams, gamma, ysafe, obs):
+    """The non-``scale_grad`` γ measurement update — OLS β̄, analytic score,
+    γ ← γ + A⊙score on observed steps — returned as ``(gamma_obs, Z)``.
+
+    Single source shared by the sequential :func:`_step` and the score-tree
+    engine (ops/score_scan.py), which linearizes exactly this map for its
+    affine prefix elements and re-runs it exactly in the refinement sweeps
+    (the ``spec.supports_score_tree`` capability is precisely "the γ update
+    is THIS function")."""
+    Z = loadings_fn(spec, gamma)
+    beta_ols = ols_solve(Z, ysafe)
+    beta_for_score = lax.stop_gradient(beta_ols) if spec.detach_inner_beta else beta_ols
+    grad = _score(spec, gamma, beta_for_score, ysafe)
+    return jnp.where(obs, gamma + grad * mp.A, gamma), Z
+
+
+def plain_gamma_transition(mp: MSEDParams, gamma_obs):
+    """γ ← ν + B⊙γ (identity for random-walk dynamics where B is empty) —
+    the transition half of the γ recursion, shared with ops/score_scan.py
+    for the same single-source reason as :func:`plain_gamma_update`."""
+    if mp.B is None:
+        return gamma_obs
+    return mp.nu + mp.B * gamma_obs
+
+
 def _step(spec: ModelSpec, mp: MSEDParams, state: MSEDState, y, observed):
     gamma, beta, ewma, count = state
     dtype = gamma.dtype
@@ -86,12 +111,11 @@ def _step(spec: ModelSpec, mp: MSEDParams, state: MSEDState, y, observed):
     poison = partial_nan_poison(y, obs)
 
     # --- measurement update (computed unconditionally, masked in) ---
-    Z = loadings_fn(spec, gamma)
-    beta_ols = ols_solve(Z, ysafe)
-    beta_for_score = lax.stop_gradient(beta_ols) if spec.detach_inner_beta else beta_ols
-    grad = _score(spec, gamma, beta_for_score, ysafe)
-
     if spec.scale_grad:
+        Z = loadings_fn(spec, gamma)
+        beta_ols = ols_solve(Z, ysafe)
+        beta_for_score = lax.stop_gradient(beta_ols) if spec.detach_inner_beta else beta_ols
+        grad = _score(spec, gamma, beta_for_score, ysafe)
         ff = jnp.asarray(spec.forget_factor, dtype)
         new_ewma = ff * ewma + (1.0 - ff) * grad * grad
         new_count = count + 1
@@ -101,9 +125,9 @@ def _step(spec: ModelSpec, mp: MSEDParams, state: MSEDState, y, observed):
         gamma_upd = gamma + scaled * mp.A
         ewma = jnp.where(obs, new_ewma, ewma)
         count = jnp.where(obs, new_count, count)
+        gamma_obs = jnp.where(obs, gamma_upd, gamma)
     else:
-        gamma_upd = gamma + grad * mp.A
-    gamma_obs = jnp.where(obs, gamma_upd, gamma)
+        gamma_obs, Z = plain_gamma_update(spec, mp, gamma, ysafe, obs)
 
     Z_upd = loadings_fn(spec, gamma_obs)
     beta_reols = ols_solve(Z_upd, ysafe)

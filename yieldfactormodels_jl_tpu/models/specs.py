@@ -130,6 +130,20 @@ class ModelSpec:
         return self.family in MSED_FAMILIES
 
     @property
+    def supports_score_tree(self) -> bool:
+        """Score-driven spec whose recursion the O(log T) tree engine
+        (ops/score_scan.py) can carry — THE applicability gate for the
+        score-tree engine and everything built on it (``config.engines_for``,
+        the T-switch dispatch, ``objective="time_sharded"``, the ladder's
+        score_tree rung — docs/DESIGN.md §19), the MSED twin of
+        ``has_constant_measurement``.  Requires the plain gradient update
+        γ ← γ + A⊙score: the ``scale_grad`` lineage carries an EWMA
+        second-moment state whose Adam-style normalization is not a
+        small-state affine recursion, so those specs keep the sequential
+        scan (and return ``False`` here)."""
+        return self.is_msed and not self.scale_grad
+
+    @property
     def is_static(self) -> bool:
         return self.family in STATIC_FAMILIES
 

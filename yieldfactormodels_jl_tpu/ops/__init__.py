@@ -1,7 +1,8 @@
 from . import linalg
 
 __all__ = ["linalg", "assoc_scan", "particle", "pallas_kf", "pallas_pf",
-           "pallas_ssd", "smoother", "sqrt_kf", "univariate_kf"]
+           "pallas_ssd", "score_scan", "slr_scan", "smoother", "sqrt_kf",
+           "univariate_kf"]
 
 
 def __getattr__(name):

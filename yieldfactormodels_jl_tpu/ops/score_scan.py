@@ -1,0 +1,350 @@
+"""Score-driven (MSED) recursion on the parallel-in-time tree.
+
+The score-driven filter (models/score_driven.py, filter.jl:52-91) was the
+last `MODEL_CODES` lineage pinned to a sequential ``lax.scan``: its state
+update is a gradient recursion, not a Kalman step, so neither the
+associative-scan elements (ops/assoc_scan.py) nor the SLR Woodbury elements
+(ops/slr_scan.py) apply.  Statistical/posterior linearization is more
+general than either: ANY state recursion x_t = f_t(x_{t−1}) admits a
+per-step affine surrogate x_t ≈ J_t x_{t−1} + b_t, and affine maps compose
+associatively — (J₂, b₂)∘(J₁, b₁) = (J₂J₁, J₂b₁ + b₂) — so the same
+two-scale design that carried TVλ (arXiv:2207.00426 idea; docs/DESIGN.md
+§19) carries the score recursion:
+
+- **pass A** (once): linearize the TRUE per-step γ map — measurement update
+  ``score_driven.plain_gamma_update`` (OLS β̄, analytic score, γ += A⊙score)
+  composed with the transition γ ← ν + B⊙γ — around the STATIONARY reference
+  ω (γ₀ = ω is the transition's fixed point, exactly like the SLR engine's
+  unconditional-mean reference), one ``jacfwd`` vmapped over T.  Missing
+  steps are exactly affine (diag(B), ν).  The composed prefix of these
+  elements is the surrogate γ trajectory at O(log T) span.  β needs no
+  surrogate at all: on observed steps the reference recursion fully RESETS
+  β to the OLS fit (β_obs is independent of β_{t−1} — the same structural
+  fact the closed-form (δ, Φ) solve in estimation/optimize.py exploits), so
+  given the γ path the β recursion is EXACTLY affine per step — a second
+  composed prefix, no approximation.
+- **pass B** (K sweeps): re-run the TRUE recursion (``score_driven._step``,
+  vmapped over the chunk axis) within length-L chunks seeded from the
+  composed entry states, Jacobi-shifting entries to the previous sweep's
+  chunk exits.  Boundary errors contract at ≈∏B per step (the recursion's
+  own forgetting), so K = 2 sits at parity tolerance against the sequential
+  scan; the final sweep's predictions feed the exact reference loss.
+
+Applicability is ``spec.supports_score_tree`` (the plain γ update only —
+the ``scale_grad`` EWMA lineage is not a small-state affine recursion), the
+registry entry is ``config.MSED_ENGINES["score_tree"]``, and the engine
+matrix seam is ``config.engines_for`` / ``tree_engine_for`` like every
+other tree engine.  Same conventions as the siblings: −Inf sentinel +
+taxonomy codes, trace-counter no-recompile pins, ``prefix="interleaved"``
+for the time-sharded layout with the refinement chunk pinned to the shard
+length (parallel/time_parallel.py), oracle parity against the independent
+NumPy loops in tests/oracle.py (linearized_score_filter — never
+JAX-vs-JAX).
+
+One deliberate divergence from ops/slr_scan.py: the tree entries are NOT
+``stop_gradient``-cut at K ≥ 2.  The SLR cut was a measured-cost call (the
+Kalman combine tree's reverse pass dominated, and ρ^L forgetting makes the
+cut adjoint negligible); here the tree is an L-dimensional affine compose
+(L = 1 for msed_lambda) whose reverse pass is cheap, while the recursion's
+forgetting ≈B^L is WEAK at realistic B → 1 — cutting would cost real
+gradient accuracy for no measurable wall.  Grad parity vs the sequential
+scan is pinned in tests/test_score_scan.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..models import score_driven as SD
+from ..models.common import partial_nan_poison, window_contributions
+from ..models.params import unpack_msed
+from ..models.specs import ModelSpec
+from ..ops.linalg import ols_solve
+from ..robustness import taxonomy as tax
+from .assoc_scan import _CHUNK, _bmm, _mv
+
+from .. import config as _config  # noqa: E402  (after the jax imports above)
+
+trace_counts, _note_trace, reset_trace_counts = _config.make_trace_counter()
+
+#: default refinement sweep count K — same two-scale rationale as
+#: slr_scan.DEFAULT_SWEEPS: sweep 1 refines every chunk exactly from the
+#: tree's globally-coupled entries, sweep 2 repeats from sweep 1's exits,
+#: and the remaining boundary error contracts at the recursion's own ≈B^L
+#: per-chunk forgetting (K is static; each value traces its own program).
+DEFAULT_SWEEPS = 2
+
+#: default refinement chunk length L.  Larger than assoc/slr's ``_CHUNK``
+#: (128) on purpose: the score recursion's per-chunk contraction is its own
+#: forgetting ≈∏B ≈ B^L with B → 1 in practice (0.97^128 ≈ 0.02 but
+#: 0.97^256 ≈ 4e-4), and the refinement step is tiny (OLS + analytic score,
+#: no covariance algebra), so a longer chunk buys both accuracy AND wall —
+#: measured on the 20k single-chain value+grad workload the L = 256 sweep
+#: beats both L = 128 and L = 512 (the latter pays scan-length dispatch).
+DEFAULT_CHUNK = 256
+
+
+def _affine_combine(e1, e2):
+    """Associative composition of affine maps applied in time order —
+    ``e2 ∘ e1`` for elements (J, b) meaning x ↦ Jx + b: (J₂J₁, J₂b₁ + b₂).
+    Broadcast-multiply-reduce matmuls (assoc_scan's ``_bmm``/``_mv``) so the
+    combine vectorizes over any leading batch/tree layout."""
+    J1, b1 = e1
+    J2, b2 = e2
+    return _bmm(J2, J1), _mv(J2, b1) + b2
+
+
+def _affine_prefix(J, b, T: int, prefix: str):
+    """Composed prefix STATES of the affine chain x_t = J_t x_{t−1} + b_t
+    whose start state was absorbed into element 0 (J₀ = 0, b₀ = f₀(x₋₁)):
+    every prefix then has zero slope, so the states are just the composed
+    offsets — returns b(P_t) of shape (T, n).
+
+    ``"blocked"`` mirrors ``assoc_scan._prefix_scan``'s three-pass schedule
+    (chunk-local scan → associative scan over chunk totals → one batched
+    apply that, like the assoc engine's, only needs the offset outputs);
+    ``"interleaved"`` is one ``lax.associative_scan`` over time — the
+    block-local schedule the time-sharded layout needs."""
+    if prefix == "interleaved":
+        _, states = lax.associative_scan(_affine_combine, (J, b), axis=0)
+        return states
+    n = J.shape[-1]
+    L = min(_CHUNK, T)
+    Cn = -(-T // L)
+    pad = Cn * L - T
+    if pad:  # identity elements: padding cannot move any real prefix
+        eye = jnp.broadcast_to(jnp.eye(n, dtype=J.dtype),
+                               (pad,) + J.shape[1:])
+        J = jnp.concatenate([J, eye], axis=0)
+        b = jnp.concatenate(
+            [b, jnp.zeros((pad,) + b.shape[1:], dtype=b.dtype)], axis=0)
+    Jc = J.reshape(Cn, L, n, n).swapaxes(0, 1)            # (L, C, n, n)
+    bc = b.reshape(Cn, L, n).swapaxes(0, 1)               # (L, C, n)
+    eyeC = jnp.broadcast_to(jnp.eye(n, dtype=J.dtype), (Cn, n, n))
+    zeroC = jnp.zeros((Cn, n), dtype=b.dtype)
+
+    def local(carry, e):
+        out = _affine_combine(carry, e)
+        return out, out
+
+    (Jt, bt), (Jl, bl) = lax.scan(local, (eyeC, zeroC), (Jc, bc))
+    # exclusive prefix over the chunk totals = each chunk's entry map
+    Jg, bg = lax.associative_scan(_affine_combine, (Jt, bt), axis=0)
+    bg = jnp.concatenate([zeroC[:1], bg[:-1]], axis=0)
+    # apply: b(local ∘ entry) = J_local·b_entry + b_local (J never needed —
+    # chunk 0's entry offset is the absorbed start state itself, 0 here)
+    states = _mv(Jl, bg[None]) + bl                       # (L, C, n)
+    return states.swapaxes(0, 1).reshape(Cn * L, n)[:T]
+
+
+def _gamma_elements(spec: ModelSpec, mp, ysafe_T, obs):
+    """Per-step affine surrogate (J_t (T, L, L), b_t (T, L)) of the TRUE
+    post-transition γ map, linearized at the stationary reference ω — one
+    vmapped ``jacfwd`` of exactly the recursion pass B re-runs
+    (``plain_gamma_update`` + ``plain_gamma_transition``), so the surrogate
+    and the refinement can never drift.  Missing steps come out EXACTLY
+    affine (the map is ν + B⊙γ already); a non-finite score at a broken
+    parameter point lands in the engine's −Inf sentinel downstream."""
+
+    def fmap(g, y, o):
+        g_obs, _ = SD.plain_gamma_update(spec, mp, g, y, o)
+        return SD.plain_gamma_transition(mp, g_obs)
+
+    def elem(y, o):
+        J = jax.jacfwd(fmap)(mp.omega, y, o)
+        return J, fmap(mp.omega, y, o) - _mv(J, mp.omega)
+
+    return jax.vmap(elem)(ysafe_T, obs)
+
+
+def _beta_elements(spec: ModelSpec, mp, gprev, data_T, obs):
+    """Per-step EXACT affine elements (A_t (T, M, M), b_t (T, M)) of the β
+    recursion given the composed γ path ``gprev`` (the pre-step states):
+    observed steps reset β to the re-OLS fit — β_next = μ + Φ·(OLS·poison),
+    slope 0 — and missing steps are the bare transition (Φ, μ).  The
+    reference-parity partial-NaN poison taints exactly like the sequential
+    step (NaN elements compose into NaN states → −Inf loss)."""
+    dtype = gprev.dtype
+
+    def elem(g, yraw, o):
+        ysafe = jnp.where(jnp.isfinite(yraw), yraw, 0.0)
+        poison = partial_nan_poison(yraw, o)
+        g_obs, _ = SD.plain_gamma_update(spec, mp, g, ysafe, o)
+        beta_reols = ols_solve(SD.loadings_fn(spec, g_obs), ysafe)
+        of = o.astype(dtype)
+        A = ((1.0 - of) * poison) * mp.Phi
+        bvec = mp.mu + (of * poison) * (mp.Phi @ beta_reols)
+        return A, bvec
+
+    return jax.vmap(elem)(gprev, data_T, obs)
+
+
+def _absorb_start(J, b, x0):
+    """Fold the start state into element 0: b₀ ← J₀x₀ + b₀, J₀ ← 0 — after
+    which every composed prefix offset IS the state (see _affine_prefix)."""
+    b = b.at[0].set(b[0] + _mv(J[0], x0))
+    return J.at[0].set(0.0), b
+
+
+def _chunked_refine(spec: ModelSpec, mp, data_p, observed_p, entry_g,
+                    entry_b, L: int, Cn: int):
+    """Pass B: the TRUE score recursion (``score_driven._step`` — the
+    sequential engine's own step, vmapped over the chunk axis) re-run within
+    chunks from the composed entry states.  EWMA state enters zeroed — the
+    ``supports_score_tree`` gate guarantees it is never read.  Returns
+    per-step (pred, γ_next, β_next, code) stacked back to (C·L, ...) time
+    order."""
+    N = spec.N
+    y_cl = data_p.T.reshape(Cn, L, N).swapaxes(0, 1)      # (L, C, N)
+    obs_cl = observed_p.reshape(Cn, L).swapaxes(0, 1)     # (L, C)
+    step_v = jax.vmap(lambda st, y, o: SD._step(spec, mp, st, y, o))
+    st0 = SD.MSEDState(entry_g, entry_b, jnp.zeros_like(entry_g),
+                       jnp.zeros((Cn,), dtype=jnp.int32))
+
+    def body(st, inp):
+        y, o = inp
+        st2, out = step_v(st, y, o)
+        return st2, (out["pred"], out["gamma"], out["beta"], out["code"])
+
+    _, outs = lax.scan(body, st0, (y_cl, obs_cl))
+    return tuple(
+        jnp.swapaxes(o, 0, 1).reshape((Cn * L,) + o.shape[2:]) for o in outs)
+
+
+def _resolve_sweeps(sweeps: int | None) -> int:
+    K_sweeps = DEFAULT_SWEEPS if sweeps is None else int(sweeps)
+    if K_sweeps < 1:
+        raise ValueError(f"sweeps must be >= 1, got {K_sweeps}")
+    return K_sweeps
+
+
+def _filter_sweeps(spec: ModelSpec, params, data, start, end,
+                   prefix: str, sweeps: int | None, chunk: int | None):
+    """The iterated two-pass forward sweep: composed affine prefixes seed
+    the chunk entries, K true-recursion sweeps refine.  Returns
+    ``(preds, gammas, betas, codes)`` (each length T, time order) — at the
+    fixed point the sequential scan's outputs, step for step."""
+    if prefix not in ("blocked", "interleaved"):
+        raise ValueError(f"unknown prefix schedule {prefix!r}; pick from "
+                         f"('blocked', 'interleaved')")
+    if not getattr(spec, "supports_score_tree", False):
+        raise ValueError(
+            f"the score_tree engine needs a plain-gradient score-driven "
+            f"family (spec.supports_score_tree); "
+            f"config.engines_for({spec.family!r}) = {_config.engines_for(spec)}")
+    K_sweeps = _resolve_sweeps(sweeps)
+    _note_trace("score_filter")
+    mp = unpack_msed(spec, params)
+    T = data.shape[1]
+    if end is None:
+        end = T
+    t_idx = jnp.arange(T)
+    in_win = (t_idx >= start) & (t_idx < end)
+    obs = in_win & jnp.isfinite(data[0, :])   # filter.jl:53 convention
+    data_T = data.T                                        # (T, N)
+    ysafe_T = jnp.where(jnp.isfinite(data_T), data_T, 0.0)
+
+    # pass A — composed affine surrogates (γ linearized at ω; β exact
+    # given the γ path), both at O(log T) span
+    Jg, bg = _gamma_elements(spec, mp, ysafe_T, obs)
+    Jg, bg = _absorb_start(Jg, bg, mp.omega)
+    gs = _affine_prefix(Jg, bg, T, prefix)                 # (T, L) post-step
+    gprev = jnp.concatenate([mp.omega[None], gs[:-1]], axis=0)
+    Jb, bb = _beta_elements(spec, mp, gprev, data_T, obs)
+    Jb, bb = _absorb_start(Jb, bb, mp.delta)
+    bs = _affine_prefix(Jb, bb, T, prefix)                 # (T, M) post-step
+
+    L = min(DEFAULT_CHUNK if chunk is None else int(chunk), T)
+    if L < 1:
+        raise ValueError(f"chunk must be >= 1, got {L}")
+    Cn = -(-T // L)
+    pad = Cn * L - T
+    data_p = data if not pad else jnp.concatenate(
+        [data, jnp.full(data.shape[:1] + (pad,), jnp.nan, dtype=data.dtype)],
+        axis=1)
+    observed_p = in_win if not pad else jnp.concatenate(
+        [in_win, jnp.zeros((pad,), bool)])
+    bidx = jnp.arange(1, Cn) * L - 1       # chunk-entry steps (post-step at)
+    entry_g = jnp.concatenate([mp.omega[None], gs[bidx]], axis=0)
+    entry_b = jnp.concatenate([mp.delta[None], bs[bidx]], axis=0)
+
+    preds = gammas = betas = codes = None
+    exit_idx = jnp.arange(Cn) * L + (L - 1)
+    for k in range(K_sweeps):
+        if k > 0:
+            # Jacobi relaxation, same schedule as the SLR engine: entries
+            # are the previous sweep's chunk exits, shifted one chunk right
+            # (chunk 0 keeps the exact start state); each sweep contracts
+            # boundary error by the chunk's own ≈B^L forgetting
+            entry_g = jnp.concatenate(
+                [mp.omega[None], gammas[exit_idx[:-1]]], axis=0)
+            entry_b = jnp.concatenate(
+                [mp.delta[None], betas[exit_idx[:-1]]], axis=0)
+        preds, gammas, betas, codes = _chunked_refine(
+            spec, mp, data_p, observed_p, entry_g, entry_b, L, Cn)
+    return preds[:T], gammas[:T], betas[:T], codes[:T]
+
+
+def _loss_coded(spec: ModelSpec, params, data, start=0, end=None,
+                prefix: str = "blocked", sweeps: int | None = None,
+                chunk: int | None = None):
+    """Shared loss pass ``(loss, code, (gammas, betas))`` — the exact
+    reference loss (one-step-ahead forecast MSE over the contribution
+    window, normalized by N·nobs) on the final sweep's predictions, with
+    the same −Inf sentinel and taxonomy channel as the sequential engine
+    (``score_driven.get_loss_coded``)."""
+    preds, gammas, betas, codes = _filter_sweeps(spec, params, data, start,
+                                                 end, prefix, sweeps, chunk)
+    T = data.shape[1]
+    if end is None:
+        end = T
+    nobs = end - start
+    total = jnp.sum(window_contributions(preds, data, start, end))
+    loss = total / spec.N / nobs
+    loss = jnp.where(jnp.isfinite(loss), loss, -jnp.inf)
+    t_idx = jnp.arange(T)
+    in_win = (t_idx >= start) & (t_idx < end)
+    observed = in_win & jnp.isfinite(data[0, :])
+    code = tax.params_code(params) \
+        | tax.combine(jnp.where(in_win, codes, jnp.int32(0))) \
+        | tax.bit(~jnp.any(observed), tax.MISSING_ALL_OBS)
+    code = code | tax.bit(~jnp.isfinite(loss) & (code == 0),
+                          tax.STATE_EXPLODED)
+    return loss, code, (gammas, betas)
+
+
+def get_loss(spec: ModelSpec, params, data, start=0, end=None,
+             prefix: str = "blocked", sweeps: int | None = None,
+             chunk: int | None = None):
+    """The score-driven loss at O(log T) span — converges to the sequential
+    ``score_driven.get_loss`` (K = 1 replay) at ≈B^L per sweep,
+    differentiable end-to-end (tree included — see the module docstring on
+    the deliberate no-cut divergence from the SLR engine)."""
+    loss, _, _ = _loss_coded(spec, params, data, start, end, prefix, sweeps,
+                             chunk)
+    return loss
+
+
+def get_loss_coded(spec: ModelSpec, params, data, start=0, end=None,
+                   prefix: str = "blocked", sweeps: int | None = None,
+                   chunk: int | None = None):
+    """``(loss, code)`` — :func:`get_loss` plus its taxonomy bitmask, the
+    self-describing failure channel every engine carries (the ladder's
+    score_tree rescue rung reads this)."""
+    loss, code, _ = _loss_coded(spec, params, data, start, end, prefix,
+                                sweeps, chunk)
+    return loss, code
+
+
+def filter_states(spec: ModelSpec, params, data, start=0, end=None,
+                  prefix: str = "blocked", sweeps: int | None = None,
+                  chunk: int | None = None):
+    """Post-transition state trajectories ``(gammas (T, L), betas (T, M))``
+    from the final refinement sweep — the tree twin of reading
+    ``scan_filter``'s outs (the parity surface tests/test_score_scan.py
+    pins element-wise against the sequential scan and the NumPy oracle)."""
+    _, _, (gammas, betas) = _loss_coded(spec, params, data, start, end,
+                                        prefix, sweeps, chunk)
+    return gammas, betas

@@ -131,6 +131,101 @@ def _resolve_linearization(name: str | None) -> str:
     return name
 
 
+def _chol_unrolled(P):
+    """Lower Cholesky factor of (…, Ms, Ms) SPD matrices, unrolled over the
+    static tiny state dimension — the factorization twin of
+    ``assoc_scan._solve_unrolled``: pure broadcast arithmetic that vectorizes
+    over the chunk batch, where ``jnp.linalg.cholesky`` would lower to
+    per-matrix LAPACK dispatch on CPU and a lane-hostile loop on TPU.  A
+    non-PD input goes NaN through the sqrt and lands in the engine's −Inf
+    sentinel + STATE_EXPLODED taxonomy like every other breakdown (the
+    ``psd_floor`` recovery surface projects entry moments before they get
+    here)."""
+    Ms = P.shape[-1]
+    rows: list = [[None] * Ms for _ in range(Ms)]
+    for j in range(Ms):
+        s = P[..., j, j]
+        for k in range(j):
+            s = s - rows[j][k] * rows[j][k]
+        diag = jnp.sqrt(s)
+        rows[j][j] = diag
+        for i in range(j + 1, Ms):
+            t = P[..., i, j]
+            for k in range(j):
+                t = t - rows[i][k] * rows[j][k]
+            rows[i][j] = t / diag
+    zero = jnp.zeros_like(P[..., 0, 0])
+    return jnp.stack(
+        [jnp.stack([rows[i][j] if j <= i else zero for j in range(Ms)],
+                   axis=-1) for i in range(Ms)], axis=-2)
+
+
+def _tri_solve_right_unrolled(B, Lc):
+    """Solve X·L = B for lower-triangular ``Lc`` (…, Ms, Ms) and
+    B (…, N, Ms) by unrolled back-substitution over the static columns —
+    same no-dispatch rationale as :func:`_chol_unrolled` (the sigma-point
+    regression slope needs ``D_hᵀ L⁻¹``, never an explicit inverse)."""
+    Ms = Lc.shape[-1]
+    cols: list = [None] * Ms
+    for j in range(Ms - 1, -1, -1):
+        t = B[..., j]
+        for k in range(j + 1, Ms):
+            t = t - cols[k] * Lc[..., k, j][..., None]
+        cols[j] = t / Lc[..., j, j][..., None]
+    return jnp.stack(cols, axis=-1)
+
+
+def _tvl_h_lanes(spec: ModelSpec, chi, mats):
+    """TVλ measurement h(β) evaluated at sigma points ``chi`` (…, Ms, S)
+    with the point axis TRAILING (the lane rule: S = 2·Ms+1 rides the TPU
+    lane dimension) → ŷ (…, N, S).  Restates ``kalman._tvl_measurement``'s
+    ŷ half (kalman/filter.jl:31-47) through the shared loadings helpers
+    (``dns_lambda``/``dns_slope_curvature``) so the decay-floor and NS
+    shapes cannot drift; no Jacobian — the sigma-point rule replaces it."""
+    from ..models.loadings import dns_lambda, dns_slope_curvature
+
+    lam = dns_lambda(chi[..., 3, :])                        # (…, S)
+    z2, z3 = dns_slope_curvature(lam[..., None, :], mats[:, None])
+    return (chi[..., 0:1, :] + z2 * chi[..., 1:2, :]
+            + z3 * chi[..., 2:3, :])                        # (…, N, S)
+
+
+def _sigma_linearize(spec: ModelSpec, m, P, mats):
+    """Statistical (sigma-point) linearization of the TVλ measurement at
+    (m (…, Ms), P (…, Ms, Ms)): the ``"ukf"`` rule of ``config.SLR_ENGINES``.
+
+    Unscented cubature with κ = 1 (c = Ms+1): χ₀ = m,
+    χᵢ± = m ± √c·L·eᵢ with P = LLᵀ, weights w₀ = 1/c, wᵢ = 1/(2c) — all
+    positive for every Ms here (the classic κ = 3−Ms goes negative at
+    Ms ≥ 4, which would break the PSD reading of the SLR moments).  The SLR
+    regression slope collapses to a triangular solve:
+    Ψ = Σ wᵢ (χᵢ−m)(h(χᵢ)−μ)ᵀ = L·(√c·wᵢ·D_h) with D_h rows h(χᵢ⁺)−h(χᵢ⁻),
+    so Z = Ψᵀ P⁻¹ = D_hᵀ L⁻¹ / (2√c) and d = μ − Z m.  DELIBERATE
+    divergence from the full sigma-point filter: the SLR residual
+    covariance Ω = E[(h−Zx−d)(·)ᵀ] is OMITTED from the observation noise —
+    keeping R diagonal is what lets the Woodbury element assembly and the
+    sequential-observation update stay pivot-free (the module contract);
+    the oracle (tests/oracle.py sigma-point loops) defines the identical
+    semantics, so the sequential fixed point is the statistically
+    linearized filter with unmodified R.  Returns (Z (…, N, Ms),
+    d (…, N), μ (…, N))."""
+    Ms = m.shape[-1]
+    c = float(Ms + 1)
+    scale = math.sqrt(c)
+    Lc = _chol_unrolled(P)
+    offs = jnp.concatenate(
+        [jnp.zeros_like(Lc[..., :, :1]), scale * Lc, -scale * Lc], axis=-1)
+    chi = m[..., :, None] + offs                            # (…, Ms, S)
+    h = _tvl_h_lanes(spec, chi, mats)                       # (…, N, S)
+    h0 = h[..., 0]
+    hp = h[..., 1:Ms + 1]
+    hm = h[..., Ms + 1:]
+    mu = h0 / c + jnp.sum(hp + hm, axis=-1) / (2.0 * c)
+    Z = _tri_solve_right_unrolled((hp - hm) / (2.0 * scale), Lc)
+    d = mu - _mv(Z, m)
+    return Z, d, mu
+
+
 def _resolve_sweeps(spec: ModelSpec, sweeps: int | None) -> int:
     """K for a family: constant-measurement families are their own fixed
     point after one sweep (the linearization cannot move), so extra sweeps
@@ -141,16 +236,26 @@ def _resolve_sweeps(spec: ModelSpec, sweeps: int | None) -> int:
     return 1 if spec.has_constant_measurement else K_sweeps
 
 
-def _linearize_trajectory(spec: ModelSpec, kp, beta_bar, dtype):
+def _linearize_trajectory(spec: ModelSpec, kp, beta_bar, dtype,
+                          rule: str = "ekf", P_bar=None):
     """(Z_all (T, N, Ms), d_all (T, N)) — the affine measurement surrogate
     y_t ≈ Z_t x_t + d_t linearized at the reference trajectory ``beta_bar``
-    (T, Ms).  For the TVλ EKF family Z_t is the analytic Jacobian at β̄_t
+    (T, Ms).  For the TVλ EKF family the ``rule`` (``config.SLR_ENGINES``)
+    picks the surrogate: ``"ekf"`` takes the analytic Jacobian at β̄_t
     (``kalman._tvl_measurement`` — the single source of truth the sequential
-    engines use) and d_t = h(β̄_t) − Z_t β̄_t; constant-Z families broadcast
-    their loadings (the reference point is ignored)."""
+    engines use) with d_t = h(β̄_t) − Z_t β̄_t; ``"ukf"`` statistically
+    linearizes at (β̄, ``P_bar``) — the stationary predicted covariance,
+    constant like the mean reference, so one sigma-point regression
+    broadcasts over T (:func:`_sigma_linearize`).  Constant-Z families
+    broadcast their loadings (the reference point is ignored; an affine h
+    is its own statistical linearization, so the rule is moot there)."""
     T = beta_bar.shape[0]
     if spec.family == "kalman_tvl":
         mats = spec.maturities_array
+        if rule == "ukf":
+            Z1, d1, _ = _sigma_linearize(spec, beta_bar[0], P_bar, mats)
+            return (jnp.broadcast_to(Z1, (T,) + Z1.shape),
+                    jnp.broadcast_to(d1, (T,) + d1.shape))
         Z_all, y_pred = jax.vmap(
             lambda b: K._tvl_measurement(spec, b, mats))(beta_bar)
         d_all = y_pred - _mv(Z_all, beta_bar)
@@ -283,7 +388,7 @@ def _seq_update_batched(spec: ModelSpec, Z, y_eff, beta, P, obs_var):
 
 
 def _chunked_refine(spec: ModelSpec, kp, data_p, observed_p, entry_m,
-                    entry_P, L: int, Cn: int):
+                    entry_P, L: int, Cn: int, rule: str = "ekf"):
     """Pass B: exact nonlinear re-propagation within chunks, batched over
     the chunk axis.
 
@@ -291,9 +396,12 @@ def _chunked_refine(spec: ModelSpec, kp, data_p, observed_p, entry_m,
     moments at the last pre-chunk step (chunk 0 gets the stationary prior,
     for which predict is a no-op — identical to the sequential engines'
     start).  Every scan step predicts, linearizes at the chunk's own
-    predicted mean (``kalman._tvl_measurement`` — the exact EKF recursion,
-    no surrogate), and applies the sequential-observation update; all C
-    chunks advance in lanes.  Returns per-step ``(beta_pred, m_filt,
+    predicted moments — ``rule`` "ekf": first-order at the predicted mean
+    (``kalman._tvl_measurement``, the exact EKF recursion); "ukf":
+    sigma-point statistical linearization at the predicted (mean,
+    covariance) pair (:func:`_sigma_linearize`, the exact statistically
+    linearized recursion) — and applies the sequential-observation update;
+    all C chunks advance in lanes.  Returns per-step ``(beta_pred, m_filt,
     P_filt, ll, obs, code)`` stacked back to (C·L, ...) time order —
     ``ll`` in the per-step joint convention (0 unobserved, −Inf on a failed
     innovation chain).
@@ -312,7 +420,14 @@ def _chunked_refine(spec: ModelSpec, kp, data_p, observed_p, entry_m,
         y, obs_t = inp
         b = kp.delta[None] + b @ kp.Phi.T                     # predict
         P = _bmm(_bmm(kp.Phi, P), kp.Phi.T) + kp.Omega_state
-        if spec.family == "kalman_tvl":
+        if spec.family == "kalman_tvl" and rule == "ukf":
+            Z, d_sig, mu_h = _sigma_linearize(spec, b, P, mats)
+            # same fixed-linearization effective-observation trick as the
+            # EKF branch: v_i = y_eff_i − z_iᵀb = y_i − μ_i, the innovation
+            # against the sigma-point predicted measurement mean
+            ysafe = jnp.where(jnp.isfinite(y), y, mu_h)
+            y_eff = ysafe - d_sig
+        elif spec.family == "kalman_tvl":
             Z, y_hat = jax.vmap(
                 lambda bb: K._tvl_measurement(spec, bb, mats))(b)
             # fixed-linearization effective observation (the univariate
@@ -360,7 +475,7 @@ def _filter_sweeps(spec: ModelSpec, params, data, start, end, psd_floor,
         raise ValueError(
             f"the slr engine needs a Kalman family; "
             f"config.engines_for({spec.family!r}) = {config.engines_for(spec)}")
-    _resolve_linearization(linearization)
+    rule = _resolve_linearization(linearization)
     _note_trace("slr_filter")
     K_sweeps = _resolve_sweeps(spec, sweeps)
     kp = unpack_kalman(spec, params)
@@ -395,8 +510,10 @@ def _filter_sweeps(spec: ModelSpec, params, data, start, end, psd_floor,
     # boundary per sweep, which stalls exactly where the filter forgets
     # slowly: long missing stretches, near-unit persistence).
     mpred1 = kp.Phi @ state0.beta + kp.delta
+    Ppred1 = _bmm(_bmm(kp.Phi, P0), kp.Phi.T) + kp.Omega_state
     beta_bar = jnp.broadcast_to(mpred1, (T,) + mpred1.shape)
-    Z_all, d_all = _linearize_trajectory(spec, kp, beta_bar, dtype)
+    Z_all, d_all = _linearize_trajectory(spec, kp, beta_bar, dtype,
+                                         rule=rule, P_bar=Ppred1)
     elems, _ = _tv_elements(Z_all, d_all, kp.Phi, kp.delta,
                             kp.Omega_state, kp.obs_var, state0.beta,
                             P0, data, observed)
@@ -434,7 +551,7 @@ def _filter_sweeps(spec: ModelSpec, params, data, start, end, psd_floor,
         # pass B — exact within-chunk re-propagation: predict, linearize at
         # the chunk's own predicted mean, sequential-observation update
         _, m, P, ll_t, obs, codes = _chunked_refine(
-            spec, kp, data_p, observed_p, entry_m, entry_P, L, Cn)
+            spec, kp, data_p, observed_p, entry_m, entry_P, L, Cn, rule)
     return m[:T], P[:T], ll_t[:T], obs[:T], codes[:T], kp
 
 

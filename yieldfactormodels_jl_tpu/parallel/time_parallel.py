@@ -36,17 +36,19 @@ def _tree_loss_fn(spec: ModelSpec, T: int, n_dev: int):
     """The family's O(log T) parallel-in-time loss over a TIME-SHARDED
     panel: ``assoc_scan.get_loss`` for the constant-Z families,
     ``slr_scan.get_loss`` (the iterated-SLR engine, docs/DESIGN.md §19)
-    for the state-dependent-measurement ones.  One dispatch through
+    for the state-dependent-measurement ones, ``score_scan.get_loss`` for
+    the capable score-driven specs.  One dispatch through
     ``config.tree_engine_for`` so this module, the ``api.get_loss``
     T-switch and the ladder's rescue rungs can never disagree on
-    applicability.  Both run the ``"interleaved"`` combine schedule
-    (block-local under SPMD); the SLR engine additionally pins its
-    refinement chunk to the SHARD length T/n_dev, so the (C, L) chunk
-    reshape is exactly the sharding layout and every device refines its own
-    block — a misaligned chunk makes the partitioner rematerialize the
-    scan's slices across shards, which was observed to MISCOMPILE (wrong
-    loss, no error) on the 8-virtual-device mesh; the aligned form is
-    verified bit-identical to the unsharded engine at the same chunk."""
+    applicability.  All run the ``"interleaved"`` combine schedule
+    (block-local under SPMD); the chunked-refinement engines (slr,
+    score_tree) additionally pin their refinement chunk to the SHARD length
+    T/n_dev, so the (C, L) chunk reshape is exactly the sharding layout and
+    every device refines its own block — a misaligned chunk makes the
+    partitioner rematerialize the scan's slices across shards, which was
+    observed to MISCOMPILE (wrong loss, no error) on the 8-virtual-device
+    mesh; the aligned form is verified bit-identical to the unsharded
+    engine at the same chunk."""
     from .. import config
 
     eng = config.tree_engine_for(spec)
@@ -66,10 +68,24 @@ def _tree_loss_fn(spec: ModelSpec, T: int, n_dev: int):
             return slr_scan.get_loss(spec, params, data, start, end,
                                      prefix="interleaved", chunk=chunk)
         return loss
+    if eng == "score_tree":
+        from ..ops import score_scan
+
+        # same shard-aligned-chunk pin as the SLR engine: the refinement's
+        # (C, L) reshape must BE the sharding layout (a misaligned chunk
+        # rematerializes the scan's slices across shards — observed to
+        # MISCOMPILE for the SLR engine; the aligned form is pinned
+        # bit-identical to the unsharded engine in tests/test_score_scan.py)
+        chunk = max(1, T // max(n_dev, 1))
+
+        def loss(params, data, start, end):
+            return score_scan.get_loss(spec, params, data, start, end,
+                                       prefix="interleaved", chunk=chunk)
+        return loss
     raise ValueError(
-        f"time-sharded likelihood needs a Kalman family with a "
-        f"parallel-in-time engine; config.engines_for({spec.family!r}) "
-        f"lists none of ('assoc', 'slr')")
+        f"time-sharded likelihood needs a family with a parallel-in-time "
+        f"engine; config.engines_for({spec.family!r}) "
+        f"lists none of ('assoc', 'slr', 'score_tree')")
 
 
 def _pad_time(data, n_dev: int):

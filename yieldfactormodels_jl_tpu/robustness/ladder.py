@@ -24,6 +24,12 @@ automatically:
    (``slr_scan.get_loss_coded(psd_floor=...)``, docs/DESIGN.md §19) for the
    Kalman families whose measurement is state-dependent (TVλ) — a dead
    long-panel EKF start is re-evaluated at tree span too;
+2c. ``score_tree`` the score-driven twin (same length gate): the capable
+   score-driven specs (``spec.supports_score_tree``) re-evaluate a dead
+   long-panel start on the O(log T) score-tree engine
+   (``score_scan.get_loss_coded``, docs/DESIGN.md §19) — the tree's affine
+   surrogate + exact refinement can return a finite loss where the fused
+   sequential artifact died, and answers at tree depth;
 3. ``sqrt``   the square-root filter with PSD-*projected* initial moments
    (``sqrt_kf.get_loss_coded(init_psd_floor=...)``): covariance breakdowns
    (NONPSD_INNOVATION / CHOL_BREAKDOWN) re-enter through a factorization
@@ -75,7 +81,7 @@ OBS_VAR_FLOOR = 1e-8
 #: reference parity: at most 10 ×0.95 shrinks (optimization.jl:173-184)
 SHRINK_TRIES = 10
 
-RUNGS = ("scan", "assoc", "slr", "sqrt", "jitter", "shrink")
+RUNGS = ("scan", "assoc", "slr", "score_tree", "sqrt", "jitter", "shrink")
 
 
 def escalation_enabled() -> bool:
@@ -202,6 +208,41 @@ def _slr_rescue(spec, cons, data, start, end):
     return float(ll), int(code)
 
 
+@register_engine_cache
+@lru_cache(maxsize=64)
+def _jitted_score_rescue(spec):
+    """The score_tree rung's jitted evaluator: the O(log T) score-tree
+    engine (ops/score_scan, docs/DESIGN.md §19) for the capable
+    score-driven specs — the assoc/slr rungs' twin on the MSED side.
+    Keyed on spec alone, like the other tree builders (jit retraces per
+    data shape)."""
+    import jax
+
+    from ..ops import score_scan
+
+    return jax.jit(lambda p, d, s, e: score_scan.get_loss_coded(
+        spec, p, d, s, e))
+
+
+def _score_rescue_applies(spec, T: int) -> bool:
+    """Gate for the score_tree rung: a score-driven spec the tree engine
+    covers (``config.tree_engine_for`` — the same applicability seam as the
+    T-switch and the time-sharded objective) on a long panel, same length
+    gate as the assoc/slr rungs."""
+    from .. import config
+
+    return (config.tree_engine_for(spec) == "score_tree"
+            and T >= ASSOC_RESCUE_MIN_T)
+
+
+def _score_rescue(spec, cons, data, start, end):
+    import jax.numpy as jnp
+
+    runner = _jitted_score_rescue(spec)
+    ll, code = runner(cons, data, jnp.asarray(start), jnp.asarray(end))
+    return float(ll), int(code)
+
+
 def _jittered_raw(spec, raw):
     """The jitter rung's regularized point: constrained-space Ω-Cholesky
     diagonal inflation + observation-variance floor, mapped back to raw."""
@@ -276,6 +317,17 @@ def escalate(spec, data, raw, start=0, end=None,
         if np.isfinite(ll):
             return LadderTrace(start_index, code0, tuple(rungs), True,
                                "slr", ll, "slr", None)
+
+    # rung 2c — the score-driven twin: the O(log T) score-tree engine
+    # (ops/score_scan) for the capable MSED specs, same length gate — the
+    # tree's affine-surrogate + exact-refinement pass can come back finite
+    # where the sequential artifact died, at tree depth
+    if _score_rescue_applies(spec, T):
+        ll, code = _score_rescue(spec, cons_of(raw), data, start, end)
+        rungs.append(RungResult("score_tree", ll, code))
+        if np.isfinite(ll):
+            return LadderTrace(start_index, code0, tuple(rungs), True,
+                               "score_tree", ll, "score_tree", None)
 
     # rung 3 — square-root filter from PSD-projected moments (Kalman only)
     if spec.is_kalman:

@@ -401,20 +401,28 @@ def _jitted_refilter(spec: ModelSpec, T: int):
     state-dependent-measurement ones (TVλ) the iterated-SLR twin
     (``slr_scan.filter_and_loss``) — the applicability gate is
     ``config.tree_engine_for``, validated at the driver
-    (serving/service.py).  This is the exact rebuild that replaces "trust k
-    accumulated O(1) updates".  Sentinel discipline as everywhere: a failed
-    pass NaN-poisons the returned state and lowers ``ok``; the driver
-    decodes ``code`` into the structured error."""
+    (serving/service.py).  The dispatch is EXPLICIT on the moment-emitting
+    tree engines: "score_tree" (the score-driven tree, no filtered (β, P)
+    moment set) and tree-less families raise here instead of silently
+    falling into the assoc path.  This is the exact rebuild that replaces
+    "trust k accumulated O(1) updates".  Sentinel discipline as everywhere:
+    a failed pass NaN-poisons the returned state and lowers ``ok``; the
+    driver decodes ``code`` into the structured error."""
+    from .. import config as _config
+
+    eng = _config.tree_engine_for(spec)
+    if eng == "slr":
+        from ..ops import slr_scan as _tree
+    elif eng == "assoc":
+        from ..ops import assoc_scan as _tree
+    else:
+        raise ValueError(
+            f"refilter needs a moment-emitting parallel-in-time engine "
+            f"('assoc' or 'slr'); config.tree_engine_for({spec.family!r}) "
+            f"is {eng!r}")
 
     def refit(params, data):
         note_trace("refilter")
-        from .. import config as _config
-
-        if _config.tree_engine_for(spec) == "slr":
-            from ..ops import slr_scan as _tree
-        else:
-            from ..ops import assoc_scan as _tree
-
         m, P, ll, code = _tree.filter_and_loss(spec, params, data, 0, T)
         beta = m[-1]
         cov = 0.5 * (P[-1] + P[-1].T)
