@@ -448,6 +448,27 @@ def main():
                          f"{proc.returncode} ({tail[:200]})")
         except Exception as e:  # never kill the bench line
             load_ctx += f"; load-mesh bench failed ({type(e).__name__}: {e})"
+        # working-set dimension (DESIGN §21): the tiered store's capacity
+        # ledger — hit rate, promotion latency, and states-per-chip when the
+        # working set overflows hot residency.  Same CPU-pinned
+        # 8-virtual-device subprocess recipe as the mesh sweep.
+        try:
+            tenv = {**os.environ, "JAX_PLATFORMS": "cpu"}
+            tenv.pop("PALLAS_AXON_POOL_IPS", None)
+            tenv.pop("JAX_COMPILATION_CACHE_DIR", None)
+            tenv["XLA_FLAGS"] = (tenv.get("XLA_FLAGS", "")
+                                 + " --xla_force_host_platform_device_"
+                                   "count=8").strip()
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--load-tier-bench"],
+                env=tenv, capture_output=True, text=True, timeout=900)
+            tail = (proc.stdout.strip().splitlines() or ["no output"])[-1]
+            load_ctx += ("; " + tail if "load-tier-bench" in tail else
+                         f"; load-tier-bench subprocess failed rc="
+                         f"{proc.returncode} ({tail[:200]})")
+        except Exception as e:  # never kill the bench line
+            load_ctx += f"; load-tier bench failed ({type(e).__name__}: {e})"
 
     # ---- long-panel engine split (opt-in: BENCH_LONGT=1) ----
     # sequential univariate scan vs the O(log T) associative-scan engine at
@@ -1185,32 +1206,15 @@ def _amort_bench():
     return 0
 
 
-def _load_mesh_bench():
-    """Subprocess mode (CPU, 8 virtual devices — exported by the caller
-    before jax inits): the BENCH_LOAD ``mesh_scaling`` line.  A sharded
-    state store of FIXED total capacity (8192 live filter states) is swept
-    across mesh sizes ``BENCH_LOAD_MESH`` (default 1,2,4,8); each size
-    serves the same update traffic through a ShardedGateway and reports the
-    unpaced max sustained QPS plus paced p50/p99 (robustness/loadgen.
-    mesh_scaling, docs/DESIGN.md §16).  Fixed total capacity means a bigger
-    mesh holds smaller shards — the production scaling shape; on this
-    harness the win is the per-launch compute partition, on real chips the
-    shards run concurrently too."""
-    import dataclasses
-
+def _serving_fixture_1c():
+    """Shared fixture for the BENCH_LOAD subprocess modes: the 1C f64 spec
+    at the tests' stable point (oracle.stable_1c_params) plus a 96-month
+    stationary DNS panel matched to it, frozen to a serving snapshot at
+    t = 64.  Returns ``(spec, data, snap)``."""
     import jax
     jax.config.update("jax_enable_x64", True)
 
     from yieldfactormodels_jl_tpu import create_model, serving
-    from yieldfactormodels_jl_tpu.parallel import mesh as pmesh
-    from yieldfactormodels_jl_tpu.robustness import loadgen
-
-    mesh_sizes = tuple(
-        int(x) for x in
-        os.environ.get("BENCH_LOAD_MESH", "1,2,4,8").split(",") if x)
-    n_dev = len(jax.devices())
-    mesh_sizes = tuple(m for m in mesh_sizes if m <= n_dev) or (1,)
-    total = 8192
 
     spec, _ = create_model("1C", tuple(MATURITIES), float_type="float64")
     # the tests' stable 1C point (oracle.stable_1c_params): λ = 0.5, obs var
@@ -1242,6 +1246,35 @@ def _load_mesh_bench():
         data[:, t] = Z @ beta + 0.02 * rng.standard_normal(N_MATURITIES)
     data += 5.0
     snap = serving.freeze_snapshot(spec, p, data, end=64)
+    return spec, data, snap
+
+
+def _load_mesh_bench():
+    """Subprocess mode (CPU, 8 virtual devices — exported by the caller
+    before jax inits): the BENCH_LOAD ``mesh_scaling`` line.  A sharded
+    state store of FIXED total capacity (8192 live filter states) is swept
+    across mesh sizes ``BENCH_LOAD_MESH`` (default 1,2,4,8); each size
+    serves the same update traffic through a ShardedGateway and reports the
+    unpaced max sustained QPS plus paced p50/p99 (robustness/loadgen.
+    mesh_scaling, docs/DESIGN.md §16).  Fixed total capacity means a bigger
+    mesh holds smaller shards — the production scaling shape; on this
+    harness the win is the per-launch compute partition, on real chips the
+    shards run concurrently too."""
+    import dataclasses
+
+    import jax
+
+    from yieldfactormodels_jl_tpu import serving
+    from yieldfactormodels_jl_tpu.parallel import mesh as pmesh
+    from yieldfactormodels_jl_tpu.robustness import loadgen
+
+    mesh_sizes = tuple(
+        int(x) for x in
+        os.environ.get("BENCH_LOAD_MESH", "1,2,4,8").split(",") if x)
+    n_dev = len(jax.devices())
+    mesh_sizes = tuple(m for m in mesh_sizes if m <= n_dev) or (1,)
+    total = 8192
+    spec, data, snap = _serving_fixture_1c()
 
     def factory(m):
         store = serving.ShardedStateStore(
@@ -1267,6 +1300,114 @@ def _load_mesh_bench():
         f"mesh sweep on the {n_dev}-virtual-device {plat} harness (the "
         f"single-chip relay exposes no multi-device mesh)")
     print(f"load-mesh-bench[1C f64, {total} resident states]: "
+          + json.dumps(out))
+    return 0
+
+
+def _load_tier_bench():
+    """Subprocess mode (CPU, 8 virtual devices): the BENCH_LOAD WORKING-SET
+    column — the tiered store's capacity ledger (docs/DESIGN.md §21).  A
+    TieredStateStore with ``BENCH_LOAD_TIER_HOT`` HBM-hot slots (default
+    1024) across the full visible mesh serves zipf(1.2)-skewed update
+    traffic over working sets of ``BENCH_LOAD_WORKING_SET`` × hot capacity
+    (default 1,2,4 — 1× is the fully-resident yardstick); each multiplier
+    gets a FRESH store booted via ``register_many`` (head hot, tail frozen
+    warm), then reports the unpaced capacity, paced p50/p99 at 0.8× of it,
+    the tier ledger's hit rate, and the promotion-wave percentiles.
+    Headline metric: ``states_per_chip_at_p99`` — the largest working set
+    per chip whose paced p99 stays within 1.5× the fully-resident line."""
+    import dataclasses
+
+    import jax
+
+    from yieldfactormodels_jl_tpu import serving
+    from yieldfactormodels_jl_tpu.parallel import mesh as pmesh
+    from yieldfactormodels_jl_tpu.robustness import loadgen
+
+    n_dev = len(jax.devices())
+    hot = int(os.environ.get("BENCH_LOAD_TIER_HOT", "1024"))
+    hot = max(n_dev, hot - hot % n_dev)  # divisible by the mesh
+    mults = tuple(
+        int(x) for x in
+        os.environ.get("BENCH_LOAD_WORKING_SET", "1,2,4").split(",")
+        if x) or (1, 2)
+    spec, data, snap = _serving_fixture_1c()
+
+    recs = []
+    for mult in sorted(set(mults)):
+        ws = mult * hot
+        # warm sized to exactly the overflow: steady-state churn spills the
+        # coldest warm records to the cold registry, so all three tiers
+        # exercise at every multiplier > 1
+        store = serving.TieredStateStore(
+            spec, mesh=pmesh.make_mesh(n_dev), shard_capacity=hot // n_dev,
+            warm_capacity=max(ws - hot, 1),
+            registry=serving.SnapshotRegistry(),
+            lattice=serving.BucketLattice(update_batch_sizes=(1, 4, 16)))
+        keys = store.register_many(
+            dataclasses.replace(snap,
+                                meta=dataclasses.replace(snap.meta,
+                                                         task_id=i))
+            for i in range(ws))
+        store.warmup()
+        gw = serving.ShardedGateway(store, queue_max=2048, queue_age_ms=0.0)
+        # zipf rank order follows key order: the register_many head (hot at
+        # boot) is also the popularity head — the steady-state layout
+        w = loadgen.zipf_weights(ws, s=1.2)
+        # priming pass (discarded): let the LRU converge on the zipf head
+        # before the measured window, then zero the ledger/timers — the
+        # published column is the steady state, not the boot transient
+        loadgen.measure_capacity(gw, data, n=256, burst=128,
+                                 mix=(1.0, 0.0, 0.0), keys=keys,
+                                 key_weights=w)
+        store.ledger = serving.TierLedger()
+        store.timer.samples.pop("promote", None)
+        cap = loadgen.measure_capacity(gw, data, n=512, burst=128,
+                                       mix=(1.0, 0.0, 0.0), keys=keys,
+                                       key_weights=w)
+        rep = loadgen.run_load(gw, data, duration_s=1.0,
+                               offered_qps=0.8 * cap, mix=(1.0, 0.0, 0.0),
+                               burst=64, keys=keys, key_weights=w)
+        t = store.tiers()
+        recs.append({
+            "multiplier": mult, "working_set": ws,
+            "capacity_qps": round(cap, 2),
+            "p50_ms": rep.p50_ms, "p99_ms": rep.p99_ms,
+            "shed_rate": round(rep.shed_rate, 6),
+            "degraded_rate": round(rep.degraded_rate, 6),
+            "hit_rate": t["ledger"]["hit_rate"],
+            "promotions": t["ledger"]["promotions"],
+            "demotions": t["ledger"]["demotions"],
+            "spills": t["ledger"]["spills"],
+            "promote_waves": t["promote_waves"],
+            "promote_p50_ms": t["promote_p50_ms"],
+            "promote_p99_ms": t["promote_p99_ms"],
+        })
+
+    base = next((r for r in recs if r["multiplier"] == 1), recs[0])
+    p99_budget = 1.5 * base["p99_ms"]
+    fit = [r for r in recs
+           if r is base or (base["p99_ms"] > 0
+                            and r["p99_ms"] <= p99_budget)]
+    out = {
+        "hot_total": hot, "mesh": n_dev, "zipf_s": 1.2,
+        "working_sets": recs,
+        "p99_budget_ms": round(p99_budget, 3),
+        "states_per_chip_at_p99": max(r["working_set"] for r in fit)
+        // n_dev,
+    }
+    for r in recs:
+        if r["multiplier"] == 2 and base["capacity_qps"]:
+            out["qps_vs_resident_2x"] = round(
+                r["capacity_qps"] / base["capacity_qps"], 3)
+            out["hit_rate_2x"] = r["hit_rate"]
+    plat = jax.devices()[0].platform
+    out["device_fallback"] = plat != "tpu"
+    out["fallback_reason"] = "" if plat == "tpu" else os.environ.get(
+        "BENCH_FALLBACK_REASON",
+        f"working-set sweep on the {n_dev}-virtual-device {plat} harness "
+        f"(the single-chip relay exposes no multi-device mesh)")
+    print(f"load-tier-bench[1C f64, hot {hot} on {n_dev} chips]: "
           + json.dumps(out))
     return 0
 
@@ -1486,6 +1627,8 @@ if __name__ == "__main__":
         sys.exit(_amort_bench())
     elif "--load-mesh-bench" in sys.argv:
         sys.exit(_load_mesh_bench())
+    elif "--load-tier-bench" in sys.argv:
+        sys.exit(_load_tier_bench())
     elif "--inner" in sys.argv:
         main()
     else:

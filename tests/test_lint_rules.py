@@ -553,6 +553,49 @@ def test_yfm008_quiet_on_host_transfer_at_response_boundary(tmp_path):
     assert not res.findings
 
 
+def test_yfm008_fires_on_host_gather_in_tier_planning(tmp_path):
+    """The DESIGN §21 tier-routing rule: promotion/eviction PLANNING
+    functions (which keys move between tiers) are per-request work and must
+    stay pure host routing — the actual freeze/thaw transfer belongs in the
+    batched flush boundaries only."""
+    res = lint(tmp_path, f"{PKG}/serving/extra.py", """\
+        import numpy as np
+
+        def _promote_plan(self, keys):
+            return np.asarray(self.warm.beta)    # transfer while planning
+
+        def _demote_plan(self, n):
+            return np.array(self.clock)
+
+        def prepare_reads(self, keys):
+            return np.asarray(keys)
+
+        def _account(self, keys):
+            return np.asarray(self.ledger)
+    """, ["YFM008"])
+    assert len(fired(res, "YFM008")) == 4
+
+
+def test_yfm008_quiet_on_pure_tier_planning_with_batched_flush(tmp_path):
+    # the same module split the sanctioned way: pure planning, transfers
+    # confined to the wave-flush boundary
+    res = lint(tmp_path, f"{PKG}/serving/extra.py", """\
+        import jax
+        import numpy as np
+
+        def _promote_plan(self, keys):
+            want = [k for k in keys if k not in self.slots]
+            return {"want": want, "victims": want[:1]}
+
+        def _prepare_batch(self, run_updates, run_batched):
+            self.store.prepare_reads([r.key for r in run_batched])
+
+        def _promote_flush_locked(self, plan):
+            return np.asarray(jax.device_get(plan))
+    """, ["YFM008"])
+    assert not res.findings
+
+
 def test_yfm008_scoped_to_serving(tmp_path):
     # the orchestrator's poll loop may sleep (chaos/test code likewise by
     # living outside serving/)

@@ -440,6 +440,31 @@ def test_register_many_partial_failure_leaves_store_unchanged(dns_setup):
         [(keys[2], data[:, T_ORIGIN])])[0]["ll"])
 
 
+def test_register_many_batched_matches_sequential(dns_setup):
+    """Bulk boot through the batched slot-write waves leaves every state
+    bit-identical to one-at-a-time ``register()`` — the batching is a
+    dispatch-count optimization, never a numeric one — and updates on both
+    stores stay bit-equal afterwards."""
+    spec, p, data, snap = dns_setup
+    a, keys_a = _store(spec, snap, 6)
+    b = serving.ShardedStateStore(
+        spec, mesh=pmesh.make_mesh(8), shard_capacity=4,
+        lattice=serving.BucketLattice(**LATTICE))
+    keys_b = [b.register(_snap_for(snap, i)) for i in range(6)]
+    assert keys_a == keys_b
+    for k in keys_a:
+        sa, sb = a.snapshot_of(k), b.snapshot_of(k)
+        np.testing.assert_array_equal(np.asarray(sa.beta), np.asarray(sb.beta))
+        np.testing.assert_array_equal(np.asarray(sa.P), np.asarray(sb.P))
+        np.testing.assert_array_equal(np.asarray(sa.params),
+                                      np.asarray(sb.params))
+    y = data[:, T_ORIGIN]
+    ra = a.update_batch([(k, y) for k in keys_a])
+    rb = b.update_batch([(k, y) for k in keys_b])
+    np.testing.assert_array_equal([r["ll"] for r in ra],
+                                  [r["ll"] for r in rb])
+
+
 def test_mesh_scaling_ledger_record(dns_setup):
     """The loadgen mesh dimension: a tiny 1→2 sweep produces the scaling
     ledger record (real numbers land in BASELINE.md via BENCH_LOAD; here we

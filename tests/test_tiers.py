@@ -1,0 +1,447 @@
+"""Tiered state store (serving/tiers.py, docs/DESIGN.md §21).
+
+Acceptance coverage for the hot/warm/cold tentpole:
+
+- demote→promote round trips are BIT-exact on both engines (the warm tier
+  freezes the engine representation, not moments), including states that
+  have absorbed partially-quoted and whole-column-NaN curves;
+- a working-set-2×-hot dry run on the 8-virtual-device mesh: every request
+  answered, promotions/demotions flow, the ledger accounts every request
+  exactly once;
+- the tier chaos seams: ``evict_corrupt`` (poisoned freeze caught by the
+  promotion-side health watch, rebuilt from the cold registry — or parked
+  stale when no fallback exists) and ``promote_stall`` (wave dropped,
+  requests degrade, next wave recovers);
+- the batched promotion path compiles ONE ``slot_write_many`` program per
+  update bucket across a 1→2→4→8 mesh sweep at fixed shard capacity — zero
+  retraces in steady state, zero donation warnings;
+- a 2-thread hammer on the tier manager's lock discipline (mutating churn
+  vs operator reads — no exceptions, consistent ledgers);
+- the fleet seam: one gateway over MANY stores routed by ``model_string``.
+"""
+
+import dataclasses
+import threading
+import warnings
+
+import numpy as np
+import pytest
+
+import yieldfactormodels_jl_tpu as yfm
+from tests import oracle
+from yieldfactormodels_jl_tpu import serving
+from yieldfactormodels_jl_tpu.orchestration import chaos
+from yieldfactormodels_jl_tpu.parallel import mesh as pmesh
+from yieldfactormodels_jl_tpu.serving import online as so
+
+MATS = tuple(np.array([3, 12, 24, 60, 120, 240, 360]) / 12.0)
+T_PANEL = 48
+T_ORIGIN = 40
+
+LATTICE = dict(horizons=(4,), batch_sizes=(1, 4), scenario_counts=(4,),
+               update_batch_sizes=(1, 4))
+
+
+@pytest.fixture(scope="module")
+def dns_setup():
+    rng = np.random.default_rng(11)
+    spec, _ = yfm.create_model("1C", MATS, float_type="float64")
+    p = oracle.stable_1c_params(spec, np.float64)
+    data = oracle.simulate_dns_panel(rng, np.asarray(MATS), T=T_PANEL)
+    snap = serving.freeze_snapshot(spec, p, data, end=T_ORIGIN)
+    return spec, p, data, snap
+
+
+@pytest.fixture(autouse=True)
+def _chaos_clean():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+def _snap_for(snap, task_id):
+    return dataclasses.replace(
+        snap, meta=dataclasses.replace(snap.meta, task_id=task_id))
+
+
+def _tiered(spec, snap, n_keys, mesh_size=2, shard_capacity=2,
+            warm_capacity=8, registry=True, **kw):
+    store = serving.TieredStateStore(
+        spec, mesh=pmesh.make_mesh(mesh_size), shard_capacity=shard_capacity,
+        warm_capacity=warm_capacity,
+        registry=serving.SnapshotRegistry() if registry else None,
+        lattice=serving.BucketLattice(**LATTICE), **kw)
+    keys = store.register_many(_snap_for(snap, i) for i in range(n_keys))
+    return store, keys
+
+
+def _slot_bits(store, key):
+    """The exact device bits of one resident slot (engine representation)."""
+    import jax
+    s, sl = store._slot[key]
+    sh = store._shards[s]
+    p, b, c, v = jax.device_get((sh["params"][:, sl], sh["beta"][:, sl],
+                                 sh["cov"][:, :, sl], sh["ver"][sl]))
+    return (np.asarray(p).tobytes(), np.asarray(b).tobytes(),
+            np.asarray(c).tobytes(), np.asarray(v).tobytes())
+
+
+# ---------------------------------------------------------------------------
+# boot across tiers, occupancy, containment
+# ---------------------------------------------------------------------------
+
+def test_register_many_boots_across_tiers(dns_setup):
+    """Bulk boot fills hot first, freezes the tail warm, and spills past the
+    warm bound to the cold registry — all-or-nothing, everything findable."""
+    spec, p, data, snap = dns_setup
+    store, keys = _tiered(spec, snap, 8, warm_capacity=3)
+    t = store.tiers()
+    assert t["hot"] == 4 and t["warm"] == 3 and t["cold"] == 1
+    assert t["ledger"]["spills"] == 1 and t["ledger"]["dropped"] == 0
+    assert all(k in store for k in keys)
+    for k in keys:  # every tier serves snapshots without promotion
+        assert store.snapshot_of(k).meta.task_id == k[1]
+    assert store.tiers()["hot"] == 4  # snapshot_of promoted nothing
+
+
+def test_warm_capacity_env_knob(dns_setup, monkeypatch):
+    spec, p, data, snap = dns_setup
+    monkeypatch.setenv("YFM_STORE_WARM_CAP", "7")
+    store = serving.TieredStateStore(
+        spec, mesh=pmesh.make_mesh(2), shard_capacity=2,
+        lattice=serving.BucketLattice(**LATTICE))
+    assert store.warm.capacity == 7
+    monkeypatch.delenv("YFM_STORE_WARM_CAP")
+    store = serving.TieredStateStore(
+        spec, mesh=pmesh.make_mesh(2), shard_capacity=2,
+        lattice=serving.BucketLattice(**LATTICE))
+    assert store.warm.capacity == 4 * store.capacity
+
+
+# ---------------------------------------------------------------------------
+# bit parity: demote → promote restores the EXACT engine bits
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("engine", ["univariate", "sqrt"])
+def test_demote_promote_bit_parity(dns_setup, engine):
+    """Freeze/thaw is bit-for-bit on both engines, including states that
+    have absorbed a partially-quoted curve and a whole-column-NaN curve
+    (the sqrt factor is never re-factored on the warm leg)."""
+    spec, p, data, snap = dns_setup
+    store, keys = _tiered(spec, snap, 4, engine=engine)
+    curves = [data[:, T_ORIGIN].copy(), data[:, T_ORIGIN + 1].copy(),
+              np.full(spec.N, np.nan)]
+    curves[1][2] = np.nan
+    for t, y in enumerate(curves):
+        res = store.update_batch([(k, y) for k in keys], dates=[t] * 4)
+        assert all("error" not in r and not r.get("degraded") for r in res)
+    before = {k: _slot_bits(store, k) for k in keys}
+    store.demote(keys[:2])
+    assert all(k in store.warm and k not in store._slot for k in keys[:2])
+    promoted, unpromoted = store.ensure_resident(keys[:2])
+    assert sorted(promoted) == sorted(keys[:2]) and not unpromoted
+    for k in keys:
+        assert _slot_bits(store, k) == before[k], k
+    lg = store.tiers()["ledger"]
+    assert lg["demotions"] == 2 and lg["promotions"] == 2
+
+
+def test_promoted_update_matches_never_demoted_twin(dns_setup):
+    """An update right after promotion is bit-identical to the same update
+    on a twin store that never demoted — the round trip is invisible to the
+    filter."""
+    spec, p, data, snap = dns_setup
+    a, keys_a = _tiered(spec, snap, 4)
+    b, keys_b = _tiered(spec, snap, 4)
+    y0, y1 = data[:, T_ORIGIN], data[:, T_ORIGIN + 1]
+    for st, ks in ((a, keys_a), (b, keys_b)):
+        assert all(np.isfinite(r["ll"])
+                   for r in st.update_batch([(k, y0) for k in ks]))
+    a.demote([keys_a[0]])
+    ra = a.update_batch([(keys_a[0], y1)])[0]
+    rb = b.update_batch([(keys_b[0], y1)])[0]
+    assert not ra.get("degraded")
+    np.testing.assert_array_equal(ra["ll"], rb["ll"])
+    np.testing.assert_array_equal(
+        np.asarray(a.snapshot_of(keys_a[0]).beta),
+        np.asarray(b.snapshot_of(keys_b[0]).beta))
+
+
+# ---------------------------------------------------------------------------
+# LRU policy
+# ---------------------------------------------------------------------------
+
+def test_lru_demotes_coldest_under_pressure(dns_setup):
+    """Under promotion pressure the least-recently-touched resident key is
+    the victim; the freshly-touched keys stay hot."""
+    spec, p, data, snap = dns_setup
+    store, keys = _tiered(spec, snap, 5, warm_capacity=4)
+    hot = [k for k in keys if k in store._slot]
+    warm = [k for k in keys if k in store.warm]
+    assert len(hot) == 4 and len(warm) == 1
+    y = data[:, T_ORIGIN]
+    store.update_batch([(k, y) for k in hot[1:]])  # hot[0] stays untouched
+    store.update_batch([(warm[0], y)])             # miss → promotion wave
+    assert warm[0] in store._slot
+    assert hot[0] in store.warm and hot[0] not in store._slot
+    assert all(k in store._slot for k in hot[1:])
+
+
+# ---------------------------------------------------------------------------
+# working set 2× hot on the 8-virtual-device mesh (the bench scenario)
+# ---------------------------------------------------------------------------
+
+def test_working_set_2x_dry_run_8_devices(dns_setup):
+    """The BENCH_LOAD working-set column's scenario in miniature: 32 states
+    over 16 hot slots on the full mesh, zipf-skewed update traffic — every
+    request answered (no structural errors), the tier ledger accounts every
+    request exactly once, and promotions/demotions actually flow."""
+    from yieldfactormodels_jl_tpu.robustness import loadgen
+    spec, p, data, snap = dns_setup
+    store, keys = _tiered(spec, snap, 32, mesh_size=8, shard_capacity=2,
+                          warm_capacity=16)
+    assert store.tiers()["hot"] == 16
+    store.warmup()
+    rng = np.random.default_rng(7)
+    w = loadgen.zipf_weights(len(keys), s=1.2)
+    n_requests, answered = 0, 0
+    for t in range(12):
+        picks = rng.choice(len(keys), size=8, replace=False, p=w)
+        items = [(keys[i], data[:, T_ORIGIN + t % 8]) for i in picks]
+        n_requests += len(items)
+        for r in store.update_batch(items):
+            assert "error" not in r, r
+            answered += 1
+            assert r.get("degraded") or np.isfinite(r["ll"])
+    lg = store.ledger
+    assert answered == n_requests
+    assert lg.accounted == n_requests
+    assert lg.promotions > 0 and lg.demotions > 0
+    assert lg.hits + lg.misses_warm + lg.misses_cold == n_requests
+    t = store.tiers()
+    assert t["hot"] == 16 and t["hot_free"] == 0
+    assert t["promote_waves"] > 0 and t["promote_p99_ms"] >= t["promote_p50_ms"]
+
+
+# ---------------------------------------------------------------------------
+# chaos seams: evict_corrupt / promote_stall
+# ---------------------------------------------------------------------------
+
+def test_evict_corrupt_rebuilds_from_cold_registry(dns_setup):
+    """A poisoned freeze (chaos ``evict_corrupt``) is caught by the
+    promotion-side health watch and rebuilt from the cold registry — the
+    answer is healthy, the rebuild is ledgered."""
+    spec, p, data, snap = dns_setup
+    store, keys = _tiered(spec, snap, 4)
+    k = keys[0]
+    store.registry.put(store.snapshot_of(k))
+    chaos.configure("evict_corrupt:@1")
+    store.demote([k])
+    chaos.reset()
+    assert np.isnan(store.warm.peek(k).beta).all()
+    r = store.update_batch([(k, data[:, T_ORIGIN])])[0]
+    assert not r.get("degraded") and np.isfinite(r["ll"])
+    assert store.ledger.corrupt_rebuilds == 1
+
+
+def test_evict_corrupt_without_fallback_parks_stale(dns_setup):
+    """No cold fallback: the poisoned record is parked back warm,
+    stale-flagged, and its requests degrade — visible, never silently
+    dropped."""
+    spec, p, data, snap = dns_setup
+    store, keys = _tiered(spec, snap, 4, registry=False)
+    k = keys[0]
+    chaos.configure("evict_corrupt:@1")
+    store.demote([k])
+    chaos.reset()
+    r = store.update_batch([(k, data[:, T_ORIGIN])])[0]
+    assert r.get("degraded") and r.get("stale")
+    assert k in store.warm and store.warm.peek(k).stale
+    assert store.ledger.corrupt_rebuilds == 0
+
+
+def test_promote_stall_degrades_then_recovers(dns_setup):
+    """A dropped promotion wave (chaos ``promote_stall``) answers its
+    requests degraded-stale from the warm record; the next wave lands."""
+    spec, p, data, snap = dns_setup
+    store, keys = _tiered(spec, snap, 4)
+    k = keys[0]
+    store.demote([k])
+    chaos.configure("promote_stall:@1")
+    r = store.update_batch([(k, data[:, T_ORIGIN])])[0]
+    assert r.get("degraded") and r.get("stale")
+    assert store.ledger.promote_stalls == 1 and k in store.warm
+    r = store.update_batch([(k, data[:, T_ORIGIN])])[0]
+    assert not r.get("degraded") and np.isfinite(r["ll"])
+    chaos.reset()
+
+
+# ---------------------------------------------------------------------------
+# one program per bucket across the mesh sweep; steady state retrace-free
+# ---------------------------------------------------------------------------
+
+def test_promotion_one_program_per_bucket_across_mesh_sweep(dns_setup):
+    """Fixed shard capacity → the batched slot-write program keys never
+    mention mesh size: the whole 1→2→4→8 sweep (boot + demote + promote
+    waves on every size) compiles each update bucket ONCE, and the donated
+    launches never warn about unusable donated buffers."""
+    spec, p, data, snap = dns_setup
+    cap = 3  # unique to this test: the lru cache must start cold
+    so.reset_trace_counts()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        for m in (1, 2, 4, 8):
+            store = serving.TieredStateStore(
+                spec, mesh=pmesh.make_mesh(m), shard_capacity=cap,
+                warm_capacity=4 * m,
+                registry=serving.SnapshotRegistry(),
+                lattice=serving.BucketLattice(**LATTICE))
+            keys = store.register_many(
+                _snap_for(snap, i) for i in range(3 * m + 2))
+            store.demote([k for k in keys if k in store._slot][:2])
+            promoted, _ = store.ensure_resident(keys[:2])
+            assert promoted
+    n_buckets = len(LATTICE["update_batch_sizes"])
+    assert so.trace_counts["slot_write_many"] <= n_buckets
+    donation = [str(i.message) for i in w
+                if "donat" in str(i.message).lower()]
+    assert donation == []
+
+
+def test_steady_state_waves_are_trace_free(dns_setup):
+    """After warmup, promotion/demotion waves and resident updates add ZERO
+    retraces — the acceptance bar for the hot path."""
+    spec, p, data, snap = dns_setup
+    store, keys = _tiered(spec, snap, 8, warm_capacity=8)
+    store.warmup()
+    so.reset_trace_counts()
+    y = data[:, T_ORIGIN]
+    for t in range(4):
+        miss = [k for k in keys if k not in store._slot][:2]
+        res = store.update_batch([(k, y) for k in miss + keys[:2]])
+        assert all("error" not in r for r in res)
+    assert so.trace_counts["slot_write_many"] == 0
+    assert so.trace_counts["store_update"] == 0
+
+
+# ---------------------------------------------------------------------------
+# lock discipline: 2-thread hammer (mutating churn vs operator reads)
+# ---------------------------------------------------------------------------
+
+def test_two_thread_hammer_lock_discipline(dns_setup):
+    """Thread A churns updates over a working set 2× hot (constant
+    promotion/demotion waves); thread B hammers the operator surface
+    (health / tiers / containment / last-good snapshots).  No exceptions on
+    either side, and the ledger stays exactly-once consistent."""
+    spec, p, data, snap = dns_setup
+    store, keys = _tiered(spec, snap, 8, warm_capacity=8)
+    store.warmup()
+    errors = []
+    stop = threading.Event()
+    n_rounds = 25
+
+    def churn():
+        try:
+            rng = np.random.default_rng(0)
+            for t in range(n_rounds):
+                picks = rng.choice(len(keys), size=3, replace=False)
+                res = store.update_batch(
+                    [(keys[i], data[:, T_ORIGIN + t % 8]) for i in picks])
+                for r in res:
+                    if "error" in r:
+                        raise AssertionError(f"structural error: {r}")
+        except Exception as e:  # surfaced to the main thread
+            errors.append(e)
+        finally:
+            stop.set()
+
+    def observe():
+        try:
+            while not stop.is_set():
+                h = store.health()
+                assert "tiers" in h
+                t = store.tiers()
+                assert t["hot"] <= t["hot_capacity"]
+                for k in keys[:3]:
+                    k in store
+                    store.last_good_snapshot_of(k)
+        except Exception as e:
+            errors.append(e)
+
+    a = threading.Thread(target=churn)
+    b = threading.Thread(target=observe)
+    a.start(); b.start()
+    a.join(timeout=120); b.join(timeout=120)
+    assert not a.is_alive() and not b.is_alive()
+    assert errors == []
+    assert store.ledger.accounted == n_rounds * 3
+
+
+# ---------------------------------------------------------------------------
+# gateway integration: reads promote through the pump
+# ---------------------------------------------------------------------------
+
+def test_gateway_pump_promotes_read_keys(dns_setup):
+    """A keyed read of a demoted state is admitted, promoted in the next
+    pump wave (``prepare_reads``), and answered non-degraded."""
+    spec, p, data, snap = dns_setup
+    store, keys = _tiered(spec, snap, 4)
+    store.warmup()
+    gw = serving.ShardedGateway(store, queue_max=64, queue_age_ms=0.0)
+    k = keys[0]
+    store.demote([k])
+    t1 = gw.submit_forecast(4, key=k)
+    t2 = gw.submit_update(99, data[:, T_ORIGIN], key=k)
+    gw.pump()
+    r1, r2 = gw.result(t1), gw.result(t2)
+    assert not r1.get("degraded") and not r2.get("degraded")
+    assert np.isfinite(r2["ll"])
+    assert k in store._slot
+    assert store.ledger.misses_warm >= 1
+
+
+# ---------------------------------------------------------------------------
+# fleet seam: one gateway, many stores
+# ---------------------------------------------------------------------------
+
+def test_fleet_routes_by_model_string(dns_setup):
+    """Two tiered stores (distinct specs) under ONE gateway: requests route
+    by their key's model_string; unroutable keys get structured errors, and
+    the fleet's health/latency surfaces aggregate the members."""
+    spec, p, data, snap = dns_setup
+    store, keys = _tiered(spec, snap, 4)
+    spec2, _ = yfm.create_model("AFNS3", MATS, float_type="float64")
+    p2 = oracle.generic_stable_params(spec2, np.random.default_rng(0))
+    snap2 = serving.freeze_snapshot(spec2, p2, data, end=T_ORIGIN)
+    store2 = serving.TieredStateStore(
+        spec2, mesh=pmesh.make_mesh(2), shard_capacity=2,
+        lattice=serving.BucketLattice(**LATTICE))
+    k2 = store2.register(snap2)
+    fleet = serving.StoreFleet([store, store2])
+    assert len(fleet) == 5
+    assert fleet.spec_for(keys[0]) is spec and fleet.spec_for(k2) is spec2
+
+    gw = serving.ShardedGateway(fleet, queue_max=64, queue_age_ms=0.0)
+    ta = gw.submit_update(1, data[:, T_ORIGIN], key=keys[0])
+    tb = gw.submit_update(1, data[:, T_ORIGIN], key=k2)
+    tc = gw.submit_forecast(4, key=k2)
+    gw.pump()
+    for t in (ta, tb, tc):
+        assert "error" not in gw.result(t)
+
+    bogus = ("no-such-model", 0)
+    r = fleet.update_batch([(bogus, data[:, T_ORIGIN])])[0]
+    assert isinstance(r.get("error"), serving.ServingError)
+    h = fleet.health()
+    assert h["status"] in ("ok", "stale")
+    assert sorted(h["stores"]) == ["1C", "AFNS3"] == h["models"]
+
+
+def test_fleet_rejects_duplicate_model_strings(dns_setup):
+    spec, p, data, snap = dns_setup
+    store, _ = _tiered(spec, snap, 2)
+    other, _ = _tiered(spec, snap, 2)
+    with pytest.raises(serving.ServingError):
+        serving.StoreFleet([store, other])
+    with pytest.raises(serving.ServingError):
+        serving.StoreFleet([])

@@ -529,6 +529,38 @@ def _m_slot_write():
                  i32())]
 
 
+def _slot_write_many_args(warmup: bool):
+    """The batched slot-write program's two staging paths (tier
+    promotion/demotion waves vs ``TieredStateStore.warmup`` — DESIGN §21):
+    both build their buffers with the REAL shared recipe
+    (``serving.store.stage_slot_write_arrays``), the live variant filled the
+    way ``_write_state_many`` fills it — aval-identical under
+    ``max_programs=1`` or a first live promotion wave would pay a compile on
+    the hot path (the PR-8 staging-mismatch bug class)."""
+    from ..serving.store import stage_slot_write_arrays
+
+    sp = spec()
+    Ms = sp.state_dim
+    slots, valid, p, b, c, v = stage_slot_write_arrays(sp, BUCKET)
+    if not warmup:
+        # one live promotion entry, as _write_state_many stages it
+        slots[0], valid[0] = 1, True
+        p[:, 0] = 0.1
+        b[:, 0] = 0.05
+        v[0] = 3
+    return (f64(sp.n_params, CAP), f64(Ms, CAP), f64(Ms, Ms, CAP),
+            i32(CAP), slots, valid, p, b, c, v)
+
+
+@case("serving.online._jitted_slot_write_many", label="donated", donated=4)
+def _m_slot_write_many():
+    from ..serving.online import _jitted_slot_write_many
+
+    fn = _jitted_slot_write_many(spec(), CAP, BUCKET, True)
+    return fn, [_slot_write_many_args(warmup=False),
+                _slot_write_many_args(warmup=True)]
+
+
 @case("serving.online._jitted_refilter")
 def _m_refilter():
     from ..serving.online import _jitted_refilter

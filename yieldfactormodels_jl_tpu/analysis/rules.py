@@ -523,7 +523,13 @@ _UNBOUNDED_QUEUES = ("queue.Queue", "Queue", "queue.LifoQueue",
 #: pays back.  Host transfer belongs in the collect/response functions only
 #: (DESIGN §16 routing state machine).
 _ROUTING_FUNCS = frozenset({"pump", "_pump_locked", "_dispatch_updates",
-                            "_submit_read", "_route_waves", "_admit"})
+                            "_submit_read", "_route_waves", "_admit",
+                            # tier promotion/eviction routing (DESIGN §21):
+                            # deciding WHAT moves between tiers is per-request
+                            # planning work; the actual freeze/thaw transfer
+                            # belongs in the batched flush boundaries only
+                            "_prepare_batch", "_promote_plan", "_demote_plan",
+                            "prepare_reads", "_account"})
 
 #: calls that move device values to host (or force a device sync)
 _HOST_TRANSFERS = ("jax.device_get", "device_get", "np.asarray", "np.array",

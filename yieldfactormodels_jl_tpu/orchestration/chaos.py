@@ -25,7 +25,13 @@ corrupt data instead of raising (:func:`should_inject` returns the trigger
 decision and the call site applies the fault): ``nan_curve`` and
 ``nonpsd_cov`` poison the online serving state (serving/service.py) to
 exercise the health-watch → rebuild → stale-flag path end-to-end
-(docs/DESIGN.md §11).
+(docs/DESIGN.md §11), and the TIER-BOUNDARY seams (serving/tiers.py,
+docs/DESIGN.md §21) drill the residency hierarchy the same way:
+``evict_corrupt`` poisons one frozen warm record at demotion time (the
+promotion-side health watch must catch it and rebuild from the cold
+registry) and ``promote_stall`` drops one whole promotion wave (the
+affected requests answer degraded from their tier records and the next
+wave retries).
 
 REQUEST-PATH seams (docs/DESIGN.md §12) drill the serving gateway's
 degradation machinery instead of the numerics: ``slow_update`` injects

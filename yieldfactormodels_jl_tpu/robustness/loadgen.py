@@ -73,14 +73,27 @@ def _percentiles_ms(latencies) -> Tuple[float, float, float]:
     return tuple(1e3 * _nearest_rank(s, q) for q in (0.50, 0.99, 0.999))
 
 
+def zipf_weights(n: int, s: float = 1.2) -> np.ndarray:
+    """Normalized Zipf(s) popularity over ``n`` keys (weight ∝ 1/rank^s) —
+    the LRU-friendly skewed key mix the tiered-store working-set column
+    drives (DESIGN §21): a small head of keys carries most of the traffic,
+    so a hot tier smaller than the working set can still keep the hit rate
+    high.  Rank order follows key order (rank 1 = first key)."""
+    if n < 1:
+        raise ValueError(f"need n >= 1 keys, got {n}")
+    w = 1.0 / np.arange(1, n + 1, dtype=np.float64) ** float(s)
+    return w / w.sum()
+
+
 class _MixedTraffic:
     """Seeded request generator: kind by cumulative mix, curves by column.
     With ``keys`` (a sequence of state-store keys) each request addresses a
-    uniformly-drawn key — the multi-user traffic shape the sharded gateway
-    routes across the mesh (DESIGN §16)."""
+    drawn key — uniform by default, or by the ``key_weights`` popularity
+    vector (e.g. :func:`zipf_weights`) — the multi-user traffic shape the
+    sharded gateway routes across the mesh (DESIGN §16, §21)."""
 
     def __init__(self, gateway, curves, mix, horizon, n_scenarios,
-                 quantiles, seed, keys=None):
+                 quantiles, seed, keys=None, key_weights=None):
         self.gateway = gateway
         self.curves = np.asarray(curves)
         self.cum = np.cumsum(np.asarray(mix, dtype=np.float64))
@@ -91,12 +104,25 @@ class _MixedTraffic:
         self.quantiles = quantiles
         self.rng = np.random.default_rng(seed)
         self.keys = list(keys) if keys is not None else None
+        self.key_weights = None
+        if key_weights is not None:
+            if self.keys is None:
+                raise ValueError("key_weights given without keys")
+            w = np.asarray(key_weights, dtype=np.float64)
+            if w.shape != (len(self.keys),) or np.any(w < 0) or w.sum() <= 0:
+                raise ValueError(
+                    f"key_weights must be {len(self.keys)} non-negative "
+                    f"weights with positive mass, got shape {w.shape}")
+            self.key_weights = w / w.sum()
         self.i = 0
 
     def _kw(self) -> dict:
         if self.keys is None:
             return {}
-        return {"key": self.keys[self.rng.integers(len(self.keys))]}
+        if self.key_weights is None:
+            return {"key": self.keys[self.rng.integers(len(self.keys))]}
+        return {"key": self.keys[self.rng.choice(len(self.keys),
+                                                 p=self.key_weights)]}
 
     def submit_one(self) -> int:
         """Submit the next mixed request; returns its ticket (a shed raises
@@ -119,7 +145,8 @@ def run_load(gateway, curves, *, duration_s: float = 2.0,
              horizon: int = 8, n_scenarios: int = 8,
              quantiles: Optional[Tuple[float, ...]] = None,
              burst: int = 4, seed: int = 0,
-             drain_rounds: int = 200, keys=None) -> LoadReport:
+             drain_rounds: int = 200, keys=None,
+             key_weights=None) -> LoadReport:
     """Drive ``duration_s`` of mixed traffic at ``offered_qps`` through the
     gateway, closed-loop (each burst is submitted, pumped, then collected —
     outstanding tickets are re-polled after later pumps, so a stalled cycle
@@ -128,7 +155,8 @@ def run_load(gateway, curves, *, duration_s: float = 2.0,
     outstanding is reported ``abandoned`` (only a permanently-stalled worker
     leaves any)."""
     traffic = _MixedTraffic(gateway, curves, mix, horizon, n_scenarios,
-                            quantiles, seed, keys=keys)
+                            quantiles, seed, keys=keys,
+                            key_weights=key_weights)
     latencies, outstanding = [], []
     ok = degraded = shed = errors = 0
     t_start = time.perf_counter()
@@ -186,14 +214,15 @@ def run_load(gateway, curves, *, duration_s: float = 2.0,
 def measure_capacity(gateway, curves, *, n: int = 128,
                      mix: Tuple[float, float, float] = (0.6, 0.3, 0.1),
                      horizon: int = 8, n_scenarios: int = 8,
-                     burst: int = 8, seed: int = 1, keys=None) -> float:
+                     burst: int = 8, seed: int = 1, keys=None,
+                     key_weights=None) -> float:
     """Max sustained QPS: the UNPACED closed-loop completion rate — bursts
     submitted back-to-back with the service always busy, queue depth bounded
     by the burst, nothing shed.  This is the saturation throughput the paced
     ``run_load`` offered rate is set against (chaos should be DISARMED here;
     arm it for the measured run, not the yardstick)."""
     traffic = _MixedTraffic(gateway, curves, mix, horizon, n_scenarios,
-                            None, seed, keys=keys)
+                            None, seed, keys=keys, key_weights=key_weights)
     answered = 0
     t0 = time.perf_counter()
     while traffic.i < n:

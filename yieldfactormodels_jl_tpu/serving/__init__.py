@@ -10,7 +10,10 @@ admission control/load shedding, per-request deadlines with degraded
 last-good answers (``gateway``) — and the device-scale half: mesh-resident
 per-user filter states sharded across the device mesh with shard-routed
 donated micro-batch updates (``store``, ``ShardedGateway``;
-docs/DESIGN.md §16).
+docs/DESIGN.md §16) — extended past HBM by the tiered residency hierarchy:
+hot device slots / packed warm host records / cold snapshot registry with
+LRU promotion-on-miss, batched promotion waves, a capacity ledger, and the
+multi-store fleet seam (``tiers``; docs/DESIGN.md §21).
 """
 
 from .batcher import (BucketLattice, DEFAULT_LATTICE, ForecastRequest,
@@ -23,11 +26,16 @@ from .snapshot import (ServingError, ServingSnapshot, SnapshotMeta,
                        SnapshotRegistry, freeze_snapshot,
                        freeze_snapshots_batch, load_snapshot)
 from .store import ShardedStateStore
+from .tiers import StoreFleet, TieredStateStore, TierLedger, WarmTier
 
 __all__ = [
     "BucketLattice",
     "ShardedGateway",
     "ShardedStateStore",
+    "StoreFleet",
+    "TieredStateStore",
+    "TierLedger",
+    "WarmTier",
     "DEFAULT_LATTICE",
     "ForecastRequest",
     "MicroBatcher",

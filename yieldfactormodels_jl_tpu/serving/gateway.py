@@ -293,6 +293,7 @@ class ServingGateway:
                 run_updates.append(req)
             else:
                 run_batched.append(req)
+        self._prepare_batch(run_updates, run_batched)
         self._dispatch_updates(run_updates)
         if run_batched:
             self._dispatch_batched(run_batched)
@@ -303,6 +304,15 @@ class ServingGateway:
             # must be able to find its way back to serving fresh answers
             self._flush_cost = 0.5 * self._flush_cost
         return len(batch)
+
+    def _prepare_batch(self, run_updates: List[_Pending],
+                       run_batched: List[_Pending]) -> None:
+        """Hook between batch formation and dispatch: the sharded gateway
+        pre-promotes the drained READ keys in one wave when its store is
+        tiered (serving/tiers.py — update keys promote inside
+        ``store.update_batch`` itself).  Runs AFTER deadline triage so
+        already-expired requests never trigger device work; pure routing —
+        no host transfer here (YFM008)."""
 
     def _degraded_answer(self, req: _Pending, reason: str) -> dict:
         """The degraded answer: the service's last-good snapshot state —
@@ -545,6 +555,20 @@ class ShardedGateway(ServingGateway):
                 self.counters.completed += 1
                 self._finish(req.ticket, {"kind": "update", **out})
 
+    def _prepare_batch(self, run_updates: List[_Pending],
+                       run_batched: List[_Pending]) -> None:
+        """Batch-promote the cycle's READ keys before any per-request
+        ``snapshot_of`` resolution: a tiered store (or fleet) thaws every
+        warm/cold read key of this wave in one batched promotion, so a read
+        burst against demoted state costs one device dispatch per shard —
+        never one per request.  Update keys are handled inside
+        ``store.update_batch``; stores without a tier seam have no
+        ``prepare_reads`` and skip.  Pure key routing (YFM008)."""
+        prepare = getattr(self.store, "prepare_reads", None)
+        if prepare is None or not run_batched:
+            return
+        prepare([r.payload[0] for r in run_batched])
+
     def _submit_read(self, req: _Pending) -> int:
         key, payload = req.payload
         return self.store.batcher.submit(self.store.snapshot_of(key), payload)
@@ -562,7 +586,9 @@ class ShardedGateway(ServingGateway):
             raise ServingError("refit", "sharded refits need key= (the "
                                "(model_string, task_id) state address)")
         store = self.store
-        spec = store.spec
+        # fleet stores have no single .spec — resolve per key
+        spec = store.spec_for(key) if hasattr(store, "spec_for") \
+            else store.spec
 
         def run():
             from ..estimation import amortize as _amortize

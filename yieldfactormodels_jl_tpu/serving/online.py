@@ -393,6 +393,35 @@ def _jitted_slot_write(spec: ModelSpec, capacity: int, donate: bool = True):
 
 @register_engine_cache
 @lru_cache(maxsize=32)
+def _jitted_slot_write_many(spec: ModelSpec, capacity: int, bucket: int,
+                            donate: bool = True):
+    """Multi-slot rewrite program: scatter up to ``bucket`` slots' worth of
+    (p, β, cov-rep, version) into a shard's resident arrays in ONE donated
+    launch — the batched promotion / bulk-registration path (docs/DESIGN.md
+    §21): a burst of tier misses costs one device dispatch per shard, not
+    one per user.  Padding rows target slot ``capacity`` (out of bounds) and
+    are DROPPED exactly as in ``_jitted_shard_update`` — they can never
+    clobber a live slot.  Callers guarantee the valid slots are UNIQUE
+    within one launch (duplicate scatter order is undefined); the router
+    (``serving.tiers``) enforces it by construction.  One compiled program
+    per (capacity, bucket): mesh size never appears in the key, so a
+    1→2→4→8 sweep at fixed shard capacity reuses one trace (pinned in
+    tests/test_tiers.py)."""
+    del spec, bucket  # shapes ride the arguments; the key keeps them apart
+
+    def write(params, beta, cov, ver, slots, valid, p, b, c, v):
+        note_trace("slot_write_many")
+        safe = jnp.where(valid, slots, capacity)
+        return (params.at[:, safe].set(p, mode="drop"),
+                beta.at[:, safe].set(b, mode="drop"),
+                cov.at[:, :, safe].set(c, mode="drop"),
+                ver.at[safe].set(v, mode="drop"))
+
+    return jax.jit(write, donate_argnums=(0, 1, 2, 3) if donate else ())
+
+
+@register_engine_cache
+@lru_cache(maxsize=32)
 def _jitted_refilter(spec: ModelSpec, T: int):
     """Re-filter-from-scratch program (docs/DESIGN.md §13/§19): the
     O(log T)-span parallel-in-time filter over a full (N, T) history → the

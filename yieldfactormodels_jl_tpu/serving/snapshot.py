@@ -264,9 +264,19 @@ class SnapshotRegistry:
         with self._lock:
             return len(self._snaps)
 
+    def __contains__(self, key: Tuple[str, int]) -> bool:
+        with self._lock:
+            return key in self._snaps
+
     def keys(self):
         with self._lock:
             return sorted(self._snaps)
+
+    def pop(self, key: Tuple[str, int]) -> Optional[ServingSnapshot]:
+        """Remove and return one snapshot (None when absent) — the tiered
+        store's cold-tier consume path (serving/tiers.py)."""
+        with self._lock:
+            return self._snaps.pop(key, None)
 
     def put(self, snap: ServingSnapshot) -> Tuple[str, int]:
         key = (snap.meta.model_string, snap.meta.task_id)

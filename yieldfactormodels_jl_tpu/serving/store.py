@@ -64,7 +64,7 @@ from ..robustness import taxonomy as tax
 from ..utils.profiling import StageTimer
 from .batcher import BucketLattice, MicroBatcher
 from .online import (_check_engine, _jitted_shard_update, _jitted_slot_write,
-                     factor_cov)
+                     _jitted_slot_write_many, factor_cov)
 from .service import RequestCounters
 from .snapshot import (ServingError, ServingSnapshot, SnapshotMeta,
                        SnapshotRegistry)
@@ -85,6 +85,25 @@ def stage_request_arrays(spec, bucket: int):
     slots = np.zeros((bucket,), dtype=np.int32)
     valid = np.zeros((bucket,), dtype=bool)
     return Y, slots, valid
+
+
+def stage_slot_write_arrays(spec, bucket: int):
+    """The ONE staging recipe for a batched slot-write bucket
+    (``online._jitted_slot_write_many``): all-padding ``(slots, valid, p, b,
+    c, v)`` host buffers at the program's input signature.  Same contract as
+    :func:`stage_request_arrays` — every launch path (bulk registration,
+    tier promotion/demotion, warm-up) builds its write arrays HERE, and the
+    IR-audit manifest derives the program's staging-parity variants from
+    this helper, so the paths cannot drift into a second compile per
+    (device, bucket)."""
+    dtype = spec.dtype
+    slots = np.zeros((bucket,), dtype=np.int32)
+    valid = np.zeros((bucket,), dtype=bool)
+    p = np.zeros((spec.n_params, bucket), dtype=dtype)
+    b = np.zeros((spec.state_dim, bucket), dtype=dtype)
+    c = np.zeros((spec.state_dim, spec.state_dim, bucket), dtype=dtype)
+    v = np.zeros((bucket,), dtype=np.int32)
+    return slots, valid, p, b, c, v
 
 
 def _route_waves(items, slot_map) -> List[Dict[int, list]]:
@@ -235,6 +254,40 @@ class ShardedStateStore:
             jnp.asarray(cov, dtype=dtype),
             jnp.asarray(ver, dtype=jnp.int32))
 
+    def _write_state_many(self, s: int, entries) -> None:
+        """Rewrite MANY slots of shard ``s`` in one donated scatter per
+        lattice bucket — the batched sibling of :meth:`_write_state`
+        (``online._jitted_slot_write_many``): a bulk registration or a tier
+        promotion/demotion wave costs one device dispatch per (shard,
+        bucket-chunk), not one per slot.  ``entries`` is ``[(slot, params,
+        beta, cov, ver), ...]`` with UNIQUE slots (scatter duplicate order
+        is undefined — callers route one write per slot per wave)."""
+        if not entries:
+            return
+        sh = self._shards[s]
+        bmax = self.lattice.update_batch_sizes[-1]
+        for lo in range(0, len(entries), bmax):
+            chunk = entries[lo:lo + bmax]
+            bb = self.lattice.update_bucket(len(chunk))
+            slots, valid, p, b, c, v = stage_slot_write_arrays(self.spec, bb)
+            for j, (sl, pj, bj, cj, vj) in enumerate(chunk):
+                slots[j], valid[j] = sl, True
+                p[:, j] = np.asarray(pj).reshape(-1)
+                b[:, j] = bj
+                c[:, :, j] = cj
+                v[j] = vj
+            writer = _jitted_slot_write_many(self.spec, self.shard_capacity,
+                                             bb, self._donate)
+            sh["params"], sh["beta"], sh["cov"], sh["ver"] = writer(
+                sh["params"], sh["beta"], sh["cov"], sh["ver"],
+                slots, valid, p, b, c, v)
+
+    def spec_for(self, key: Key):
+        """The spec serving ``key`` — one spec per store here; the fleet
+        seam (``tiers.StoreFleet``) routes per-key."""
+        del key
+        return self.spec
+
     def register(self, snapshot: ServingSnapshot) -> Key:
         """Admit one frozen snapshot: allocate a slot on the least-loaded
         shard, factor the covariance into the engine representation, write
@@ -266,11 +319,15 @@ class ShardedStateStore:
         return key
 
     def register_many(self, snapshots) -> List[Key]:
-        """Bulk warm-boot registration.  On an EMPTY store the shards are
-        assembled host-side and shipped with ONE placement per shard array
-        (no per-slot programs — the warm-boot path must not pay thousands of
-        scatter launches); on a non-empty store it falls back to per-slot
-        :meth:`register` so resident state is never gathered."""
+        """Bulk registration.  On an EMPTY store the shards are assembled
+        host-side and shipped with ONE placement per shard array (no
+        per-slot programs — the warm-boot path must not pay thousands of
+        scatter launches); on a non-empty store the validated batch rides
+        the batched slot-write program (:meth:`_write_state_many` —
+        ``online._jitted_slot_write_many``), one donated dispatch per
+        (shard, bucket-chunk), so resident state is never gathered and the
+        cost is O(batch) launches, not O(batch) scatters.  Both branches are
+        all-or-nothing: a mid-list failure leaves the store untouched."""
         snapshots = list(snapshots)
         dtype = self.spec.dtype
         # validate + factor EVERYTHING before touching any table or shard:
@@ -334,9 +391,36 @@ class ShardedStateStore:
                             if name != "ver" else jnp.asarray(st[name]), d)
                         for name in ("params", "beta", "cov", "ver")}
         if not empty:
-            # non-empty store: per-slot path (resident state never gathered,
-            # and nothing was mutated above beyond the validation pass)
-            return [self.register(s) for s in snapshots]
+            # non-empty store: batched slot writes into the free slots
+            # (resident state never gathered, and nothing was mutated above
+            # beyond the validation pass — re-checked all-or-nothing here)
+            with self._lock:
+                clash = [k for k, _, _ in staged if k in self._slot]
+                if clash:
+                    raise ServingError(
+                        "store", f"key {clash[0]} already registered — "
+                        "evict it first", key=clash[0])
+                if len(staged) > sum(len(f) for f in self._free):
+                    raise ServingError(
+                        "store", f"{len(staged)} snapshots exceed the "
+                        f"{sum(len(f) for f in self._free)} free slots — "
+                        "widen shard_capacity or the mesh")
+                keys = []
+                per_shard: Dict[int, list] = {}
+                for key, snap, cov in staged:
+                    s = int(np.argmax([len(f) for f in self._free]))
+                    sl = self._free[s].pop()
+                    per_shard.setdefault(s, []).append(
+                        (sl, snap.params, snap.beta, cov,
+                         snap.meta.version))
+                    self._slot[key] = (s, sl)
+                    self._meta[key] = snap.meta
+                    self._bank[key] = (
+                        np.asarray(snap.beta, dtype=np.float64),
+                        np.asarray(cov, dtype=np.float64))
+                    keys.append(key)
+                for s in sorted(per_shard):
+                    self._write_state_many(s, per_shard[s])
         return keys
 
     def evict(self, key: Key) -> None:
@@ -550,6 +634,19 @@ class ShardedStateStore:
 
     # ---- read-side snapshots ---------------------------------------------
 
+    def _snapshot_of_locked(self, key: Key) -> ServingSnapshot:
+        """:meth:`snapshot_of` body with ``self._lock`` HELD by the caller —
+        the tiered store resolves the hot tier and builds the device slices
+        under one acquisition so a concurrent demotion wave can't invalidate
+        the slot between check and slice (serving/tiers.py)."""
+        s, sl = self._slot[key]
+        meta = self._meta[key]
+        sh = self._shards[s]
+        c = sh["cov"][:, :, sl]
+        P = c @ c.T if self.engine == "sqrt" else c
+        return ServingSnapshot(self.spec, sh["params"][:, sl],
+                               sh["beta"][:, sl], P, meta)
+
     def snapshot_of(self, key: Key) -> ServingSnapshot:
         """The key's LIVE state as a snapshot with DEVICE leaves (params, β,
         P) — slot-sized device slices, no host transfer: forecast/scenario
@@ -558,13 +655,16 @@ class ShardedStateStore:
         with self._lock:
             if key not in self._slot:
                 raise ServingError("store", f"no state registered for {key}")
-            s, sl = self._slot[key]
-            meta = self._meta[key]
-        sh = self._shards[s]
-        c = sh["cov"][:, :, sl]
-        P = c @ c.T if self.engine == "sqrt" else c
-        return ServingSnapshot(self.spec, sh["params"][:, sl],
-                               sh["beta"][:, sl], P, meta)
+            return self._snapshot_of_locked(key)
+
+    def _last_good_locked(self, key: Key) -> ServingSnapshot:
+        """:meth:`last_good_snapshot_of` body with ``self._lock`` held by
+        the caller (same single-acquisition rationale as
+        :meth:`_snapshot_of_locked`)."""
+        beta, cov = self._bank[key]
+        meta = self._meta[key]
+        P = cov @ cov.T if self.engine == "sqrt" else cov
+        return ServingSnapshot(self.spec, None, beta, P, meta)
 
     def last_good_snapshot_of(self, key: Key) -> ServingSnapshot:
         """The banked last-good state (host copies) as a snapshot — what a
@@ -572,10 +672,7 @@ class ShardedStateStore:
         with self._lock:
             if key not in self._bank:
                 raise ServingError("store", f"no state registered for {key}")
-            beta, cov = self._bank[key]
-            meta = self._meta[key]
-        P = cov @ cov.T if self.engine == "sqrt" else cov
-        return ServingSnapshot(self.spec, None, beta, P, meta)
+            return self._last_good_locked(key)
 
     # ---- observability / warmup ------------------------------------------
 

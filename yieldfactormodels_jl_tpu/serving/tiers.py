@@ -1,0 +1,877 @@
+"""Tiered state residency for the sharded store (docs/DESIGN.md §21).
+
+PR 8 made per-user filter states device-resident (``store.ShardedStateStore``)
+— and capped serving at what fits in HBM.  This module adds the memory
+hierarchy behind those hot slots, the serving-side analogue of KV-cache
+paging in LLM inference stacks:
+
+- **Hot tier** — the mesh-resident slots, unchanged: donated shard-update
+  programs, O(batch) host traffic, the only tier that serves live updates.
+- **Warm tier** (:class:`WarmTier`) — evicted slots frozen to PACKED host-RAM
+  arrays holding the exact ENGINE representation (params, β, cov-rep,
+  version) plus meta/stale bits.  Because the engine representation itself
+  is frozen (never re-factored), a demote → promote round trip restores the
+  hot slot **bit-for-bit** (pinned in tests/test_tiers.py) — the freeze/thaw
+  parity invariant.
+- **Cold tier** — the :class:`~.snapshot.SnapshotRegistry` behind the warm
+  tier: warm overflow spills there as moment-space snapshots (β, P).  Cold →
+  hot re-factors the covariance (``factor_cov``), so warm↔hot is bit-exact
+  while cold↔hot is moment-exact — the sqrt engine's factor is not unique,
+  and the §11 health watch guards the re-factorization.
+
+**Policy** (:class:`TieredStateStore`): an LRU access clock (one integer per
+key, bumped on every accounted request), promotion on miss, demotion of the
+coldest resident keys under pressure.  Promotions and demotions move in
+WAVES: one gathered fetch per shard on the way out, one donated
+``online._jitted_slot_write_many`` scatter per (shard, bucket-chunk) on the
+way in — a burst of misses costs one device dispatch per shard, not one per
+user, and the steady-state hot path adds ZERO retraces (the write program's
+key never mentions mesh size or wave content).
+
+**Request flow**: ``update_batch`` accounts each request against the ledger
+(hit / warm miss / cold miss), promotes the missed keys in one wave, then
+delegates to the base shard-routed launch.  A key whose promotion cannot
+land this wave (the ``promote_stall`` chaos seam, a health-watch rejection
+with no cold fallback, or genuine capacity starvation) is answered with a
+DEGRADED stale result — never an error, never a blocked batch (the §12
+degrade machinery).  Reads are tier-transparent: ``snapshot_of`` serves
+warm/cold keys from their host records directly; the
+:class:`~.gateway.ShardedGateway` pump pre-promotes the read keys of each
+drained batch (``prepare_reads``) so read bursts ride the same batched
+promotion wave.
+
+**Chaos seams** (orchestration/chaos.py, ``YFM_CHAOS`` grammar):
+``evict_corrupt`` poisons one frozen warm record at demotion time — the
+promotion-side health watch must catch it and rebuild from the cold tier
+(§11 ladder); ``promote_stall`` drops one whole promotion wave — the
+affected requests degrade and the next wave retries.
+
+**Capacity ledger** (:class:`TierLedger`): hits, per-tier misses,
+promotions/demotions/spills/drops and stall counts, plus per-wave promotion
+latency percentiles through the store timer — the honest numbers behind the
+``BENCH_LOAD=1`` working-set column and BASELINE round 13's
+states-per-chip-at-fixed-p99 metric.
+
+Threading follows store.py: tier tables ride the store lock, the packed
+warm arrays their own lock (always acquired store → warm, never reverse);
+the device arrays stay single-writer — route updates through ONE gateway
+pump.  Without a cold registry the tier stack is LOSSY past hot+warm
+capacity: the coldest warm record is dropped (counted in
+``ledger.dropped``) — give the store a registry when state must survive
+arbitrary working sets.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import os
+import threading
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..orchestration import chaos
+from ..robustness import health as rh
+from ..robustness import taxonomy as tax
+from ..utils.profiling import StageTimer, _nearest_rank
+from .batcher import MicroBatcher
+from .online import _jitted_slot_write_many, factor_cov
+from .snapshot import ServingError, ServingSnapshot, SnapshotMeta
+from .store import Key, ShardedStateStore, stage_slot_write_arrays
+from .service import RequestCounters
+
+
+class WarmRecord(NamedTuple):
+    """One frozen slot: the exact engine representation plus its identity.
+    ``params``/``beta``/``cov`` are host copies at the store dtype — the
+    bits that went cold are the bits that come back hot."""
+
+    params: np.ndarray
+    beta: np.ndarray
+    cov: np.ndarray
+    ver: int
+    meta: SnapshotMeta
+    stale: bool
+    stamp: int
+
+
+class WarmTier:
+    """Packed host-RAM columns of frozen slots (docs/DESIGN.md §21).
+
+    One preallocated array per state field with the slot axis LAST (same
+    layout discipline as the device shards, so freeze/thaw is a column copy,
+    not a transpose), a free-list, and a ``key → column`` map.  Bounded:
+    ``capacity`` columns, full stop — the warm tier is a memory bound, not a
+    cache that grows.  Thread-safe: every map/array access holds the tier
+    lock (the store mutates under its own lock from the pump thread while
+    health/ops threads read)."""
+
+    def __init__(self, spec, capacity: int):
+        if capacity < 1:
+            raise ValueError(f"warm capacity must be >= 1, got {capacity}")
+        self.spec = spec
+        self.capacity = int(capacity)
+        Pn, Ms, W = spec.n_params, spec.state_dim, self.capacity
+        self._lock = threading.Lock()
+        self._idx: Dict[Key, int] = {}
+        self._free: List[int] = list(range(W))
+        self._params = np.zeros((Pn, W), dtype=spec.dtype)
+        self._beta = np.zeros((Ms, W), dtype=spec.dtype)
+        self._cov = np.zeros((Ms, Ms, W), dtype=spec.dtype)
+        self._ver = np.zeros((W,), dtype=np.int32)
+        self._meta: Dict[Key, SnapshotMeta] = {}
+        self._stale: set = set()
+        self._stamp: Dict[Key, int] = {}
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._idx)
+
+    def __contains__(self, key: Key) -> bool:
+        with self._lock:
+            return key in self._idx
+
+    def keys(self):
+        with self._lock:
+            return sorted(self._idx)
+
+    def free(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def coldest(self) -> Optional[Key]:
+        """The least-recently-used warm key (the spill candidate)."""
+        with self._lock:
+            if not self._idx:
+                return None
+            return min(self._idx, key=lambda k: (self._stamp.get(k, 0), k))
+
+    def put(self, key: Key, params, beta, cov, ver: int, meta: SnapshotMeta,
+            stale: bool, stamp: int) -> None:
+        """Freeze one record into a packed column.  Raises when full — the
+        CALLER owns the spill policy (``TieredStateStore`` spills the
+        coldest record to the cold registry first)."""
+        with self._lock:
+            i = self._idx.get(key)
+            if i is None:
+                if not self._free:
+                    raise ServingError(
+                        "store", f"warm tier exhausted ({self.capacity} "
+                        "records) — spill to the cold registry first",
+                        key=key)
+                i = self._free.pop()
+                self._idx[key] = i
+            self._params[:, i] = np.asarray(params).reshape(-1)
+            self._beta[:, i] = beta
+            self._cov[:, :, i] = cov
+            self._ver[i] = ver
+            self._meta[key] = meta
+            self._stamp[key] = int(stamp)
+            if stale:
+                self._stale.add(key)
+            else:
+                self._stale.discard(key)
+
+    def _record_locked(self, key: Key) -> WarmRecord:
+        i = self._idx[key]
+        return WarmRecord(self._params[:, i].copy(), self._beta[:, i].copy(),
+                          self._cov[:, :, i].copy(), int(self._ver[i]),
+                          self._meta[key], key in self._stale,
+                          self._stamp.get(key, 0))
+
+    def peek(self, key: Key) -> Optional[WarmRecord]:
+        """Copy one record without thawing it (degraded answers, reads)."""
+        with self._lock:
+            if key not in self._idx:
+                return None
+            return self._record_locked(key)
+
+    def pop(self, key: Key) -> Optional[WarmRecord]:
+        """Thaw one record: copy it out and free its column."""
+        with self._lock:
+            if key not in self._idx:
+                return None
+            rec = self._record_locked(key)
+            self._free.append(self._idx.pop(key))
+            self._meta.pop(key, None)
+            self._stale.discard(key)
+            self._stamp.pop(key, None)
+            return rec
+
+    def discard(self, key: Key) -> bool:
+        """Drop a record without reading it; True when one existed."""
+        with self._lock:
+            if key not in self._idx:
+                return False
+            self._free.append(self._idx.pop(key))
+            self._meta.pop(key, None)
+            self._stale.discard(key)
+            self._stamp.pop(key, None)
+            return True
+
+
+@dataclasses.dataclass
+class TierLedger:
+    """Request-path tier accounting (docs/DESIGN.md §21).  ``hits`` counts
+    requests whose key was hot at accounting time; ``misses_*`` the tier the
+    key was found in instead; promotion/demotion/spill/drop/stall counters
+    track the waves those misses triggered.  ``dropped`` > 0 means state was
+    LOST (warm overflow with no cold registry) — the lossy-mode tell."""
+
+    hits: int = 0
+    misses_warm: int = 0
+    misses_cold: int = 0
+    promotions: int = 0
+    demotions: int = 0
+    spills: int = 0
+    dropped: int = 0
+    promote_stalls: int = 0
+    corrupt_rebuilds: int = 0
+
+    @property
+    def accounted(self) -> int:
+        return self.hits + self.misses_warm + self.misses_cold
+
+    @property
+    def hit_rate(self) -> float:
+        n = self.accounted
+        return self.hits / n if n else 1.0
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["hit_rate"] = round(self.hit_rate, 6)
+        return d
+
+
+def _env_warm_cap(hot_capacity: int) -> int:
+    raw = os.environ.get("YFM_STORE_WARM_CAP", "")
+    return int(raw) if raw else 4 * hot_capacity
+
+
+class TieredStateStore(ShardedStateStore):
+    """A :class:`~.store.ShardedStateStore` with hot/warm/cold residency
+    tiers and an LRU promotion/demotion policy (module docstring; lifecycle
+    state machine in docs/DESIGN.md §21).
+
+    ``warm_capacity`` bounds the packed host tier (default
+    ``YFM_STORE_WARM_CAP``, else 4× the hot capacity); ``registry`` — the
+    base class's rebuild source — doubles as the cold tier: warm overflow
+    spills there, and promotion falls back to it when a warm record fails
+    the health watch.  All other knobs as the base store.  The operator
+    surface grows ``tiers()`` (occupancy + :class:`TierLedger` + promotion
+    latency percentiles), ``demote()`` / ``ensure_resident()`` /
+    ``prepare_reads()`` verbs, and ``health()['tiers']``.
+    """
+
+    def __init__(self, spec, *, warm_capacity: Optional[int] = None,
+                 **kwargs):
+        super().__init__(spec, **kwargs)
+        if warm_capacity is None:
+            warm_capacity = _env_warm_cap(self.capacity)
+        self.warm = WarmTier(spec, warm_capacity)
+        self.ledger = TierLedger()
+        self._tick = 0
+        self._access: Dict[Key, int] = {}
+
+    # ---- introspection ----------------------------------------------------
+
+    def __contains__(self, key: Key) -> bool:
+        if super().__contains__(key) or key in self.warm:
+            return True
+        return self.registry is not None and key in self.registry
+
+    def tiers(self) -> dict:
+        """Occupancy + ledger + per-wave promotion latency (ms) — the
+        capacity-ledger record the BENCH_LOAD working-set column reads."""
+        with self._lock:
+            hot = len(self._slot)
+            hot_free = sum(len(f) for f in self._free)
+        out = {
+            "hot": hot, "hot_capacity": self.capacity, "hot_free": hot_free,
+            "warm": len(self.warm), "warm_capacity": self.warm.capacity,
+            "cold": len(self.registry) if self.registry is not None else 0,
+            "ledger": self.ledger.to_dict(),
+        }
+        promo = sorted(self.timer.samples.get("promote", ()))
+        out["promote_waves"] = len(promo)
+        out["promote_p50_ms"] = round(
+            1e3 * _nearest_rank(promo, 0.50), 3) if promo else 0.0
+        out["promote_p99_ms"] = round(
+            1e3 * _nearest_rank(promo, 0.99), 3) if promo else 0.0
+        return out
+
+    def health(self) -> dict:
+        out = super().health()
+        out["tiers"] = self.tiers()
+        return out
+
+    def _touch_locked(self, key: Key) -> None:
+        self._tick += 1
+        self._access[key] = self._tick
+
+    def _tier_version(self, key: Key) -> int:
+        rec = self.warm.peek(key)
+        if rec is not None:
+            return rec.meta.version
+        if self.registry is not None and key in self.registry:
+            return self.registry.get(*key).meta.version
+        return 0
+
+    # ---- registration across tiers ----------------------------------------
+
+    def register(self, snapshot: ServingSnapshot) -> Key:
+        key = (snapshot.meta.model_string, snapshot.meta.task_id)
+        if key in self.warm:
+            raise ServingError("store", f"key {key} is already warm-"
+                               "resident — evict it first", key=key)
+        with self._lock:
+            if key not in self._slot \
+                    and not any(len(f) for f in self._free):
+                victims = self._demote_plan(1, exclude={key})
+                if not victims:
+                    raise ServingError(
+                        "store", f"capacity exhausted ({self.capacity} hot "
+                        "slots) and nothing demotable", key=key)
+                with self.timer.stage("demote"):
+                    self._demote_locked(victims)
+        k = super().register(snapshot)
+        with self._lock:
+            self._touch_locked(k)
+        return k
+
+    def register_many(self, snapshots) -> List[Key]:
+        """Bulk boot across tiers: the first ``hot_free`` snapshots take hot
+        slots (the base store's batched paths), the remainder freeze
+        STRAIGHT into the warm tier (no device work) — how a working set
+        larger than residency boots.  All-or-nothing like the base: the
+        whole list is validated (duplicates, warm clashes, PSD, warm fit)
+        before anything mutates."""
+        snapshots = list(snapshots)
+        seen = set()
+        for snap in snapshots:
+            key = (snap.meta.model_string, snap.meta.task_id)
+            if key in seen:
+                raise ServingError("store", f"key {key} appears twice in "
+                                   "the bulk registration", key=key)
+            seen.add(key)
+            if key in self.warm:
+                raise ServingError("store", f"key {key} is already warm-"
+                                   "resident — evict it first", key=key)
+        with self._lock:
+            hot_free = sum(len(f) for f in self._free)
+        head, tail = snapshots[:hot_free], snapshots[hot_free:]
+        staged_tail = []
+        for snap in tail:
+            key = (snap.meta.model_string, snap.meta.task_id)
+            try:
+                cov = np.asarray(factor_cov(snap.P, self.engine,
+                                            self.spec.dtype))
+            except ValueError:
+                raise ServingError("store", "filtered covariance is not "
+                                   "PSD — cannot start the sqrt engine",
+                                   key=key)
+            staged_tail.append((key, snap, cov))
+        if staged_tail and self.registry is None \
+                and len(staged_tail) > self.warm.free():
+            raise ServingError(
+                "store", f"{len(staged_tail)} overflow snapshots exceed the "
+                f"{self.warm.free()} free warm records and no cold registry "
+                "is attached — widen YFM_STORE_WARM_CAP or attach one")
+        keys = list(super().register_many(head)) if head else []
+        with self._lock:
+            for k in keys:
+                self._touch_locked(k)
+        for key, snap, cov in staged_tail:
+            self._warm_put_with_spill(
+                key, np.asarray(snap.params), np.asarray(snap.beta), cov,
+                snap.meta.version, snap.meta, stale=False, stamp=0)
+            keys.append(key)
+        return keys
+
+    def evict(self, key: Key) -> None:
+        """Drop a key from the hot or warm tier (the cold registry is the
+        durable archive — its entries outlive an eviction, exactly as they
+        do for the base store's rebuild path)."""
+        with self._lock:
+            hot = key in self._slot
+        if hot:
+            super().evict(key)
+            with self._lock:
+                self._access.pop(key, None)
+            return
+        if not self.warm.discard(key):
+            raise ServingError("store", f"no state registered for {key}")
+
+    # ---- demotion (hot → warm → cold) --------------------------------------
+
+    def _demote_plan(self, n: int, exclude) -> List[Key]:
+        """Pick the ``n`` coldest demotable resident keys (LRU by access
+        clock) — pure host routing work, lock held by the caller; no device
+        transfer may happen here (graftlint YFM008)."""
+        return heapq.nsmallest(
+            n, (k for k in self._slot if k not in exclude),
+            key=lambda k: (self._access.get(k, 0), k))
+
+    def _warm_put_with_spill(self, key: Key, params, beta, cov, ver, meta,
+                             stale: bool, stamp: int) -> None:
+        """Freeze one record, spilling the coldest warm record to the cold
+        registry (moment-space snapshot) when the packed tier is full —
+        or DROPPING it (``ledger.dropped``) when no registry is attached."""
+        while key not in self.warm and self.warm.free() == 0:
+            victim = self.warm.coldest()
+            rec = self.warm.pop(victim)
+            if rec is None:
+                break
+            if self.registry is not None:
+                P = rec.cov @ rec.cov.T if self.engine == "sqrt" else rec.cov
+                self.registry.put(ServingSnapshot(
+                    self.spec, rec.params, rec.beta, P, rec.meta))
+                self.ledger.spills += 1
+            else:
+                self.ledger.dropped += 1
+        self.warm.put(key, params, beta, cov, ver, meta, stale, stamp)
+
+    def _demote_locked(self, victims: List[Key]) -> None:
+        """Freeze the victims' slots to the warm tier: per owning shard, ONE
+        gathered fetch per lattice bucket-chunk, indices PADDED to the
+        bucket size so the gather executables are as fixed-shape as the
+        slot-write programs (``warmup`` primes both — a live wave never pays
+        a compile).  The freed slots keep their last bits: they are
+        unreachable (every read path resolves through the slot table) and
+        the next promotion wave's donated scatter overwrites them, so
+        demotion ships O(wave) host traffic and zero scatters of its own.
+        The ``evict_corrupt`` chaos seam fires per frozen record (a poisoned
+        freeze the promotion-side health watch must catch).  Store lock held
+        by the caller."""
+        groups: Dict[int, list] = {}
+        for key in victims:
+            if key not in self._slot:
+                continue
+            s, sl = self._slot[key]
+            groups.setdefault(s, []).append((key, sl))
+        bmax = self.lattice.update_batch_sizes[-1]
+        for s in sorted(groups):
+            sh = self._shards[s]
+            for lo in range(0, len(groups[s]), bmax):
+                chunk = groups[s][lo:lo + bmax]
+                bb = self.lattice.update_bucket(len(chunk))
+                sls = np.full(bb, chunk[-1][1], dtype=np.int32)
+                sls[:len(chunk)] = [sl for _, sl in chunk]
+                p_h, b_h, c_h, v_h = jax.device_get(
+                    (sh["params"][:, sls], sh["beta"][:, sls],
+                     sh["cov"][:, :, sls], sh["ver"][sls]))
+                for j, (key, sl) in enumerate(chunk):
+                    beta_j, cov_j = b_h[:, j].copy(), c_h[:, :, j].copy()
+                    if chaos.should_inject("evict_corrupt"):
+                        beta_j = np.full_like(beta_j, np.nan)
+                        cov_j = np.full_like(cov_j, np.nan)
+                    self._warm_put_with_spill(
+                        key, p_h[:, j].copy(), beta_j, cov_j, int(v_h[j]),
+                        self._meta[key], stale=key in self._stale,
+                        stamp=self._access.get(key, 0))
+                    self._slot.pop(key)
+                    self._free[s].append(sl)
+                    self._meta.pop(key, None)
+                    self._bank.pop(key, None)
+                    self._stale.discard(key)
+                    self._access.pop(key, None)
+                    self.ledger.demotions += 1
+
+    def demote(self, keys) -> None:
+        """Explicitly freeze resident keys to the warm tier (operator verb;
+        the pressure path calls the same machinery)."""
+        keys = list(dict.fromkeys(keys))
+        with self.timer.stage("demote"):
+            with self._lock:
+                missing = [k for k in keys if k not in self._slot]
+                if missing:
+                    raise ServingError(
+                        "store", f"no state registered for {missing[0]}",
+                        key=missing[0])
+                self._demote_locked(keys)
+
+    # ---- promotion (warm/cold → hot) ---------------------------------------
+
+    def _account(self, keys) -> None:
+        """Classify each requested key against the tiers (hit / warm miss /
+        cold miss) and touch the hot ones' access clocks — the ONE
+        accounting point per request (update path here, read path through
+        the gateway's ``prepare_reads``); pure host routing work
+        (graftlint YFM008)."""
+        with self._lock:
+            for k in keys:
+                if k in self._slot:
+                    self.ledger.hits += 1
+                    self._touch_locked(k)
+                elif k in self.warm:
+                    self.ledger.misses_warm += 1
+                elif self.registry is not None and k in self.registry:
+                    self.ledger.misses_cold += 1
+
+    def _promote_plan(self, keys) -> Optional[dict]:
+        """Decide the promotion wave: which keys thaw, which resident keys
+        demote to make room, which overflow (more misses than demotable
+        slots) — pure host routing work, lock held by the caller; no device
+        transfer may happen here (graftlint YFM008)."""
+        want, seen = [], set()
+        for k in keys:
+            if k in seen or k in self._slot:
+                continue
+            seen.add(k)
+            if k in self.warm or (self.registry is not None
+                                  and k in self.registry):
+                want.append(k)
+        if not want:
+            return None
+        free_total = sum(len(f) for f in self._free)
+        shortfall = len(want) - free_total
+        victims: List[Key] = []
+        overflow: List[Key] = []
+        if shortfall > 0:
+            victims = self._demote_plan(shortfall, exclude=seen)
+            fit = free_total + len(victims)
+            want, overflow = want[:fit], want[fit:]
+        return {"want": want, "victims": victims, "overflow": overflow}
+
+    def ensure_resident(self, keys) -> Tuple[List[Key], List[Key]]:
+        """Make the warm/cold keys among ``keys`` hot in ONE batched wave
+        (demote-for-room → thaw → health watch → batched slot writes).
+        Returns ``(promoted, unpromoted)`` — an unpromoted key (stalled
+        wave, failed watch with no fallback, capacity starvation) stays
+        servable from its tier record; its updates degrade."""
+        with self._lock:
+            plan = self._promote_plan(keys)
+        if plan is None:
+            return [], []
+        with self.timer.stage("promote"):
+            with self._lock:
+                promoted, unpromoted = self._promote_flush_locked(plan)
+        return promoted, unpromoted + plan["overflow"]
+
+    def prepare_reads(self, keys) -> None:
+        """The gateway pump's read-side pre-promotion hook: account the
+        drained read keys and promote their misses in one wave (so a read
+        burst costs one dispatch per shard, exactly like an update burst)."""
+        self._account(keys)
+        self.ensure_resident(keys)
+
+    def _promote_flush_locked(self, plan) -> Tuple[List[Key], List[Key]]:
+        """Execute one promotion wave (store lock held): demote victims,
+        thaw the wanted records (warm first, cold fallback), run the §11
+        health watch over the whole wave in one batch, then write the
+        survivors through the batched slot-write program — one donated
+        dispatch per (shard, bucket-chunk)."""
+        if plan["victims"]:
+            self._demote_locked(plan["victims"])
+        want = plan["want"]
+        if chaos.should_inject("promote_stall"):
+            self.ledger.promote_stalls += len(want)
+            return [], list(want)
+        thawed, unpromoted = [], []
+        for key in want:
+            rec = self.warm.pop(key)
+            src = "warm"
+            if rec is None:
+                rec = self._cold_record(key)
+                src = "cold"
+            if rec is None:
+                unpromoted.append(key)
+                continue
+            thawed.append((key, rec, src))
+        if thawed:
+            betas = np.stack([r.beta for _, r, _ in thawed], axis=-1)
+            covs = np.stack([r.cov for _, r, _ in thawed], axis=-1)
+            codes = np.asarray(rh.state_health_batch(betas, covs,
+                                                     self.engine))
+        good = []
+        for j, (key, rec, src) in enumerate(thawed):
+            if int(codes[j]) != tax.OK:
+                fallback = self._cold_record(key) if src == "warm" else None
+                if fallback is not None and rh.state_health(
+                        fallback.beta, fallback.cov,
+                        self.engine)["code"] == tax.OK:
+                    rec = fallback
+                    self.rebuilds += 1
+                    self.ledger.corrupt_rebuilds += 1
+                else:
+                    # unpromotable: park the poisoned record back in the
+                    # warm tier, stale-flagged — visible, never silently
+                    # dropped; its requests degrade until an operator refit
+                    self._warm_put_with_spill(
+                        key, rec.params, rec.beta, rec.cov, rec.ver,
+                        rec.meta, stale=True, stamp=rec.stamp)
+                    unpromoted.append(key)
+                    continue
+            good.append((key, rec))
+        per_shard: Dict[int, list] = {}
+        for key, rec in good:
+            s = int(np.argmax([len(f) for f in self._free]))
+            sl = self._free[s].pop()
+            per_shard.setdefault(s, []).append(
+                (sl, rec.params, rec.beta, rec.cov, rec.ver))
+            self._slot[key] = (s, sl)
+            self._meta[key] = rec.meta
+            self._bank[key] = (np.asarray(rec.beta, dtype=np.float64),
+                               np.asarray(rec.cov, dtype=np.float64))
+            if rec.stale:
+                self._stale.add(key)
+            else:
+                self._stale.discard(key)
+            self._touch_locked(key)
+            self.ledger.promotions += 1
+        for s in sorted(per_shard):
+            self._write_state_many(s, per_shard[s])
+        return [k for k, _ in good], unpromoted
+
+    def _cold_record(self, key: Key) -> Optional[WarmRecord]:
+        """A cold-tier snapshot as a thawable record (engine re-factored —
+        the moment-exact leg of the hierarchy)."""
+        if self.registry is None or key not in self.registry:
+            return None
+        snap = self.registry.get(*key)
+        try:
+            cov = np.asarray(factor_cov(snap.P, self.engine,
+                                        self.spec.dtype))
+        except ValueError:
+            return None
+        params = snap.params if snap.params is not None \
+            else np.zeros(self.spec.n_params)
+        return WarmRecord(np.asarray(params), np.asarray(snap.beta), cov,
+                          snap.meta.version, snap.meta, False, 0)
+
+    # ---- the tier-aware request path ---------------------------------------
+
+    def update_batch(self, items, dates=None) -> List[dict]:
+        """The base shard-routed update path with miss handling in front:
+        account every request, promote the missed keys in one wave, answer
+        the unpromotable ones DEGRADED from their tier record (never an
+        error, never a blocked batch), and delegate the resident rest."""
+        keys = [k for k, _ in items]
+        self._account(keys)
+        _, unpromoted = self.ensure_resident(keys)
+        un = set(unpromoted)
+        if not un:
+            return super().update_batch(items, dates=dates)
+        res: List[Optional[dict]] = [None] * len(items)
+        sub, mapping = [], []
+        for pos, (key, y) in enumerate(items):
+            if key in un:
+                res[pos] = {"ll": float("nan"), "degraded": True,
+                            "stale": True,
+                            "version": self._tier_version(key),
+                            "reason": "promotion did not land this wave"}
+            else:
+                mapping.append(pos)
+                sub.append((key, y))
+        outs = super().update_batch(
+            sub, dates=[dates[p] for p in mapping] if dates is not None
+            else None)
+        for pos, out in zip(mapping, outs):
+            res[pos] = out
+        return res
+
+    # ---- tier-transparent reads --------------------------------------------
+
+    def snapshot_of(self, key: Key) -> ServingSnapshot:
+        """Hot keys serve device slices exactly as the base store (resolved
+        and sliced under ONE lock acquisition — a concurrent demotion wave
+        can't invalidate the slot between check and slice); warm and cold
+        keys serve their HOST record directly (no promotion, no device work
+        — reads are tier-transparent; the gateway pump batch-promotes read
+        keys via :meth:`prepare_reads` before it gets here).  The tier walk
+        re-runs once on a complete miss: a key mid-promotion is briefly in
+        neither table (warm.pop → slot write, store lock held throughout),
+        and the second walk's hot check blocks on that lock until the wave
+        lands."""
+        for _ in range(2):
+            with self._lock:
+                if key in self._slot:
+                    self._touch_locked(key)
+                    return self._snapshot_of_locked(key)
+            rec = self.warm.peek(key)
+            if rec is not None:
+                P = rec.cov @ rec.cov.T if self.engine == "sqrt" else rec.cov
+                return ServingSnapshot(self.spec, rec.params, rec.beta, P,
+                                       rec.meta)
+            if self.registry is not None and key in self.registry:
+                return self.registry.get(*key)
+        raise ServingError("store", f"no state registered for {key}")
+
+    def last_good_snapshot_of(self, key: Key) -> ServingSnapshot:
+        for _ in range(2):  # same mid-promotion re-walk as snapshot_of
+            with self._lock:
+                if key in self._bank:
+                    return self._last_good_locked(key)
+            rec = self.warm.peek(key)
+            if rec is not None:
+                P = rec.cov @ rec.cov.T if self.engine == "sqrt" else rec.cov
+                return ServingSnapshot(self.spec, None, rec.beta, P,
+                                       rec.meta)
+            if self.registry is not None and key in self.registry:
+                return self.registry.get(*key)
+        raise ServingError("store", f"no state registered for {key}")
+
+    # ---- warmup -------------------------------------------------------------
+
+    def warmup(self, horizons=None, batch_sizes=(1,),
+               scenario_counts=()) -> int:
+        """Base warmup plus both halves of a promotion/demotion wave, per
+        (shard, bucket): the batched slot-write programs via an all-padding
+        wave (an exact no-op — ``valid`` all false, every scatter drops),
+        staged through the same ``stage_slot_write_arrays`` recipe as the
+        live waves, and the demote-side gather executables via a slot-0
+        fetch at each bucket shape — a first live miss burst must not pay a
+        compile on the hot path."""
+        n = super().warmup(horizons=horizons, batch_sizes=batch_sizes,
+                           scenario_counts=scenario_counts)
+        with self.timer.stage("warmup"):
+            for bb in self.lattice.update_batch_sizes:
+                writer = _jitted_slot_write_many(
+                    self.spec, self.shard_capacity, bb, self._donate)
+                args = stage_slot_write_arrays(self.spec, bb)
+                idx = np.zeros(bb, dtype=np.int32)
+                for sh in self._shards:
+                    outs = writer(sh["params"], sh["beta"], sh["cov"],
+                                  sh["ver"], *args)
+                    sh["params"], sh["beta"], sh["cov"], sh["ver"] = outs
+                    jax.device_get((sh["params"][:, idx], sh["beta"][:, idx],
+                                    sh["cov"][:, :, idx], sh["ver"][idx]))
+                    n += 1
+        return n
+
+
+class StoreFleet:
+    """One gateway, MANY stores — the multi-model fleet seam
+    (docs/DESIGN.md §21): requests are routed to the store serving their
+    key's ``model_string``, and the fleet duck-types the full service
+    surface a :class:`~.gateway.ShardedGateway` reads (``counters`` /
+    ``timer`` / ``batcher`` / ``update_batch`` / ``snapshot_of`` / …), so
+    one pump, one bounded queue, one operator report serve a whole fleet of
+    model families on one mesh.  Reads from every member micro-batch
+    through ONE shared :class:`~.batcher.MicroBatcher` (it already groups
+    per spec).  The routing table is immutable after construction — the
+    fleet itself needs no lock; each member store keeps its own."""
+
+    def __init__(self, stores, timer: Optional[StageTimer] = None):
+        stores = list(stores)
+        if not stores:
+            raise ServingError("fleet", "a fleet needs at least one store")
+        self._stores: Dict[str, ShardedStateStore] = {}
+        for st in stores:
+            ms = st.spec.model_string
+            if ms in self._stores:
+                raise ServingError(
+                    "fleet", f"two stores serve model {ms!r} — one store "
+                    "per model_string", model=ms)
+            self._stores[ms] = st
+        self.timer = timer if timer is not None else StageTimer()
+        self.counters = RequestCounters()
+        self.batcher = MicroBatcher(stores[0].lattice)
+
+    # ---- routing -----------------------------------------------------------
+
+    def stores(self) -> dict:
+        return dict(self._stores)
+
+    def _route(self, key: Key) -> ShardedStateStore:
+        st = self._stores.get(key[0])
+        if st is None:
+            raise ServingError(
+                "fleet", f"no store serves model {key[0]!r}", key=key,
+                known=sorted(self._stores))
+        return st
+
+    def spec_for(self, key: Key):
+        return self._route(key).spec
+
+    def __contains__(self, key: Key) -> bool:
+        st = self._stores.get(key[0])
+        return st is not None and key in st
+
+    def __len__(self) -> int:
+        return sum(len(st) for st in self._stores.values())
+
+    def keys(self):
+        out = []
+        for st in self._stores.values():
+            out.extend(st.keys())
+        return sorted(out)
+
+    # ---- the service surface the gateway reads ------------------------------
+
+    def update_batch(self, items, dates=None) -> List[dict]:
+        """Partition the batch by owning store (pure host routing), delegate
+        each group in one call, merge the results back IN ORDER — an
+        unroutable key gets a structured error result, never fails its
+        batch."""
+        res: List[Optional[dict]] = [None] * len(items)
+        groups: Dict[str, list] = {}
+        for pos, (key, y) in enumerate(items):
+            if key[0] in self._stores:
+                groups.setdefault(key[0], []).append(pos)
+            else:
+                res[pos] = {"error": ServingError(
+                    "fleet", f"no store serves model {key[0]!r}", key=key)}
+        for ms in sorted(groups):
+            poss = groups[ms]
+            outs = self._stores[ms].update_batch(
+                [items[p] for p in poss],
+                dates=[dates[p] for p in poss] if dates is not None
+                else None)
+            for p, o in zip(poss, outs):
+                res[p] = o
+        return res
+
+    def prepare_reads(self, keys) -> None:
+        groups: Dict[str, list] = {}
+        for k in keys:
+            if k[0] in self._stores:
+                groups.setdefault(k[0], []).append(k)
+        for ms in sorted(groups):
+            prep = getattr(self._stores[ms], "prepare_reads", None)
+            if prep is not None:
+                prep(groups[ms])
+
+    def snapshot_of(self, key: Key) -> ServingSnapshot:
+        return self._route(key).snapshot_of(key)
+
+    def last_good_snapshot_of(self, key: Key) -> ServingSnapshot:
+        return self._route(key).last_good_snapshot_of(key)
+
+    def publish_refit(self, key: Key, params, history=None, beta=None,
+                      P=None) -> dict:
+        return self._route(key).publish_refit(key, params, history=history,
+                                              beta=beta, P=P)
+
+    # ---- observability / warmup --------------------------------------------
+
+    def health(self) -> dict:
+        members = {ms: st.health() for ms, st in self._stores.items()}
+        status = "stale" if any(h["status"] != "ok"
+                                for h in members.values()) else "ok"
+        return {"status": status, "models": sorted(self._stores),
+                "stores": members, "requests": self.counters.to_dict()}
+
+    def latency_summary(self) -> dict:
+        return {**self.timer.summary(), "counters": self.counters.to_dict(),
+                "stores": {ms: st.latency_summary()
+                           for ms, st in self._stores.items()}}
+
+    def warmup(self, horizons=None, batch_sizes=(1,),
+               scenario_counts=()) -> int:
+        """Warm every member store, then the FLEET batcher (the one the
+        gateway reads) with one snapshot per member."""
+        n = 0
+        for ms in sorted(self._stores):
+            st = self._stores[ms]
+            n += st.warmup(horizons=horizons, batch_sizes=batch_sizes,
+                           scenario_counts=scenario_counts)
+            keys = st.keys()
+            if keys:
+                n += self.batcher.warmup(st.snapshot_of(keys[0]),
+                                         horizons=horizons,
+                                         batch_sizes=batch_sizes,
+                                         scenario_counts=scenario_counts)
+        return n
