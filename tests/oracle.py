@@ -29,6 +29,24 @@ def dns_loadings(gamma_scalar, maturities):
     return Z
 
 
+def svensson_loadings(gamma2, maturities):
+    """4-factor Svensson loadings [1, slope(λ₁), curv(λ₁), curv(λ₂)] from the
+    constrained head (γ₁, g): λ₁ = floor + exp(γ₁) (the DNS driver
+    convention above), λ₂ = λ₁ + g with g > 0 — the independent twin of the
+    program library's jnp implementation (program/library.py)."""
+    lam1 = LAMBDA_FLOOR + np.exp(gamma2[0])
+    lam2 = lam1 + gamma2[1]
+    Z = np.ones((len(maturities), 4))
+    tau1 = lam1 * maturities
+    z1 = np.exp(-tau1)
+    Z[:, 1] = (1 - z1) / tau1
+    Z[:, 2] = Z[:, 1] - z1
+    tau2 = lam2 * maturities
+    z2 = np.exp(-tau2)
+    Z[:, 3] = (1 - z2) / tau2 - z2
+    return Z
+
+
 def mlp_curve(p9, maturities):
     w1, b1, w2 = p9[0:3], p9[3:6], p9[6:9]
     out = np.zeros(len(maturities))
@@ -656,6 +674,29 @@ def stable_1c_params(spec, dtype=np.float32):
     p[a:b] = [5.0, -1.0, 0.5]
     a, b = spec.layout["phi"]
     p[a:b] = np.diag([0.9, 0.9, 0.9]).reshape(-1)
+    return p
+
+
+def stable_svensson_params(spec, dtype=np.float64):
+    """A stationary, finite-loglik parameter point for the ``svensson4``
+    program spec (program/library.py) — λ₁ = 0.5, λ₂ − λ₁ = 0.25 (RAW head
+    slot ln 0.25: the block's R_TO_POS transform maps it to the gap), obs
+    var 4e-4, chol 0.05 I, Φ = 0.9 I, δ the 1C steady state plus a small
+    second-curvature factor.  Shared by the program-layer parity/e2e tests
+    (one copy, CLAUDE.md rule).  NOTE: constrained-space values — the gap
+    slot here is the POSITIVE gap itself, as the engines consume it."""
+    p = np.zeros(spec.n_params, dtype=dtype)
+    p[spec.layout["lambda1"][0]] = np.log(0.5 - LAMBDA_FLOOR)
+    p[spec.layout["lambda2_gap"][0]] = 0.25
+    p[spec.layout["obs_var"][0]] = 4e-4
+    a, _ = spec.layout["chol"]
+    rows, cols = spec.chol_indices
+    for k, (r, c) in enumerate(zip(rows, cols)):
+        p[a + k] = 0.05 if r == c else 0.0
+    a, b = spec.layout["delta"]
+    p[a:b] = [5.0, -1.0, 0.5, 0.2]
+    a, b = spec.layout["phi"]
+    p[a:b] = np.diag([0.9, 0.9, 0.9, 0.9]).reshape(-1)
     return p
 
 

@@ -1,0 +1,105 @@
+"""Direct unit tests of ``config.engines_for`` / ``config.tree_engine_for``
+— THE engine-applicability introspection seam (docs/DESIGN.md §19).
+
+Two layers: the capability-flag matrix on synthetic stub specs (every flag
+combination → its exact engine tuple, so the seam's contract is pinned
+independently of any family), and the real-spec rows including the
+program-compiled specs (program/), plus the ``api.get_loss`` validation
+errors that must name the valid set.
+"""
+
+import types
+
+import numpy as np
+import pytest
+
+from yieldfactormodels_jl_tpu import config
+
+MATS = tuple(np.array([3, 12, 24, 60, 120, 240, 360]) / 12.0)
+
+
+def _stub(is_kalman=False, constant=False, is_msed=False, score_tree=False):
+    """A synthetic spec carrying ONLY the capability flags engines_for
+    reads — proof the seam is property-driven, never family-string-driven."""
+    return types.SimpleNamespace(
+        is_kalman=is_kalman, has_constant_measurement=constant,
+        is_msed=is_msed, supports_score_tree=score_tree)
+
+
+@pytest.mark.parametrize("flags,want", [
+    # constant-Z Kalman: the full registry, assoc included
+    (dict(is_kalman=True, constant=True), config.KALMAN_ENGINES),
+    # state-dependent-Z Kalman: everything but assoc (slr is the tree)
+    (dict(is_kalman=True, constant=False),
+     tuple(e for e in config.KALMAN_ENGINES if e != "assoc")),
+    # plain-gradient score-driven: scan + score_tree
+    (dict(is_msed=True, score_tree=True), config.MSED_ENGINES),
+    # EWMA scale_grad lineage: sequential scan only
+    (dict(is_msed=True, score_tree=False),
+     tuple(e for e in config.MSED_ENGINES if e != "score_tree")),
+    # static families: no state recursion, no engine choice
+    (dict(), ()),
+])
+def test_engines_for_capability_matrix(flags, want):
+    assert config.engines_for(_stub(**flags)) == want
+
+
+@pytest.mark.parametrize("flags,want", [
+    (dict(is_kalman=True, constant=True), "assoc"),
+    (dict(is_kalman=True, constant=False), "slr"),
+    (dict(is_msed=True, score_tree=True), "score_tree"),
+    (dict(is_msed=True, score_tree=False), None),
+    (dict(), None),
+])
+def test_tree_engine_for_capability_matrix(flags, want):
+    assert config.tree_engine_for(_stub(**flags)) == want
+
+
+def test_engines_for_real_spec_rows():
+    """The matrix on real compiled specs — zoo families and both shipped
+    programs resolve through the same properties."""
+    import yieldfactormodels_jl_tpu as yfm
+
+    no_assoc = tuple(e for e in config.KALMAN_ENGINES if e != "assoc")
+    no_tree = tuple(e for e in config.MSED_ENGINES if e != "score_tree")
+    rows = {
+        "1C": config.KALMAN_ENGINES,
+        "AFNS3": config.KALMAN_ENGINES,
+        "TVλ": no_assoc,
+        "SD-NS": config.MSED_ENGINES,      # plain-gradient λ-MSED
+        "SSD-NS": no_tree,                 # scale_grad lineage
+        "NS": (),                          # static: closed-form regression
+        "prog-dns": config.KALMAN_ENGINES,
+        "svensson4": config.KALMAN_ENGINES,
+    }
+    for code, want in rows.items():
+        spec, _ = yfm.create_model(code, MATS, float_type="float64")
+        assert config.engines_for(spec) == want, code
+
+
+def test_get_loss_rejects_inapplicable_engine_naming_valid_set():
+    import yieldfactormodels_jl_tpu as yfm
+    from yieldfactormodels_jl_tpu.models import api
+
+    spec, _ = yfm.create_model("TVλ", MATS, float_type="float64")
+    p = np.zeros(spec.n_params)
+    data = np.zeros((len(MATS), 8))
+    with pytest.raises(ValueError, match="engines_for lists"):
+        api.get_loss(spec, p, data, engine="assoc")
+    with pytest.raises(ValueError, match="unknown kalman engine"):
+        api.get_loss(spec, p, data, engine="bogus")
+    static_spec, _ = yfm.create_model("NS", MATS, float_type="float64")
+    ps = np.zeros(static_spec.n_params)
+    with pytest.raises(ValueError, match="engines_for lists"):
+        api.get_loss(static_spec, ps, data, engine="assoc")
+
+
+def test_get_loss_rejects_score_tree_with_k_replay():
+    import yieldfactormodels_jl_tpu as yfm
+    from yieldfactormodels_jl_tpu.models import api
+
+    spec, _ = yfm.create_model("SD-NS", MATS, float_type="float64")
+    p = np.zeros(spec.n_params)
+    data = np.zeros((len(MATS), 8))
+    with pytest.raises(ValueError, match="K=1"):
+        api.get_loss(spec, p, data, K=2, engine="score_tree")

@@ -255,6 +255,53 @@ def test_runtime_census_fires_on_stale_manifest_key(monkeypatch):
     assert "manifest" in res.lint.findings[0].message
 
 
+def test_program_census_fires_on_missing_program_cases(monkeypatch):
+    """Full-audit census (only=None): a registered program whose
+    auto-generated `program:<name>` cases are missing from the manifest is
+    a YFM011 finding per audited builder — coverage drift, both shipped
+    programs reported."""
+    import yieldfactormodels_jl_tpu.program  # noqa: F401 — registers library
+
+    # register FIRST, then blank the manifest: the auto-generated cases land
+    # in the real MANIFEST, and the census sees programs with no cases
+    monkeypatch.setattr(ir_mod, "_import_package_modules",
+                        lambda config: [])
+    monkeypatch.setattr("yieldfactormodels_jl_tpu.config.engine_cache_entries",
+                        lambda: [])
+    monkeypatch.setattr("yieldfactormodels_jl_tpu.analysis.manifest.MANIFEST",
+                        {})
+    res = ir_mod.run_ir()
+    assert res.lint.findings and all(
+        f.rule == "YFM011" for f in res.lint.findings)
+    msgs = " ".join(f.message for f in res.lint.findings)
+    assert "prog-dns" in msgs and "svensson4" in msgs
+
+
+def test_program_census_fires_on_stale_program_label(monkeypatch):
+    """The reverse direction: a `program:<name>` manifest label naming no
+    registered program is a census finding, not silent dead coverage."""
+    key = "estimation.optimize._jitted_loss"
+    import yieldfactormodels_jl_tpu.estimation.optimize  # noqa: F401
+    import yieldfactormodels_jl_tpu.program  # noqa: F401 — library must be
+    # imported BEFORE _PROGRAMS is blanked, or the census's own import
+    # re-registers the shipped programs into the patched registry
+    from yieldfactormodels_jl_tpu import config as pkg_config
+
+    entries = dict(pkg_config.engine_cache_entries())
+    monkeypatch.setattr(ir_mod, "_import_package_modules",
+                        lambda config: [])
+    monkeypatch.setattr("yieldfactormodels_jl_tpu.config.engine_cache_entries",
+                        lambda: [(key, entries[key])])
+    monkeypatch.setattr(
+        "yieldfactormodels_jl_tpu.analysis.manifest.MANIFEST",
+        {key: [Case(key, "program:ghost", None, skip="census fixture")]})
+    monkeypatch.setattr("yieldfactormodels_jl_tpu.program.registry._PROGRAMS",
+                        {})
+    res = ir_mod.run_ir()
+    assert [f.rule for f in res.lint.findings] == ["YFM011"]
+    assert "program:ghost" in res.lint.findings[0].message
+
+
 # ---------------------------------------------------------------------------
 # the CI gate: full --ir run, zero unsuppressed findings
 # ---------------------------------------------------------------------------

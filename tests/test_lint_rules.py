@@ -496,6 +496,49 @@ def test_yfm007_quiet_when_slr_linearizations_oracle_covered(tmp_path):
     assert not res.findings
 
 
+def _program_tree(tmp_path, tests_body):
+    cfgpath = tmp_path / PKG / "config.py"
+    cfgpath.parent.mkdir(parents=True, exist_ok=True)
+    cfgpath.write_text('KALMAN_ENGINES = ("univariate",)\n')
+    lib = tmp_path / PKG / "program" / "library.py"
+    lib.parent.mkdir(parents=True, exist_ok=True)
+    lib.write_text(textwrap.dedent("""\
+        MY_PROGRAM = ModelProgram(
+            name="myprog",
+            kind="kalman",
+            factors=3,
+        )
+    """))
+    tdir = tmp_path / "tests"
+    tdir.mkdir(exist_ok=True)
+    (tdir / "test_parity.py").write_text(textwrap.dedent(tests_body))
+    (tmp_path / "CLAUDE.md").write_text("")
+    return LintConfig(root=str(tmp_path))
+
+
+def test_yfm007_fires_on_uncovered_program_name(tmp_path):
+    # a shipped ModelProgram declaration rides the engine-parity contract:
+    # its name absent from every oracle-backed test module must fire, and
+    # the finding anchors at the declaration site, not config.py
+    cfg = _program_tree(tmp_path, """\
+        from .oracle import kalman_filter_loglik
+        ENGINES = ("univariate",)  # 'myprog' has no oracle-backed mention
+    """)
+    res = run_lint(cfg, files=[], rules=["YFM007"])
+    assert [f.rule for f in res.findings] == ["YFM007"]
+    assert "'myprog'" in res.findings[0].message
+    assert res.findings[0].file == f"{PKG}/program/library.py"
+
+
+def test_yfm007_quiet_when_program_name_oracle_covered(tmp_path):
+    cfg = _program_tree(tmp_path, """\
+        from .oracle import kalman_filter_loglik
+        NAMES = ("univariate", "myprog")
+    """)
+    res = run_lint(cfg, files=[], rules=["YFM007"])
+    assert not res.findings
+
+
 # ---------------------------------------------------------------------------
 # YFM008 — request-path hygiene
 # ---------------------------------------------------------------------------

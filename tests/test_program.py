@@ -1,0 +1,393 @@
+"""Program layer (docs/DESIGN.md §22) acceptance tests.
+
+The two shipped proving cases, oracle-backed (CLAUDE.md rule — never
+JAX-vs-JAX alone):
+
+- ``prog-dns``: the hand-ported 1C family re-declared through the program
+  layer, pinned BIT-IDENTICAL (loss + grad + filter outputs) on every
+  engine ``config.engines_for`` grants — the correctness anchor that says
+  the program path IS the family path, not a parallel implementation.
+- ``svensson4``: a 4-factor Svensson model the zoo lacks, with its own
+  λ₂-gap transform block — engine-parity vs an independent NumPy oracle
+  (tests/oracle.py ``svensson_loadings``), estimated, T-switch
+  tree-dispatched, served and scenario-fanned end to end.
+
+Plus the registration state machine (collisions, replace, unregister,
+auto-generated manifest cases), the declaration validation errors, the
+state-dependent measurement lowering, and the registry's unknown-code
+error naming program codes (models/registry.valid_codes).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import yieldfactormodels_jl_tpu as yfm
+from tests import oracle
+from yieldfactormodels_jl_tpu import config
+from yieldfactormodels_jl_tpu.models import api
+from yieldfactormodels_jl_tpu.models.loadings import dns_loadings
+from yieldfactormodels_jl_tpu.models.registry import valid_codes
+from yieldfactormodels_jl_tpu.program import (ModelProgram, ParamBlock,
+                                              compile_program,
+                                              register_program,
+                                              unregister_program)
+from yieldfactormodels_jl_tpu.program.compile import ProgramSpec
+from yieldfactormodels_jl_tpu.utils import transformations as tr
+
+MATS = tuple(np.array([3, 12, 24, 60, 120, 240, 360]) / 12.0)
+
+#: literal twin of config.KALMAN_ENGINES, ON PURPOSE (the YFM007 coverage
+#: census greps oracle-backed test ASTs for these names); the sync test in
+#: test_assoc_estimation.py pins the registry side
+ALL_ENGINES = ("univariate", "sqrt", "joint", "assoc", "slr")
+
+
+def _linear_sd_measurement(beta, mats):
+    """A state-dependent declaration of a LINEAR measurement (fixed-λ DNS):
+    Z constant, y_pred = Zβ — so the EKF linearization is exact and the
+    oracle pins the state-dependent lowering path too."""
+    Z = dns_loadings(jnp.log(0.5), mats)
+    return Z, Z @ beta
+
+
+#: module-level (stable identity: programs key trace-time caches by hash)
+SD_LINEAR_PROGRAM = ModelProgram(
+    name="test-sd-linear", kind="kalman", factors=3,
+    measurement=_linear_sd_measurement,
+)
+
+
+def _dns_pair(rng, T=60):
+    spec1c, _ = yfm.create_model("1C", MATS, float_type="float64")
+    specp, code = yfm.create_model("prog-dns", MATS, float_type="float64")
+    assert code == "prog-dns" and isinstance(specp, ProgramSpec)
+    p = oracle.stable_1c_params(spec1c, np.float64)
+    data = 0.4 * rng.standard_normal((len(MATS), T)) + 4.0
+    data[:, 25:28] = np.nan  # interior gap: mask parity rides along
+    return spec1c, specp, jnp.asarray(p), jnp.asarray(data)
+
+
+def _svensson_case(rng, T=80):
+    spec, code = yfm.create_model("svensson4", MATS, float_type="float64")
+    assert code == "svensson4" and spec.state_dim == 4
+    p = oracle.stable_svensson_params(spec)
+    data = 0.4 * rng.standard_normal((len(MATS), T)) + 4.0
+    return spec, p, data
+
+
+def _oracle_state_pieces(spec, p):
+    """(Phi, delta, Omega_state, obs_var) from the flat vector, layout-driven
+    (works for any Kalman-kind program spec)."""
+    Ms = spec.state_dim
+    C = np.zeros((Ms, Ms))
+    rows, cols = spec.chol_indices
+    a, _ = spec.layout["chol"]
+    for k, (r, c) in enumerate(zip(rows, cols)):
+        C[r, c] = p[a + k]
+    lo, hi = spec.layout["delta"]
+    delta = np.asarray(p[lo:hi], dtype=np.float64)
+    lo, hi = spec.layout["phi"]
+    Phi = np.asarray(p[lo:hi], dtype=np.float64).reshape(Ms, Ms)
+    return Phi, delta, C @ C.T, float(p[spec.layout["obs_var"][0]])
+
+
+# ---------------------------------------------------------------------------
+# prog-dns — the bit-identity anchor
+# ---------------------------------------------------------------------------
+
+def test_prog_dns_compiles_to_the_family_layout(rng):
+    spec1c, specp, _, _ = _dns_pair(rng)
+    assert specp.layout == spec1c.layout
+    assert specp.transform_codes == spec1c.transform_codes
+    assert specp.n_params == spec1c.n_params == 20
+    assert config.engines_for(specp) == config.engines_for(spec1c) \
+        == config.KALMAN_ENGINES
+    assert config.tree_engine_for(specp) == "assoc"
+
+
+@pytest.mark.parametrize("engine",
+                         ["univariate", "sqrt", "joint", "assoc", "slr"])
+def test_prog_dns_bit_identical_loss_and_grad(engine, rng):
+    """The tentpole pin: the compiled program flows through the SAME kernels
+    as the hand-ported family — loss and gradient EXACTLY equal (==, not
+    allclose) on every granted engine."""
+    spec1c, specp, p, data = _dns_pair(rng)
+    l1 = api.get_loss(spec1c, p, data, engine=engine)
+    l2 = api.get_loss(specp, p, data, engine=engine)
+    assert float(l1) == float(l2), engine
+    g1 = jax.grad(lambda q: api.get_loss(spec1c, q, data, engine=engine))(p)
+    g2 = jax.grad(lambda q: api.get_loss(specp, q, data, engine=engine))(p)
+    assert bool(jnp.all(g1 == g2)), engine
+
+
+def test_prog_dns_oracle_parity_and_filter_outputs(rng):
+    """Not only family-vs-program: the program is also pinned against the
+    independent NumPy loop directly, and the predict artifact set (filtered
+    factors + predictions) is bit-identical to the family's."""
+    spec1c, specp, p, data = _dns_pair(rng)
+    pn = np.asarray(p)
+    Z = oracle.dns_loadings(float(pn[spec1c.layout["gamma"][0]]),
+                            np.asarray(MATS))
+    Phi, delta, Om, ov = _oracle_state_pieces(spec1c, pn)
+    want = oracle.kalman_filter_loglik(Z, Phi, delta, Om, ov,
+                                       np.asarray(data))
+    got = float(api.get_loss(specp, p, data))
+    np.testing.assert_allclose(got, want, rtol=1e-8)
+    out1 = api.predict(spec1c, p, data)
+    out2 = api.predict(specp, p, data)
+    for k in out1:
+        assert bool(jnp.all(out1[k] == out2[k])), k
+
+
+# ---------------------------------------------------------------------------
+# svensson4 — the new-model proving case
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("engine",
+                         ["univariate", "sqrt", "joint", "assoc", "slr"])
+def test_svensson_engine_oracle_parity(engine, rng):
+    spec, p, data = _svensson_case(rng)
+    data[:, 30:33] = np.nan
+    lo, hi = spec.layout["gamma"]  # the concatenated (λ₁ driver, gap) head
+    Z = oracle.svensson_loadings(np.asarray(p[lo:hi]), np.asarray(MATS))
+    Phi, delta, Om, ov = _oracle_state_pieces(spec, p)
+    want = oracle.kalman_filter_loglik(Z, Phi, delta, Om, ov, data)
+    got = float(api.get_loss(spec, jnp.asarray(p), jnp.asarray(data),
+                             engine=engine))
+    np.testing.assert_allclose(got, want, rtol=1e-8, err_msg=engine)
+
+
+def test_svensson_transform_table_enforces_gap(rng):
+    """The block transform table is real: the λ₂-gap slot carries R_TO_POS,
+    so ANY unconstrained value maps to a strictly positive gap (λ₂ > λ₁ by
+    construction), and untransform∘transform is the identity."""
+    spec, p, _ = _svensson_case(rng)
+    gap_slot = spec.layout["lambda2_gap"][0]
+    assert spec.transform_codes[gap_slot] == tr.R_TO_POS
+    raw = yfm.untransform_params(spec, jnp.asarray(p))
+    back = yfm.transform_params(spec, raw)
+    np.testing.assert_allclose(np.asarray(back), p, rtol=1e-12)
+    neg = raw.at[gap_slot].set(-7.0)  # deeply negative unconstrained slot
+    assert float(yfm.transform_params(spec, neg)[gap_slot]) > 0.0
+
+
+@pytest.mark.slow
+def test_svensson_estimate_end_to_end(rng):
+    """Multi-start MLE on simulated svensson4 data recovers a loglik at
+    least as good as the truth's (the estimator's own acceptance bar)."""
+    spec, p_true, _ = _svensson_case(rng)
+    sim = api.simulate(spec, jnp.asarray(p_true), 120, jax.random.PRNGKey(0))
+    data = np.asarray(sim["data"])
+    ll_true = float(api.get_loss(spec, jnp.asarray(p_true),
+                                 jnp.asarray(data)))
+    starts = np.stack([p_true,
+                       p_true + 0.05 * rng.standard_normal(spec.n_params)])
+    _, ll, best, conv = yfm.estimate(spec, data, starts.T,
+                                     max_iters=60, g_tol=1e-5)
+    assert np.isfinite(float(ll)) and float(ll) >= ll_true - 1e-3
+    assert np.asarray(best).shape == (spec.n_params,)
+
+
+def test_svensson_t_switch_tree_dispatch(rng):
+    """YFM_LOGLIK_T_SWITCH upgrades the svensson4 production default onto
+    its O(log T) tree ('assoc': the program is constant-Z) — same policy
+    seam as the zoo families, same numbers as the sequential default."""
+    spec, p, data = _svensson_case(rng, T=96)
+    assert config.tree_engine_for(spec) == "assoc"
+    pj, dj = jnp.asarray(p), jnp.asarray(data)
+    seq = float(api.get_loss(spec, pj, dj, engine="univariate"))
+    config.set_loglik_t_switch(50)
+    try:
+        auto = float(api.get_loss(spec, pj, dj))
+    finally:
+        config.set_loglik_t_switch(0)
+    np.testing.assert_allclose(auto, seq, rtol=1e-9)
+
+
+@pytest.mark.slow
+def test_svensson_serving_scenario_end_to_end(rng):
+    """freeze → update → refilter → forecast → scenarios → stress_fan, all
+    on the compiled program spec — serving and the scenario lattice consume
+    it unchanged."""
+    spec, p, data = _svensson_case(rng, T=80)
+    snap = yfm.freeze_snapshot(spec, jnp.asarray(p), jnp.asarray(data),
+                               end=70)
+    svc = yfm.YieldCurveService(snap)
+    for t in range(70, 74):
+        ll = svc.update(t, data[:, t])
+        assert np.isfinite(ll)
+    ll_re = svc.refilter(data[:, :74])
+    assert np.isfinite(ll_re)
+    fc = svc.forecast(h=6)
+    assert fc["means"].shape == (6, len(MATS))
+    assert np.all(np.isfinite(fc["means"]))
+    sc = svc.scenarios(n=8, h=6)
+    assert sc["paths"].shape == (len(MATS), 6, 8)
+    assert np.all(np.isfinite(sc["paths"]))
+    fan = svc.stress_fan(h=6)
+    assert np.all(np.isfinite(np.asarray(fan["means"])))
+
+
+# ---------------------------------------------------------------------------
+# state-dependent measurement lowering
+# ---------------------------------------------------------------------------
+
+def test_state_dependent_program_engines_and_oracle_parity(rng):
+    """A measurement= declaration drops 'assoc' (no constant Z) but keeps
+    the sequential engines and the SLR tree; declaring a LINEAR measurement
+    makes the EKF linearization exact, so the NumPy oracle pins the whole
+    state-dependent path."""
+    spec = compile_program(SD_LINEAR_PROGRAM, MATS, float_type="float64")
+    assert spec.has_constant_measurement is False
+    assert config.engines_for(spec) == tuple(
+        e for e in config.KALMAN_ENGINES if e != "assoc")
+    assert config.tree_engine_for(spec) == "slr"
+    assert "gamma" not in spec.layout  # no head blocks declared
+    p = oracle.generic_stable_params(spec, rng)
+    data = 0.1 * rng.standard_normal((len(MATS), 50)) + 0.3
+    Z = oracle.dns_loadings(np.log(0.5), np.asarray(MATS))
+    Phi, delta, Om, ov = _oracle_state_pieces(spec, p)
+    want = oracle.kalman_filter_loglik(Z, Phi, delta, Om, ov, data)
+    for engine in ("univariate", "slr"):
+        got = float(api.get_loss(spec, jnp.asarray(p), jnp.asarray(data),
+                                 engine=engine))
+        np.testing.assert_allclose(got, want, rtol=1e-8, err_msg=engine)
+    with pytest.raises(ValueError, match="not applicable"):
+        api.get_loss(spec, jnp.asarray(p), jnp.asarray(data),
+                     engine="assoc")
+
+
+def test_state_dependent_program_rejects_ukf_rule(rng):
+    """The sigma-point linearization rule is TVλ-specific; a state-dependent
+    program gets the generic EKF rule and a loud error on 'ukf'."""
+    from yieldfactormodels_jl_tpu.ops import slr_scan
+
+    spec = compile_program(SD_LINEAR_PROGRAM, MATS, float_type="float64")
+    p = oracle.generic_stable_params(spec, rng)
+    data = 0.1 * rng.standard_normal((len(MATS), 40)) + 0.3
+    with pytest.raises(ValueError, match="TVλ-specific"):
+        slr_scan.get_loss(spec, jnp.asarray(p), jnp.asarray(data),
+                          linearization="ukf")
+
+
+def test_state_dependent_program_loadings_error():
+    spec = compile_program(SD_LINEAR_PROGRAM, MATS, float_type="float64")
+    with pytest.raises(ValueError, match="state-dependent"):
+        api.update_factor_loadings(spec, jnp.zeros(1))
+
+
+# ---------------------------------------------------------------------------
+# registration state machine + registry integration (satellite: the
+# unknown-code error names program codes)
+# ---------------------------------------------------------------------------
+
+def test_unknown_code_error_names_program_codes():
+    codes = valid_codes()
+    assert "prog-dns" in codes and "svensson4" in codes and "1C" in codes
+    with pytest.raises(ValueError) as ei:
+        yfm.create_model("no-such-model", MATS)
+    msg = str(ei.value)
+    assert "no-such-model" in msg
+    assert "svensson4" in msg and "prog-dns" in msg and "1C" in msg
+
+
+def test_register_program_state_machine():
+    from yieldfactormodels_jl_tpu.analysis import manifest as mf
+    from yieldfactormodels_jl_tpu.program.registry import (_AUDIT_BUILDERS,
+                                                           lookup)
+
+    prog = ModelProgram(
+        name="test-reg-prog", kind="kalman", factors=3,
+        blocks=(ParamBlock("gamma", 1, (tr.IDENTITY,)),),
+        loadings=dns_loadings)
+    register_program(prog)
+    try:
+        register_program(prog)  # same object: idempotent no-op
+        assert lookup("test-reg-prog") is prog
+        # the auto-generated tier-2 cases landed on every audited builder
+        for key in _AUDIT_BUILDERS:
+            labels = [c.label for c in mf.MANIFEST.get(key, [])]
+            assert "program:test-reg-prog" in labels, key
+        spec, code = yfm.create_model("test-reg-prog", MATS,
+                                      float_type="float64")
+        assert code == "test-reg-prog" and spec.program is prog
+        clone = ModelProgram(
+            name="test-reg-prog", kind="kalman", factors=3,
+            blocks=(ParamBlock("gamma", 1, (tr.IDENTITY,)),),
+            loadings=dns_loadings)
+        with pytest.raises(ValueError, match="already registered"):
+            register_program(clone)
+        register_program(clone, replace=True)
+        assert lookup("test-reg-prog") is clone
+    finally:
+        unregister_program("test-reg-prog")
+    assert lookup("test-reg-prog") is None
+    for key in _AUDIT_BUILDERS:  # cases dropped with the program
+        labels = [c.label for c in mf.MANIFEST.get(key, [])]
+        assert "program:test-reg-prog" not in labels, key
+    with pytest.raises(ValueError, match="valid codes"):
+        yfm.create_model("test-reg-prog", MATS)
+
+
+def test_register_program_rejects_zoo_collision():
+    prog = ModelProgram(
+        name="1C", kind="kalman", factors=3,
+        blocks=(ParamBlock("gamma", 1, (tr.IDENTITY,)),),
+        loadings=dns_loadings)
+    with pytest.raises(ValueError, match="collides with a built-in"):
+        register_program(prog)
+
+
+# ---------------------------------------------------------------------------
+# declaration validation
+# ---------------------------------------------------------------------------
+
+def test_param_block_validation_errors():
+    with pytest.raises(ValueError, match="identifier"):
+        ParamBlock("not a name", 1, (tr.IDENTITY,))
+    with pytest.raises(ValueError, match="reserved"):
+        ParamBlock("obs_var", 1, (tr.IDENTITY,))
+    with pytest.raises(ValueError, match="one code per slot"):
+        ParamBlock("head", 2, (tr.IDENTITY,))
+    with pytest.raises(ValueError, match="unknown transform code"):
+        ParamBlock("head", 1, (999,))
+
+
+def test_model_program_validation_errors():
+    with pytest.raises(ValueError, match="EXACTLY ONE measurement"):
+        ModelProgram(name="p", kind="kalman", factors=3)
+    with pytest.raises(ValueError, match="EXACTLY ONE measurement"):
+        ModelProgram(name="p", kind="kalman", factors=3,
+                     loadings=dns_loadings,
+                     measurement=_linear_sd_measurement)
+    with pytest.raises(ValueError, match="head parameter blocks"):
+        ModelProgram(name="p", kind="kalman", factors=3,
+                     measurement=_linear_sd_measurement,
+                     blocks=(ParamBlock("g", 1, (tr.IDENTITY,)),))
+    with pytest.raises(ValueError, match="unknown program kind"):
+        ModelProgram(name="p", kind="arma", factors=3,
+                     loadings=dns_loadings)
+    with pytest.raises(ValueError, match="state must carry"):
+        ModelProgram(name="p", kind="kalman", factors=3, state_dim=2,
+                     measurement=_linear_sd_measurement)
+    with pytest.raises(ValueError, match="loadings= only"):
+        ModelProgram(name="p", kind="msed", factors=3,
+                     measurement=_linear_sd_measurement)
+    with pytest.raises(ValueError, match="program name"):
+        ModelProgram(name="bad name!", kind="kalman", factors=3,
+                     loadings=dns_loadings)
+
+
+def test_msed_program_capability_flags():
+    plain = ModelProgram(name="m1", kind="msed", factors=3,
+                         loadings=dns_loadings)
+    scaled = ModelProgram(name="m2", kind="msed", factors=3,
+                          loadings=dns_loadings, scale_grad=True)
+    assert plain.supports_score_tree and not scaled.supports_score_tree
+    sp, ss = (compile_program(q, MATS, float_type="float64")
+              for q in (plain, scaled))
+    assert sp.is_msed and config.engines_for(sp) == config.MSED_ENGINES
+    assert config.engines_for(ss) == tuple(
+        e for e in config.MSED_ENGINES if e != "score_tree")

@@ -57,6 +57,9 @@ _LAZY["save_results"] = ".persistence.io"
 _LAZY.update({name: ".serving" for name in (
     "YieldCurveService", "ServingSnapshot", "SnapshotRegistry",
     "freeze_snapshot", "load_snapshot")})
+_LAZY.update({name: ".program" for name in (
+    "ModelProgram", "ParamBlock", "ProgramSpec", "compile_program",
+    "register_program", "unregister_program", "registered_programs")})
 # "model_api" (the module itself, not an attribute of it) is special-cased
 # in __getattr__ below and deliberately absent from this table
 
@@ -64,8 +67,8 @@ _LAZY.update({name: ".serving" for name in (
 #: explicit submodule import at the call site
 _SUBMODULES = frozenset({
     "analysis", "config", "estimation", "forecasting", "models", "ops",
-    "orchestration", "parallel", "persistence", "robustness", "run",
-    "serving", "utils",
+    "orchestration", "parallel", "persistence", "program", "robustness",
+    "run", "serving", "utils",
 })
 
 __all__ = sorted(set(_LAZY) | {"model_api"})

@@ -394,6 +394,37 @@ def run_ir(config: Optional[LintConfig] = None,
             for rule, msg in problems:
                 add(rule, rel, line, f"{key}: {msg}")
 
+    if only is None:
+        # program ↔ manifest census (DESIGN §22): every registered program
+        # must carry its auto-generated `program:<name>` case on every
+        # audited builder, and every program-labeled case must name a
+        # registered program — registration drift is a census finding in
+        # both directions, not a silent coverage hole
+        from ..program.registry import _AUDIT_BUILDERS, registered_programs
+
+        prog_names = {p.name for p in registered_programs()}
+        labeled: Dict[str, set] = {}
+        for key, cases in mf.MANIFEST.items():
+            for case in cases:
+                if case.label.startswith("program:"):
+                    labeled.setdefault(
+                        case.label[len("program:"):], set()).add(key)
+        for name in sorted(prog_names):
+            for key in _AUDIT_BUILDERS:
+                if key not in labeled.get(name, set()):
+                    add("YFM011", manifest_rel, 1,
+                        f"registered program {name!r} has no "
+                        f"'program:{name}' case on builder {key} — "
+                        f"register_program auto-generates these; "
+                        f"re-register or repair the manifest "
+                        f"(runtime census)")
+        for name in sorted(set(labeled) - prog_names):
+            add("YFM011", manifest_rel, 1,
+                f"manifest case label 'program:{name}' on builders "
+                f"{sorted(labeled[name])} names no registered program — "
+                f"unregister_program drops its cases; prune the stale "
+                f"label (runtime census)")
+
     # partition: pragmas (on the builder's source lines) > baseline > action
     mods: Dict[str, Optional[SourceModule]] = {}
 

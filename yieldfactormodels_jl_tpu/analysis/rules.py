@@ -491,13 +491,44 @@ def oracle_backed_test_strings(config: LintConfig):
     return out
 
 
+def declared_program_names(config: LintConfig):
+    """``name → (rel, lineno)`` of every ``ModelProgram(name="...")``
+    literal declaration in the package — the program layer's analogue of
+    the engine registries: a shipped declarative model carries the same
+    oracle-parity contract as a hand-ported family.  Scanned from disk
+    like :func:`kalman_engines_static` (the coverage contract is
+    project-global, independent of the linted subset); declarations in
+    tests/fixtures don't count — only the package ships programs."""
+    out: dict = {}
+    pkg = config.abspath(config.package)
+    if not os.path.isdir(pkg):
+        return out
+    for path in iter_py_files(pkg):
+        rel = os.path.relpath(path, config.root).replace(os.sep, "/")
+        with open(path, encoding="utf-8") as fh:
+            try:
+                tree = ast.parse(fh.read(), filename=path)
+            except SyntaxError:
+                continue
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and dotted_name(
+                    node.func).split(".")[-1] == "ModelProgram"):
+                continue
+            for kw in node.keywords:
+                if kw.arg == "name" and isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, str):
+                    out.setdefault(kw.value.value, (rel, node.lineno))
+    return out
+
+
 @rule("YFM007", "engine-oracle-parity",
-      "every config.KALMAN_ENGINES entry must be named in an "
-      "oracle-importing test module — no engine ships without parity",
-      scope="project")
+      "every config.KALMAN_ENGINES entry and every declared ModelProgram "
+      "name must be named in an oracle-importing test module — no engine "
+      "or shipped program without parity", scope="project")
 def yfm007_engine_parity(modules, config: LintConfig) -> Iterable[Finding]:
     engines, lineno = kalman_engines_static(config)
-    if not engines:
+    programs = declared_program_names(config)
+    if not engines and not programs:
         return
     strings = oracle_backed_test_strings(config)
     for engine in engines:
@@ -508,6 +539,13 @@ def yfm007_engine_parity(modules, config: LintConfig) -> Iterable[Finding]:
                 f"add a parity test against tests/oracle.py that names it "
                 f"(see test_assoc_estimation.test_engine_oracle_parity_"
                 f"with_nan_gap)")
+    for name, (rel, prog_lineno) in sorted(programs.items()):
+        if not any(name in ss for ss in strings.values()):
+            yield Finding(
+                "YFM007", rel, prog_lineno, 0,
+                f"program {name!r} has no oracle-backed parity coverage — "
+                f"a shipped ModelProgram needs a parity test against "
+                f"tests/oracle.py that names it (see tests/test_program.py)")
 
 
 # ---------------------------------------------------------------------------

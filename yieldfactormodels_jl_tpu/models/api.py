@@ -191,6 +191,13 @@ def init_state(spec: ModelSpec, params):
 def update_factor_loadings(spec: ModelSpec, gamma):
     """Z(γ) for any family (reference: per-family update_factor_loadings!)."""
     if spec.is_kalman:
+        prog = getattr(spec, "program", None)
+        if prog is not None:
+            if prog.measurement is not None:
+                raise ValueError(
+                    f"program {prog.name!r} loadings are state-dependent; "
+                    f"see kalman.state_measurement")
+            return prog.loadings(gamma, spec.maturities_array)
         if spec.family == "kalman_tvl":
             # TVλ builds Z from the 4th state at filter time
             raise ValueError("kalman_tvl loadings are state-dependent; see kalman._tvl_measurement")

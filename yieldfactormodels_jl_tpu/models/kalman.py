@@ -95,8 +95,9 @@ def _step(spec: ModelSpec, kp: KalmanParams, Z_const, d_const, state: KalmanStat
     dtype = P.dtype
     maturities = spec.maturities_array
 
-    if spec.family == "kalman_tvl":
-        Z, y_pred = _tvl_measurement(spec, beta, maturities)
+    mfn = state_measurement(spec)
+    if mfn is not None:
+        Z, y_pred = mfn(beta, maturities)
     else:
         Z = Z_const
         y_pred = Z @ beta
@@ -157,10 +158,22 @@ def _step(spec: ModelSpec, kp: KalmanParams, Z_const, d_const, state: KalmanStat
 
 def measurement_setup(spec: ModelSpec, kp: KalmanParams, dtype):
     """(Z_const, d_const) for the constant-measurement families; (None, None)
-    for TVλ whose Z is state-dependent.  Shared by the joint-form filter here,
-    the univariate kernel (ops/univariate_kf.py) and the associative-scan
-    filter so the likelihood kernels can never diverge on loadings setup."""
+    when Z is state-dependent (TVλ, state-dependent programs).  Shared by the
+    joint-form filter here, the univariate kernel (ops/univariate_kf.py) and
+    the associative-scan filter so the likelihood kernels can never diverge
+    on loadings setup.  Program-declared models (program/, docs/DESIGN.md
+    §22) plug in HERE: their loadings/intercept callables feed the same
+    kernels as the hand-ported families."""
     mats = spec.maturities_array
+    prog = getattr(spec, "program", None)
+    if prog is not None:
+        if prog.measurement is not None:
+            return None, None
+        Z = prog.loadings(kp.gamma, mats).astype(dtype)
+        if prog.intercept is None:
+            return Z, None
+        d = prog.intercept(kp.gamma, kp.Omega_state, mats)
+        return Z, d.astype(dtype)
     if spec.family == "kalman_dns":
         return dns_loadings(kp.gamma, mats).astype(dtype), None
     if spec.family == "kalman_afns":
@@ -168,6 +181,26 @@ def measurement_setup(spec: ModelSpec, kp: KalmanParams, dtype):
         d = yield_adjustment(kp.gamma, kp.Omega_state, mats, spec.M)
         return Z, d.astype(dtype)
     return None, None
+
+
+def state_measurement(spec: ModelSpec):
+    """The state-dependent measurement callable ``(beta, maturities) ->
+    (Z, y_pred)`` for specs whose Z depends on the state — TVλ's
+    EKF-Jacobian form (:func:`_tvl_measurement`) or a program-declared
+    ``measurement`` — and ``None`` for the constant-measurement families.
+
+    THE trace-time dispatch seam replacing the scattered
+    ``spec.family == "kalman_tvl"`` string checks: the joint/univariate/
+    sqrt/SLR kernels, the forecast scan, the simulator and the serving
+    online filter all consult this one function, so a state-dependent
+    program rides the full TVλ machinery with no per-kernel wiring
+    (docs/DESIGN.md §22)."""
+    prog = getattr(spec, "program", None)
+    if prog is not None:
+        return prog.measurement
+    if spec.family == "kalman_tvl":
+        return lambda beta, mats: _tvl_measurement(spec, beta, mats)
+    return None
 
 
 def loglik_contrib_mask(start, end, T):
@@ -273,7 +306,7 @@ def predict(spec: ModelSpec, params, data):
     factors = outs["beta_after"][1:].T
     fl1 = outs["Z2"][1:].T
     fl2 = outs["Z3"][1:].T
-    if spec.family in ("kalman_dns", "kalman_afns"):
+    if kp.gamma is not None:  # layout-driven: any spec with a γ head block
         states = jnp.broadcast_to(kp.gamma, (T, kp.gamma.shape[-1])).T
     else:
         # TVλ never writes its γ buffer (set_params! at kalman/paramoperations.jl:61-68)

@@ -109,8 +109,10 @@ def unpack_kalman(spec: ModelSpec, params) -> KalmanParams:
     """kalman/paramoperations.jl:6-58: Ω_obs = σ²I; Ω_state = CᵀC with C the
     upper-triangular factor filled column-by-column; Φ filled row-major."""
     Ms = spec.state_dim
+    # layout-driven, not family-listed: program-compiled specs (program/)
+    # carry a γ head exactly when their block table declares one
     gamma = (spec.slice(params, "gamma")
-             if spec.family in ("kalman_dns", "kalman_afns") else None)
+             if "gamma" in spec.layout else None)
     obs_var = spec.slice(params, "obs_var")[..., 0]
     chol_flat = spec.slice(params, "chol")
     rows, cols = spec.chol_indices

@@ -87,6 +87,17 @@ _TABLE = _build_table()
 MODEL_CODES = sorted({canon for canon, _ in _TABLE.values()})
 
 
+def valid_codes() -> Tuple[str, ...]:
+    """Every code :func:`create_model` accepts right now: the zoo's canonical
+    codes plus the registered program codes (program/registry.py) — the list
+    the unknown-code ``ValueError`` names."""
+    try:
+        from ..program.registry import registered_codes
+    except ImportError:  # program layer absent/partial: zoo codes only
+        return tuple(MODEL_CODES)
+    return tuple(sorted({*MODEL_CODES, *registered_codes()}))
+
+
 def create_model(
     model_type: str,
     maturities,
@@ -95,9 +106,26 @@ def create_model(
     float_type="float32",
     results_location: str = "results/",
 ) -> Tuple[Optional[ModelSpec], str]:
-    """model_dictionary.jl:7 equivalent.  Returns (spec | None, canonical code)."""
+    """model_dictionary.jl:7 equivalent.  Returns (spec | None, canonical code).
+
+    Program codes (``program.register_program``) resolve here too — the
+    compiled :class:`~..program.compile.ProgramSpec` comes back through the
+    same factory seam as the hand-ported zoo (``M`` is ignored for programs;
+    the declaration owns its factor count)."""
     if model_type not in _TABLE:
-        raise ValueError(f"Invalid model type: {model_type}")
+        # registered declarative programs share the factory seam; import
+        # through the package so the shipped library registers first
+        from .. import program as _program
+
+        prog = _program.lookup(model_type)
+        if prog is not None:
+            spec = _program.build_spec(
+                prog, maturities, N=N, float_type=float_type,
+                results_location=results_location)
+            return spec, prog.name
+        raise ValueError(
+            f"Invalid model type: {model_type!r}; valid codes (aliases "
+            f"omitted): {valid_codes()}")
     canon, kw = _TABLE[model_type]
     if kw is None:  # pC / vanillaNN placeholders (model_dictionary.jl:114-119)
         return None, canon

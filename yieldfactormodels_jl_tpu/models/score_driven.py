@@ -48,6 +48,11 @@ class MSEDState(NamedTuple):
 
 def loadings_fn(spec: ModelSpec, gamma):
     mats = spec.maturities_array
+    prog = getattr(spec, "program", None)
+    if prog is not None:
+        # program-declared msed loadings; the score is AD through the
+        # user callable (the same jax.grad path as the zoo families)
+        return prog.loadings(gamma, mats)
     if spec.family == "msed_lambda":
         return dns_loadings(gamma, mats)
     return neural_loadings(gamma, mats, spec.transform_bool)

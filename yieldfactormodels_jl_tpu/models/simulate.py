@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .kalman import _tvl_measurement, init_state, measurement_setup
+from .kalman import init_state, measurement_setup, state_measurement
 from .params import unpack_kalman
 from .specs import ModelSpec
 
@@ -54,6 +54,7 @@ def simulate(spec: ModelSpec, params, T: int, key,
     Ms, N = spec.state_dim, spec.N
     mats = spec.maturities_array
     Z_const, d_const = measurement_setup(spec, kp, dtype)
+    mfn = state_measurement(spec)
     if Z_const is not None and d_const is None:
         d_const = jnp.zeros((N,), dtype=dtype)
 
@@ -79,8 +80,8 @@ def simulate(spec: ModelSpec, params, T: int, key,
         beta = kp.delta + kp.Phi @ beta \
             + C @ jax.random.normal(k_eta, (Ms,), dtype=dtype)
         h = sv_phi * h + sv_sigma * jax.random.normal(k_xi, (), dtype=dtype)
-        if spec.family == "kalman_tvl":
-            _, y_mean = _tvl_measurement(spec, beta, mats)
+        if mfn is not None:
+            _, y_mean = mfn(beta, mats)
         else:
             y_mean = Z_const @ beta + d_const
         y = y_mean + sig * jnp.exp(0.5 * h) \

@@ -22,7 +22,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..models import kalman as K
-from ..models.kalman import _tvl_measurement
+from ..models.kalman import state_measurement
 from ..models.specs import ModelSpec
 
 
@@ -36,6 +36,7 @@ def density_from_state(spec: ModelSpec, kp, beta, P, horizon: int):
     dtype = kp.Phi.dtype
     mats = spec.maturities_array
     Z_const, d_const = K.measurement_setup(spec, kp, dtype)
+    mfn = state_measurement(spec)
     if Z_const is not None and d_const is None:
         d_const = jnp.zeros((spec.N,), dtype=dtype)
     eyeN = jnp.eye(spec.N, dtype=dtype)
@@ -44,8 +45,8 @@ def density_from_state(spec: ModelSpec, kp, beta, P, horizon: int):
         b, Pm = carry
         b = kp.delta + kp.Phi @ b
         Pm = kp.Phi @ Pm @ kp.Phi.T + kp.Omega_state
-        if spec.family == "kalman_tvl":
-            Z, y_mean = _tvl_measurement(spec, b, mats)
+        if mfn is not None:
+            Z, y_mean = mfn(b, mats)
         else:
             Z = Z_const
             y_mean = Z @ b + d_const

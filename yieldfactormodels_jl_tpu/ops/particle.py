@@ -47,6 +47,17 @@ def _measurement(spec: ModelSpec, kp, dtype):
     measurement_setup; under jax_enable_x64 the quadrature inside
     yield_adjustment otherwise emits f64 into an f32 scan carry."""
     mats = spec.maturities_array
+    prog = getattr(spec, "program", None)
+    if prog is not None:
+        if prog.measurement is not None:
+            raise ValueError(
+                "the SV particle filter marginalizes a LINEAR state space; "
+                f"program {prog.name!r} has a state-dependent measurement")
+        Z = prog.loadings(kp.gamma, mats)
+        if prog.intercept is None:
+            return Z.astype(dtype), jnp.zeros((spec.N,), dtype=dtype)
+        d = prog.intercept(kp.gamma, kp.Omega_state, mats)
+        return Z.astype(dtype), d.astype(dtype)
     if spec.family == "kalman_afns":
         Z = afns_loadings(kp.gamma, mats, spec.M)
         d = yield_adjustment(kp.gamma, kp.Omega_state, mats, spec.M)

@@ -250,14 +250,20 @@ def _linearize_trajectory(spec: ModelSpec, kp, beta_bar, dtype,
     broadcast their loadings (the reference point is ignored; an affine h
     is its own statistical linearization, so the rule is moot there)."""
     T = beta_bar.shape[0]
-    if spec.family == "kalman_tvl":
+    mfn = K.state_measurement(spec)
+    if mfn is not None:
         mats = spec.maturities_array
         if rule == "ukf":
+            if spec.family != "kalman_tvl":
+                # the sigma-point lanes (_tvl_h_lanes) are hand-laid for the
+                # TVλ h; state-dependent program measurements linearize by AD
+                raise ValueError(
+                    "the 'ukf' linearization rule is TVλ-specific; "
+                    f"family {spec.family!r} uses 'ekf'")
             Z1, d1, _ = _sigma_linearize(spec, beta_bar[0], P_bar, mats)
             return (jnp.broadcast_to(Z1, (T,) + Z1.shape),
                     jnp.broadcast_to(d1, (T,) + d1.shape))
-        Z_all, y_pred = jax.vmap(
-            lambda b: K._tvl_measurement(spec, b, mats))(beta_bar)
+        Z_all, y_pred = jax.vmap(lambda b: mfn(b, mats))(beta_bar)
         d_all = y_pred - _mv(Z_all, beta_bar)
         return Z_all, d_all
     Z, d = K.measurement_setup(spec, kp, dtype)
@@ -410,6 +416,7 @@ def _chunked_refine(spec: ModelSpec, kp, data_p, observed_p, entry_m,
     N = spec.N
     mats = spec.maturities_array
     Z_const, d_const = K.measurement_setup(spec, kp, dtype)
+    mfn = K.state_measurement(spec)
     if Z_const is not None and d_const is None:
         d_const = jnp.zeros((N,), dtype=dtype)
     y_cl = data_p.T.reshape(Cn, L, N).swapaxes(0, 1)          # (L, C, N)
@@ -427,9 +434,8 @@ def _chunked_refine(spec: ModelSpec, kp, data_p, observed_p, entry_m,
             # against the sigma-point predicted measurement mean
             ysafe = jnp.where(jnp.isfinite(y), y, mu_h)
             y_eff = ysafe - d_sig
-        elif spec.family == "kalman_tvl":
-            Z, y_hat = jax.vmap(
-                lambda bb: K._tvl_measurement(spec, bb, mats))(b)
+        elif mfn is not None:
+            Z, y_hat = jax.vmap(lambda bb: mfn(bb, mats))(b)
             # fixed-linearization effective observation (the univariate
             # engine's EKF trick): v_i = y_eff_i − z_iᵀb reproduces the
             # joint EKF update with Z carrying the Jacobian column

@@ -33,7 +33,7 @@ from ..models.kalman import (
     init_state,
     loglik_contrib_mask,
     measurement_setup,
-    _tvl_measurement,
+    state_measurement,
 )
 from ..models.params import unpack_kalman
 from ..models.specs import ModelSpec
@@ -104,6 +104,7 @@ def _loss_coded(spec: ModelSpec, params, data, start=0, end=None,
     Ms = spec.state_dim
     mats = spec.maturities_array
     Z_const, d_const = measurement_setup(spec, kp, dtype)
+    mfn = state_measurement(spec)
     if Z_const is not None and d_const is None:
         d_const = jnp.zeros((spec.N,), dtype=dtype)
 
@@ -144,8 +145,8 @@ def _loss_coded(spec: ModelSpec, params, data, start=0, end=None,
     def body(state, inp):
         y, obs_t, con_t = inp
         beta, S = state
-        if spec.family == "kalman_tvl":
-            Z, y_pred0 = _tvl_measurement(spec, beta, mats)
+        if mfn is not None:
+            Z, y_pred0 = mfn(beta, mats)
             ysafe = jnp.where(jnp.isfinite(y), y, y_pred0)
             y_eff = ysafe - y_pred0 + Z @ beta
         else:

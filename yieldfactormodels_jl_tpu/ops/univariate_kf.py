@@ -38,10 +38,10 @@ from jax import lax
 
 from ..models.kalman import (
     KalmanState,
-    _tvl_measurement,
     init_state,
     loglik_contrib_mask,
     measurement_setup,
+    state_measurement,
 )
 from ..models.params import unpack_kalman
 from ..models.specs import ModelSpec
@@ -95,6 +95,7 @@ def _filter_scan(spec: ModelSpec, params, data, start, end):
     dtype = kp.Phi.dtype
     mats = spec.maturities_array
     Z_const, d_const = measurement_setup(spec, kp, dtype)
+    mfn = state_measurement(spec)
     if Z_const is not None and d_const is None:
         d_const = jnp.zeros((spec.N,), dtype=dtype)
 
@@ -106,12 +107,12 @@ def _filter_scan(spec: ModelSpec, params, data, start, end):
     def body(state, inp):
         y, obs_t = inp
         beta, P = state
-        if spec.family == "kalman_tvl":
+        if mfn is not None:
             # fixed-linearization effective observation for the EKF: with
             # y_eff = y − h(β_pred) + Z β_pred the scalar recursion
             # v_i = y_eff_i − z_i'b reproduces the joint EKF update exactly
             # (Z carries the Jacobian column that h(β_pred) does not).
-            Z, y_pred0 = _tvl_measurement(spec, beta, mats)
+            Z, y_pred0 = mfn(beta, mats)
             ysafe = jnp.where(jnp.isfinite(y), y, y_pred0)
             y_eff = ysafe - y_pred0 + Z @ beta
         else:

@@ -1,0 +1,24 @@
+"""Declarative model programs (docs/DESIGN.md §22, QUICKSTART §12).
+
+Declare a state-space model as data (:class:`ModelProgram`: measurement
+callables + a block-structured parameter-transform table), compile it onto
+the engine matrix (:func:`compile_program` → :class:`ProgramSpec`), and
+publish it framework-wide in one motion (:func:`register_program`: registry
+code, engine dispatch, estimation/serving/scenario surfaces, IR-audit
+coverage).  ``library`` ships the proving declarations (``prog-dns``,
+``svensson4``), registered at import.
+"""
+
+from .compile import ProgramSpec, compile_program
+from .registry import (build_spec, lookup, register_program,
+                       registered_codes, registered_programs,
+                       unregister_program)
+from .spec import ModelProgram, ParamBlock
+
+from . import library  # noqa: E402,F401 — registers the shipped programs
+
+__all__ = [
+    "ModelProgram", "ParamBlock", "ProgramSpec", "compile_program",
+    "register_program", "unregister_program", "registered_programs",
+    "registered_codes", "lookup", "build_spec", "library",
+]

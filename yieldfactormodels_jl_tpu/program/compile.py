@@ -1,0 +1,207 @@
+"""Compile a :class:`~.spec.ModelProgram` into an engine-ready spec.
+
+:class:`ProgramSpec` is a synthetic :class:`~..models.specs.ModelSpec`: it
+subclasses the hand-ported spec class and overrides exactly the DERIVED
+surfaces — the capability properties ``config.engines_for`` and the kernels
+read (``is_kalman``/``is_msed``/``has_constant_measurement``/
+``supports_score_tree``/``state_dim``) and the flat-parameter compilation
+(``layout``/``transform_codes``, built from the program's block table).
+Everything downstream — ``api.get_loss`` dispatch, the estimation entry
+points (``estimate``/``estimate_steps``/``estimate_windows``), the Newton
+cascade, the escalation ladder, serving (refilter/freeze/store slots), the
+scenario lattice, ``YFM_AMORT`` eligibility — is property- or layout-driven
+and takes the compiled spec UNCHANGED (docs/DESIGN.md §22 has the lowering
+table).
+
+The Kalman measurement seams the kernels consult
+(``models.kalman.measurement_setup`` for constant-Z,
+``models.kalman.state_measurement`` for state-dependent Z,
+``models.score_driven.loadings_fn`` for the score-driven kind) each carry a
+program branch, so a compiled program flows through the SAME kernels as the
+hand-ported families — never a parallel filter implementation that could
+drift.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import cached_property
+from typing import Optional, Tuple
+
+import jax
+
+from ..models.specs import ModelSpec
+from ..utils import transformations as tr
+from .spec import ModelProgram
+
+#: synthetic family strings — NEVER members of models.specs.ALL_FAMILIES, so
+#: every ``spec.family == "kalman_*"`` string check in the kernels is False
+#: for a program and dispatch flows through the property seams instead
+PROGRAM_KALMAN = "program_kalman"
+PROGRAM_MSED = "program_msed"
+
+
+@jax.tree_util.register_static
+@dataclasses.dataclass(frozen=True)
+class ProgramSpec(ModelSpec):
+    """A compiled model program (hashable/static under jit, like its base).
+
+    ``program`` is the declarative source; the base-class fields are filled
+    by :func:`compile_program` so the msed kind can reuse the hand-ported
+    layout/transform machinery verbatim."""
+
+    program: Optional[ModelProgram] = None
+
+    def __post_init__(self):
+        # replaces (does not extend) the base family validation: the family
+        # is synthesized and deliberately outside the closed zoo list
+        if self.program is None:
+            raise ValueError("ProgramSpec requires a compiled program; use "
+                             "program.compile_program(...)")
+        if self.family not in (PROGRAM_KALMAN, PROGRAM_MSED):
+            raise ValueError(
+                f"ProgramSpec family must be {PROGRAM_KALMAN!r} or "
+                f"{PROGRAM_MSED!r}, got {self.family!r}")
+        if not self.model_string:
+            object.__setattr__(self, "model_string", self.model_code)
+
+    # ---- capability properties (the engines_for inputs) ------------------
+
+    @property
+    def is_kalman(self) -> bool:
+        return self.program.kind == "kalman"
+
+    @property
+    def is_msed(self) -> bool:
+        return self.program.kind == "msed"
+
+    @property
+    def is_static(self) -> bool:
+        return False
+
+    @property
+    def has_constant_measurement(self) -> bool:
+        return self.program.has_constant_measurement
+
+    @property
+    def supports_score_tree(self) -> bool:
+        return self.program.supports_score_tree
+
+    @property
+    def state_dim(self) -> int:
+        return self.program.resolved_state_dim if self.is_kalman else self.M
+
+    @property
+    def n_lambdas(self) -> int:
+        # the decay-driver count is a zoo-family notion; a program's head is
+        # its block table — expose the head size so generic consumers that
+        # broadcast gamma (kalman.predict) see the right width
+        return max(self.program.head_size, 1)
+
+    # ---- flat parameter compilation --------------------------------------
+
+    @cached_property
+    def layout(self) -> dict:
+        prog = self.program
+        if prog.kind == "msed":
+            # the msed layout/codes are exactly the hand-ported family's —
+            # reuse the base implementation (it branches on is_msed)
+            return ModelSpec.layout.func(self)
+        pos = 0
+        lay: dict = {}
+
+        def put(name, size):
+            nonlocal pos
+            lay[name] = (pos, pos + size)
+            pos += size
+
+        for b in prog.blocks:
+            put(b.name, b.size)
+        head = pos
+        Ms = self.state_dim
+        put("obs_var", 1)
+        put("chol", Ms * (Ms + 1) // 2)
+        put("delta", Ms)
+        put("phi", Ms * Ms)
+        if head and "gamma" not in lay:
+            # the concatenated head IS gamma: what the measurement callables
+            # receive and what params.unpack_kalman slices by name
+            lay["gamma"] = (0, head)
+        lay["__total__"] = (0, pos)
+        return lay
+
+    @cached_property
+    def transform_codes(self) -> Tuple[int, ...]:
+        prog = self.program
+        if prog.kind == "msed":
+            return ModelSpec.transform_codes.func(self)
+        codes: list[int] = []
+        for b in prog.blocks:            # the declared head transform table
+            codes.extend(b.transforms)
+        Ms = self.state_dim              # standard state tail (specs.py)
+        codes.append(tr.R_TO_POS)        # observation variance
+        for j in range(Ms):              # chol column-by-column, diag > 0
+            for i in range(j + 1):
+                codes.append(tr.R_TO_POS if i == j else tr.IDENTITY)
+        codes.extend([tr.IDENTITY] * Ms)           # delta
+        for i in range(Ms):              # Phi row-major, diag in (-1, 1)
+            for j in range(Ms):
+                codes.append(tr.R_TO_11 if i == j else tr.IDENTITY)
+        assert len(codes) == self.n_params
+        return tuple(codes)
+
+    # a program has no hand-tuned initialization grids — estimation's
+    # multi-start spray / amortized warm start own the starts
+    @property
+    def A_guesses(self) -> Tuple[float, ...]:
+        return ()
+
+    @property
+    def B_guesses(self) -> Tuple[float, ...]:
+        return ()
+
+
+def compile_program(
+    program: ModelProgram,
+    maturities,
+    N: Optional[int] = None,
+    float_type="float32",
+    results_location: str = "results/",
+) -> ProgramSpec:
+    """Lower a declarative program onto a concrete maturity grid/dtype.
+
+    The compiled spec is what every engine consumes; ``register_program``
+    (program/registry.py) additionally publishes the program's name as a
+    ``models.registry.create_model`` code so this call happens behind the
+    same factory as the zoo models."""
+    import numpy as np
+
+    mats = tuple(float(m) for m in maturities)
+    if N is not None and N != len(mats):
+        raise ValueError(f"N={N} does not match len(maturities)={len(mats)}")
+    dtype_name = np.dtype(float_type).name
+    if program.kind == "kalman":
+        return ProgramSpec(
+            family=PROGRAM_KALMAN,
+            model_code=program.name,
+            maturities=mats,
+            M=program.factors,
+            L=max(program.head_size, 1),
+            dtype_name=dtype_name,
+            results_location=results_location,
+            program=program,
+        )
+    return ProgramSpec(
+        family=PROGRAM_MSED,
+        model_code=program.name,
+        maturities=mats,
+        M=program.factors,
+        L=program.gamma_dim,
+        dtype_name=dtype_name,
+        duplicator=program.duplicator or tuple(range(program.gamma_dim)),
+        random_walk=program.random_walk,
+        scale_grad=program.scale_grad,
+        forget_factor=program.forget_factor,
+        results_location=results_location,
+        program=program,
+    )

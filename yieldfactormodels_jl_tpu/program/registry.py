@@ -1,0 +1,160 @@
+"""One-motion program registration (docs/DESIGN.md §22 state machine).
+
+``register_program(prog)`` publishes a declared :class:`~.spec.ModelProgram`
+everywhere the framework looks, atomically from the caller's point of view:
+
+1. **models/registry**: the program's name becomes a ``create_model`` code
+   (collisions with zoo codes and other programs are rejected up front), so
+   drivers, services and scripts build it through the same factory as the
+   hand-ported models.
+2. **engine dispatch**: nothing to register — ``config.engines_for`` reads
+   the compiled spec's capability properties, so the engine grant (assoc
+   for constant-Z, slr for state-dependent-Z, score_tree where the flag
+   holds) follows from the declaration itself.  Same for the estimation
+   entry points, the Newton cascade, the escalation ladder, serving and the
+   scenario lattice: all property-/layout-driven.
+3. **``YFM_AMORT`` eligibility**: the amortizer registry
+   (``estimation.amortize.register_amortizer``) keys on the compiled spec;
+   a program spec is a valid key like any other, so training a surrogate
+   for it makes the warm start available with no extra wiring.
+4. **IR-audit coverage**: an auto-generated manifest ``Case`` per audited
+   builder (label ``program:<name>``) so graftlint tier 2
+   (``analysis/ir.py``, YFM101–YFM105) lowers and audits the COMPILED
+   program like any hand-written case, and the runtime census (YFM011)
+   cross-checks registered programs ↔ program-labeled cases in both
+   directions.
+
+Registration is process-global and import-time idempotent in spirit:
+re-registering the SAME program object under its name is a no-op;
+registering a DIFFERENT program under a taken name raises unless
+``replace=True`` (tests use replace + ``unregister_program``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+from .compile import ProgramSpec, compile_program
+from .spec import ModelProgram
+
+#: name → registered program (process-global, like the engine caches)
+_PROGRAMS: Dict[str, ModelProgram] = {}
+
+#: the engine-cache builders every registered program is audited through —
+#: the estimation loss path and the serving refilter path, the two compiled
+#: surfaces a program must keep clean (donation/dtype/host/lane/retrace)
+_AUDIT_BUILDERS: Tuple[str, ...] = ("estimation.optimize._jitted_loss",
+                                    "serving.online._jitted_refilter")
+
+
+def registered_programs() -> Tuple[ModelProgram, ...]:
+    """The registered programs, name-sorted (the IR census input)."""
+    return tuple(_PROGRAMS[k] for k in sorted(_PROGRAMS))
+
+
+def registered_codes() -> Tuple[str, ...]:
+    return tuple(sorted(_PROGRAMS))
+
+
+def lookup(name: str) -> Optional[ModelProgram]:
+    return _PROGRAMS.get(name)
+
+
+def _case_label(program: ModelProgram) -> str:
+    return f"program:{program.name}"
+
+
+def _register_manifest_cases(program: ModelProgram) -> None:
+    """Auto-generate the tier-2 manifest cases for one program.
+
+    Cases attach to EXISTING builder keys (the program flows through the
+    same engine-cache builders as the zoo families), so the AST-side YFM011
+    key census is untouched; the runtime census in ``analysis/ir.py`` is
+    what pins registered programs ↔ program-labeled cases."""
+    from ..analysis import manifest as mf
+
+    label = _case_label(program)
+
+    def loss_make(prog=program):
+        from ..estimation.optimize import _jitted_loss
+
+        sp = compile_program(prog, mf.MATS, float_type="float64")
+        return _jitted_loss(sp, mf.T), [(mf.f64(sp.n_params),
+                                         mf.f64(mf.N, mf.T),
+                                         mf.i64(), mf.i64())]
+
+    def refilter_make(prog=program):
+        from ..serving.online import _jitted_refilter
+
+        sp = compile_program(prog, mf.MATS, float_type="float64")
+        return _jitted_refilter(sp, mf.T), [(mf.f64(sp.n_params),
+                                            mf.f64(mf.N, mf.T))]
+
+    makes = {"estimation.optimize._jitted_loss": loss_make,
+             "serving.online._jitted_refilter": refilter_make}
+    for key in _AUDIT_BUILDERS:
+        cases = mf.MANIFEST.setdefault(key, [])
+        if any(c.label == label for c in cases):
+            continue
+        cases.append(mf.Case(key, label, makes[key]))
+
+
+def _drop_manifest_cases(name: str) -> None:
+    from ..analysis import manifest as mf
+
+    label = f"program:{name}"
+    for key in _AUDIT_BUILDERS:
+        cases = mf.MANIFEST.get(key)
+        if cases:
+            cases[:] = [c for c in cases if c.label != label]
+
+
+def register_program(program: ModelProgram, replace: bool = False) -> None:
+    """Publish ``program`` (module docstring has the four-surface motion)."""
+    if not isinstance(program, ModelProgram):
+        raise TypeError(f"register_program expects a ModelProgram, "
+                        f"got {type(program).__name__}")
+    from ..models import registry as model_registry
+
+    if program.name in model_registry._TABLE:
+        raise ValueError(
+            f"program name {program.name!r} collides with a built-in model "
+            f"code — pick another name (models/registry.py owns the zoo)")
+    existing = _PROGRAMS.get(program.name)
+    if existing is program:
+        return  # idempotent re-registration of the same declaration
+    if existing is not None and not replace:
+        raise ValueError(
+            f"program {program.name!r} is already registered; pass "
+            f"replace=True to swap it (or unregister_program first)")
+    _PROGRAMS[program.name] = program
+    _register_manifest_cases(program)
+
+
+def unregister_program(name: str) -> None:
+    """Remove a registered program (tests/tooling; unknown names are a
+    no-op so teardown paths stay simple)."""
+    if _PROGRAMS.pop(name, None) is not None:
+        _drop_manifest_cases(name)
+
+
+def build_spec(
+    name_or_program,
+    maturities,
+    N: Optional[int] = None,
+    float_type="float32",
+    results_location: str = "results/",
+) -> ProgramSpec:
+    """Compile a registered program (by name) or a program object onto a
+    maturity grid — the hook ``models.registry.create_model`` calls for
+    program codes."""
+    if isinstance(name_or_program, ModelProgram):
+        program = name_or_program
+    else:
+        program = _PROGRAMS.get(name_or_program)
+        if program is None:
+            raise ValueError(
+                f"no registered program named {name_or_program!r}; "
+                f"registered: {registered_codes()}")
+    return compile_program(program, maturities, N=N, float_type=float_type,
+                           results_location=results_location)

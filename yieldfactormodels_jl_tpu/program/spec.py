@@ -1,0 +1,200 @@
+"""Declarative model programs: the user-facing half of the program layer.
+
+A :class:`ModelProgram` is a small declarative description of a state-space
+model — user-declared transition/observation callables, a block-structured
+parameter-transform table (reusing ``utils/transformations`` codes), and
+capability flags derived from WHAT was declared (constant-Z vs
+state-dependent-Z vs score-driven) — that ``program/compile.py`` lowers onto
+the existing engine matrix (docs/DESIGN.md §22).  The design twin of
+arXiv:2505.23302's state-space model programming idea: the model is data,
+the inference engines are interchangeable.
+
+Two program kinds cover the filtered families:
+
+- ``kind="kalman"``: linear-Gaussian transition β ← δ + Φβ + η (the shared
+  Kalman machinery owns it) with EITHER a constant measurement declared as
+  ``loadings(gamma, maturities) -> Z (N, M)`` (+ optional
+  ``intercept(gamma, Omega_state, maturities) -> d (N,)``) OR a
+  state-dependent measurement declared as ``measurement(beta, maturities)
+  -> (Z (N, state_dim), y_pred (N,))`` with Z carrying the Jacobian /
+  linearization columns — exactly ``kalman._tvl_measurement``'s contract.
+  Constant-Z programs get the FULL engine set including the associative
+  scan; state-dependent ones ride the TVλ machinery (sequential EKF trick
+  + the iterated-SLR tree, EKF rule).
+- ``kind="msed"``: a score-driven observation ``loadings(gamma, maturities)
+  -> Z (N, M)`` — the inner score is AD through the user callable
+  (``score_driven._score``), so declaring Z is declaring the whole filter.
+  ``supports_score_tree`` holds unless the program opts into the EWMA
+  ``scale_grad`` lineage (same rule as the hand-ported specs).
+
+Capability flags are PROPERTIES of the declaration, never free-floating
+booleans a user could set inconsistently — a program that declares a
+``measurement`` callable IS state-dependent, one that declares ``loadings``
+IS constant-Z, and ``config.engines_for`` reads the compiled spec's
+properties unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Tuple
+
+from ..utils import transformations as tr
+
+#: transform codes a block may use (utils/transformations.py — the same
+#: integer codes the hand-ported specs compile to)
+_VALID_CODES = (tr.IDENTITY, tr.R_TO_POS, tr.R_TO_11, tr.R_TO_01)
+
+PROGRAM_KINDS = ("kalman", "msed")
+
+#: tail block names the compiler appends to a Kalman program's layout —
+#: head blocks must not collide with them (models/params.unpack_kalman
+#: slices these by name)
+RESERVED_BLOCK_NAMES = ("obs_var", "chol", "delta", "phi", "gamma",
+                        "__total__")
+
+
+@dataclasses.dataclass(frozen=True)
+class ParamBlock:
+    """One named block of the program's HEAD parameters with its per-slot
+    bijection codes — the block-structured transform table.  Head blocks sit
+    in front of the standard state blocks (obs_var | chol | δ | Φ for the
+    Kalman kind) and are what the measurement callables receive,
+    concatenated, as ``gamma``."""
+
+    name: str
+    size: int
+    transforms: Tuple[int, ...]
+
+    def __post_init__(self):
+        if not self.name or not self.name.isidentifier():
+            raise ValueError(f"block name {self.name!r} must be a Python "
+                             f"identifier")
+        if self.name in RESERVED_BLOCK_NAMES and self.name != "gamma":
+            raise ValueError(
+                f"block name {self.name!r} collides with a reserved state "
+                f"block ({RESERVED_BLOCK_NAMES}) — pick another name")
+        if self.size < 1:
+            raise ValueError(f"block {self.name!r}: size must be >= 1, "
+                             f"got {self.size}")
+        if len(self.transforms) != self.size:
+            raise ValueError(
+                f"block {self.name!r}: {len(self.transforms)} transform "
+                f"code(s) for size {self.size} — one code per slot")
+        bad = [c for c in self.transforms if c not in _VALID_CODES]
+        if bad:
+            raise ValueError(
+                f"block {self.name!r}: unknown transform code(s) {bad}; "
+                f"pick from utils.transformations "
+                f"(IDENTITY/R_TO_POS/R_TO_11/R_TO_01)")
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelProgram:
+    """A declarative state-space model (module docstring has the contract).
+
+    Frozen and hashable — the compiled :class:`~.compile.ProgramSpec`
+    carries the program as a static field, so it keys the same trace-time
+    ``lru_cache``/``@register_engine_cache`` machinery as the hand-ported
+    specs (callables hash by identity; declare programs at module level so
+    the identity is stable for the life of the process)."""
+
+    name: str
+    kind: str                                   # "kalman" | "msed"
+    factors: int                                # M (observation factors)
+    blocks: Tuple[ParamBlock, ...] = ()         # head transform table
+    loadings: Optional[Callable] = None         # (gamma, mats) -> Z (N, M)
+    intercept: Optional[Callable] = None        # (gamma, Om, mats) -> d (N,)
+    measurement: Optional[Callable] = None      # (beta, mats) -> (Z, y_pred)
+    state_dim: Optional[int] = None             # kalman only; default M
+    # score-driven (kind="msed") passthrough — same options as the
+    # hand-ported MSED specs (models/specs.py)
+    gamma_dim: int = 1                          # L
+    duplicator: Tuple[int, ...] = ()
+    random_walk: bool = False
+    scale_grad: bool = False
+    forget_factor: float = 0.9
+    description: str = ""
+
+    def __post_init__(self):
+        if not self.name or not all(
+                c.isalnum() or c in "-_." for c in self.name):
+            raise ValueError(
+                f"program name {self.name!r} must be non-empty and use only "
+                f"[A-Za-z0-9._-] (it becomes a registry model code)")
+        if self.kind not in PROGRAM_KINDS:
+            raise ValueError(f"unknown program kind {self.kind!r}; pick "
+                             f"from {PROGRAM_KINDS}")
+        if self.factors < 1:
+            raise ValueError(f"factors must be >= 1, got {self.factors}")
+        names = [b.name for b in self.blocks]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate head block names {names}")
+        if self.kind == "kalman":
+            if (self.loadings is None) == (self.measurement is None):
+                raise ValueError(
+                    "a kalman program declares EXACTLY ONE measurement: "
+                    "loadings= (constant-Z) or measurement= "
+                    "(state-dependent-Z)")
+            if self.measurement is not None and self.blocks:
+                raise ValueError(
+                    "a state-dependent kalman program keeps its measurement "
+                    "drivers in the STATE (TVλ-style) — head parameter "
+                    "blocks are for constant-Z loadings; drop blocks= or "
+                    "declare loadings= instead")
+            if self.measurement is not None and self.intercept is not None:
+                raise ValueError(
+                    "intercept= is part of the constant-Z contract; a "
+                    "state-dependent measurement returns y_pred directly")
+            sd = self.state_dim if self.state_dim is not None else self.factors
+            if sd < self.factors:
+                raise ValueError(
+                    f"state_dim={sd} < factors={self.factors}: the state "
+                    f"must carry at least the observation factors")
+        else:  # msed
+            if self.loadings is None or self.measurement is not None \
+                    or self.intercept is not None:
+                raise ValueError(
+                    "an msed program declares loadings= only (the score "
+                    "recursion is AD through it); measurement=/intercept= "
+                    "belong to the kalman kind")
+            if self.state_dim is not None:
+                raise ValueError("state_dim is a kalman-kind field; msed "
+                                 "programs size their state by factors/"
+                                 "gamma_dim")
+            if self.gamma_dim < 1:
+                raise ValueError(f"gamma_dim must be >= 1, "
+                                 f"got {self.gamma_dim}")
+            if self.duplicator and (len(self.duplicator) != self.gamma_dim
+                                    or min(self.duplicator) < 0):
+                raise ValueError(
+                    f"duplicator must map each of the {self.gamma_dim} "
+                    f"γ-states to a 0-based unique index")
+
+    # ---- derived capability flags (the lowering table's inputs) ----------
+
+    @property
+    def head_size(self) -> int:
+        return sum(b.size for b in self.blocks)
+
+    @property
+    def resolved_state_dim(self) -> int:
+        return self.state_dim if self.state_dim is not None else self.factors
+
+    @property
+    def has_constant_measurement(self) -> bool:
+        """Constant-Z kalman program — grants the "assoc" engine and
+        everything built on it (the same gate as
+        ``ModelSpec.has_constant_measurement``)."""
+        return self.kind == "kalman" and self.measurement is None
+
+    @property
+    def is_state_dependent(self) -> bool:
+        return self.measurement is not None
+
+    @property
+    def supports_score_tree(self) -> bool:
+        """Score-driven program on the plain-gradient recursion — grants the
+        O(log T) score-tree engine (same rule as the hand-ported specs:
+        the EWMA ``scale_grad`` lineage keeps the sequential scan)."""
+        return self.kind == "msed" and not self.scale_grad

@@ -44,7 +44,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..config import make_trace_counter, register_engine_cache
-from ..models.kalman import _tvl_measurement, measurement_setup
+from ..models.kalman import measurement_setup, state_measurement
 from ..models.params import unpack_kalman
 from ..models.specs import ModelSpec
 from ..robustness import taxonomy as tax
@@ -179,9 +179,10 @@ def filter_step(spec: ModelSpec, kp, state: OnlineState, y, engine: str):
 
     mask = jnp.isfinite(y)
     ysafe = jnp.where(mask, y, 0.0)  # masked elements never reach the update
-    if spec.family == "kalman_tvl":
+    mfn = state_measurement(spec)
+    if mfn is not None:
         # fixed-linearization effective observation (ops/univariate_kf.py)
-        Z, y_pred0 = _tvl_measurement(spec, beta_pred, mats)
+        Z, y_pred0 = mfn(beta_pred, mats)
         y_eff = ysafe - y_pred0 + Z @ beta_pred
     else:
         Z, d_const = measurement_setup(spec, kp, dtype)
