@@ -469,6 +469,28 @@ def main():
                          f"{proc.returncode} ({tail[:200]})")
         except Exception as e:  # never kill the bench line
             load_ctx += f"; load-tier bench failed ({type(e).__name__}: {e})"
+        # streaming dimension (DESIGN §23): the scenario-subscription hub's
+        # delta-refresh ratio — sustained fan answers/sec vs the per-update
+        # full stress_fan recompute, plus refresh p50/p99 and answer-time
+        # staleness p99.  Same CPU-pinned 8-virtual-device subprocess
+        # recipe as the mesh/tier sweeps.
+        try:
+            fenv = {**os.environ, "JAX_PLATFORMS": "cpu"}
+            fenv.pop("PALLAS_AXON_POOL_IPS", None)
+            fenv.pop("JAX_COMPILATION_CACHE_DIR", None)
+            fenv["XLA_FLAGS"] = (fenv.get("XLA_FLAGS", "")
+                                 + " --xla_force_host_platform_device_"
+                                   "count=8").strip()
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--load-fan-bench"],
+                env=fenv, capture_output=True, text=True, timeout=900)
+            tail = (proc.stdout.strip().splitlines() or ["no output"])[-1]
+            load_ctx += ("; " + tail if "load-fan-bench" in tail else
+                         f"; load-fan-bench subprocess failed rc="
+                         f"{proc.returncode} ({tail[:200]})")
+        except Exception as e:  # never kill the bench line
+            load_ctx += f"; load-fan bench failed ({type(e).__name__}: {e})"
 
     # ---- long-panel engine split (opt-in: BENCH_LONGT=1) ----
     # sequential univariate scan vs the O(log T) associative-scan engine at
@@ -1412,6 +1434,83 @@ def _load_tier_bench():
     return 0
 
 
+def _load_fan_bench():
+    """Subprocess mode (CPU, 8 virtual devices): the BENCH_LOAD STREAMING
+    column — the scenario-subscription hub's delta-refresh claim
+    (docs/DESIGN.md §23).  ``BENCH_LOAD_FAN_SUBS`` standing subscriptions
+    (default 24) ride one ``ScenarioStreamHub`` over a live
+    ``YieldCurveService`` while ``BENCH_LOAD_FAN_UPDATES`` accepted online
+    updates stream in (default 40); every update delta-refreshes ALL dirty
+    fans in ONE donated wave and every subscription's answer is collected
+    after each update.  The baseline is the same stream answered the
+    pre-§23 way: one full ``stress_fan`` recompute per subscription per
+    update.  Headline metric: ``delta_vs_full`` — sustained fan answers/sec
+    of the delta refresh over the per-update full recompute (the ISSUE
+    acceptance bar is ≥ 3×) at bounded answer-time staleness p99."""
+    import jax
+
+    from yieldfactormodels_jl_tpu import serving
+    from yieldfactormodels_jl_tpu.robustness import loadgen
+    from yieldfactormodels_jl_tpu.serving import streams  # noqa: F401
+
+    subs = int(os.environ.get("BENCH_LOAD_FAN_SUBS", "24"))
+    updates = int(os.environ.get("BENCH_LOAD_FAN_UPDATES", "40"))
+    horizon = 8
+    spec, data, snap = _serving_fixture_1c()
+    live = data.shape[1] - 64   # post-origin curves; the stream cycles them
+    dates = list(range(updates))
+    curves = [data[:, 64 + (i % live)] for i in range(updates)]
+
+    # ---- delta side: one hub, one donated wave per update ----
+    svc = serving.YieldCurveService(snap)
+    hub = serving.ScenarioStreamHub(svc, capacity=subs)
+    for i in range(subs):
+        hub.subscribe(f"sub{i}", horizon=horizon)
+    # warm: one update + one answer sweep (compile both programs), discarded
+    svc.update(-1, curves[0])
+    for i in range(subs):
+        hub.fan(f"sub{i}")
+    rep = loadgen.run_fan_load(hub, svc, curves, dates)
+
+    # ---- full side: the same stream, a stress_fan recompute per sub ----
+    svc_full = serving.YieldCurveService(snap)
+    svc_full.update(-1, curves[0])
+    svc_full.stress_fan(h=horizon)   # warm, discarded
+    full_lat = []
+    t_start = time.perf_counter()
+    for date, curve in zip(dates, curves):
+        svc_full.update(date, curve)
+        for _ in range(subs):
+            t0 = time.perf_counter()
+            svc_full.stress_fan(h=horizon)
+            full_lat.append(time.perf_counter() - t0)
+    full_wall = time.perf_counter() - t_start
+    f50, f99, _ = loadgen._percentiles_ms(full_lat)
+    full_fans_per_s = round(updates * subs / full_wall, 2) if full_wall \
+        else 0.0
+
+    out = {
+        "subscriptions": subs, "updates": updates, "horizon": horizon,
+        "shocks": len(sc_standard := hub.fan("sub0")["names"]),
+        "shock_names": list(sc_standard),
+        "delta": rep.to_dict(),
+        "full": {"fans_per_s": full_fans_per_s, "wall_s": round(full_wall, 4),
+                 "p50_ms": round(f50, 3), "p99_ms": round(f99, 3)},
+        "delta_vs_full": round(rep.fans_per_s / full_fans_per_s, 2)
+        if full_fans_per_s else float("nan"),
+        "counters": hub.counters.to_dict(),
+    }
+    plat = jax.devices()[0].platform
+    out["device_fallback"] = plat != "tpu"
+    out["fallback_reason"] = "" if plat == "tpu" else os.environ.get(
+        "BENCH_FALLBACK_REASON",
+        f"streaming-fan sweep on the 8-virtual-device {plat} harness "
+        f"(the single-chip relay exposes no multi-device mesh)")
+    print(f"load-fan-bench[1C f64, {subs} subs x {updates} updates]: "
+          + json.dumps(out))
+    return 0
+
+
 def _orch_bench():
     """2-worker in-process orchestration bench (CPU-pinned subprocess mode):
     tasks/sec on a clean RW rolling run through the leased queue, plus the
@@ -1629,6 +1728,8 @@ if __name__ == "__main__":
         sys.exit(_load_mesh_bench())
     elif "--load-tier-bench" in sys.argv:
         sys.exit(_load_tier_bench())
+    elif "--load-fan-bench" in sys.argv:
+        sys.exit(_load_fan_bench())
     elif "--inner" in sys.argv:
         main()
     else:

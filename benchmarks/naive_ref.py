@@ -489,6 +489,53 @@ def unit_ssd_nns_pass():
     return time.perf_counter() - t0, "1 full-panel pass (FD inner score)"
 
 
+def unit_fan(subs=24, S=6, h=8):
+    """Measured seconds for ONE update cycle of the pre-streaming serving
+    answer (the ``load-fan-bench`` naive denominator): a single online
+    filter update (element-masked per-step NumPy loop) followed by ``subs``
+    FULL stress-fan recomputes — per subscriber, per shock, the h-step
+    density recursion in straight float64 loops
+    (tests/oracle.fan_refresh).  This is the per-update cost the
+    ScenarioStreamHub's one-launch delta refresh replaces."""
+    from yieldfactormodels_jl_tpu import create_model
+    from yieldfactormodels_jl_tpu.models.params import unpack_kalman
+
+    spec, _ = create_model("1C", tuple(common.MATURITIES),
+                           float_type="float64")
+    p = oracle.stable_1c_params(spec, np.float64)
+    rng = np.random.default_rng(3)
+    data = oracle.simulate_dns_panel(rng, np.asarray(common.MATURITIES),
+                                     T=96)
+    kp = unpack_kalman(spec, p)
+    Z = oracle.dns_loadings(float(p[spec.layout["gamma"][0]]),
+                            np.asarray(common.MATURITIES))
+    Phi, delta = np.asarray(kp.Phi), np.asarray(kp.delta)
+    Om, ov = np.asarray(kp.Omega_state), float(kp.obs_var)
+    d = np.zeros(spec.N)
+    # the standard 6-shock fan's displacements (estimation/scenario.py),
+    # truncated to the first S rows when the caller shrinks the fan
+    full_shifts = np.zeros((6, spec.state_dim))
+    full_shifts[1, 0], full_shifts[2, 0] = 0.5, -0.5
+    full_shifts[3, 1], full_shifts[4, 1] = -0.5, 0.5
+    full_vols = np.ones(6)
+    full_vols[5] = 1.5
+    shifts = np.zeros((S, spec.state_dim))
+    shifts[: min(S, 6)] = full_shifts[: min(S, 6)]
+    vols = np.ones(S)
+    vols[: min(S, 6)] = full_vols[: min(S, 6)]
+    betas, Ps, _ = oracle.online_filter(Z, d, Phi, delta, Om, ov,
+                                        data[:, :64])
+    t0 = time.perf_counter()
+    betas2, Ps2, _ = oracle.online_filter(Z, d, Phi, delta, Om, ov,
+                                          data[:, 64:65])
+    for _ in range(subs):
+        oracle.fan_refresh(Z, d, Phi, delta, Om, ov, betas[-1], Ps[-1],
+                           shifts, vols, h)
+    wall = time.perf_counter() - t0
+    return wall, (f"1 online update + {subs} full {S}-shock h={h} fan "
+                  f"recomputes (per-step NumPy loops, 1C f64)")
+
+
 def unit_newton_iteration():
     """Measured seconds for ONE naive second-order iteration at the DNS3
     config: the reference-equivalent way to get a Newton step is a
@@ -593,6 +640,7 @@ RUNNERS = {
     "unit-msed-pass": unit_msed_pass,
     "unit-ssd-pass": unit_ssd_nns_pass,
     "scenario-fan": naive_scenario_fan,
+    "unit-fan": unit_fan,
     "unit-newton-iteration": unit_newton_iteration,
     "unit-amort": unit_amort,
 }

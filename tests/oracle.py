@@ -1390,3 +1390,30 @@ def amortizer_loss(params, panels, targets):
         total += float(np.sum((pred - targets[b]) ** 2))
         n_ok += 1
     return total / (max(n_ok, 1) * P)
+
+
+def fan_refresh(Z, d, Phi, delta, Omega_state, obs_var, beta, P, shifts,
+                vol_scales, horizon):
+    """Constant-Z stress-fan densities by the defining per-shock loop — the
+    oracle for ``ops/forecast.density_fan`` and the streaming hub's delta
+    refresh (serving/streams.py): for every shock s the filtered state is
+    displaced (β + shifts[s], P · vol_scales[s]²) and the textbook
+    propagate-then-emit recursion runs h steps (b ← δ + Φb, Pm ← ΦPmΦᵀ + Ω;
+    mean = Zb + d, cov = ZPmZᵀ + σ²I).  Straight float64 loops, no JAX;
+    returns means (S, h, N) and covs (S, h, N, N)."""
+    Z = np.asarray(Z, dtype=np.float64)
+    d = np.asarray(d, dtype=np.float64)
+    N = Z.shape[0]
+    S = len(vol_scales)
+    means = np.zeros((S, horizon, N))
+    covs = np.zeros((S, horizon, N, N))
+    for s in range(S):
+        b = np.asarray(beta, dtype=np.float64) + np.asarray(shifts[s],
+                                                            dtype=np.float64)
+        Pm = np.asarray(P, dtype=np.float64) * float(vol_scales[s]) ** 2
+        for k in range(horizon):
+            b = delta + Phi @ b
+            Pm = Phi @ Pm @ Phi.T + Omega_state
+            means[s, k] = Z @ b + d
+            covs[s, k] = Z @ Pm @ Z.T + obs_var * np.eye(N)
+    return means, covs

@@ -119,3 +119,43 @@ def test_rejects_prediction_error_families_and_bad_engine(rng):
         yfm.forecast_density(nspec, np.zeros(nspec.n_params), data, 4)
     with pytest.raises(ValueError, match="filtering-moments"):
         yfm.forecast_density(spec, jnp.asarray(p), data, 4, engine="sqrt")
+
+
+def test_density_fan_poisons_per_shock_with_codes(rng):
+    """density_fan is the sentinel boundary for the fan axis (DESIGN §11):
+    a non-finite displaced start NaN-poisons ONLY its own fan row and
+    stamps a per-shock taxonomy code; finite rows still match the
+    independent NumPy oracle."""
+    from yieldfactormodels_jl_tpu.ops.forecast import density_fan
+    from yieldfactormodels_jl_tpu.robustness import taxonomy as tax
+
+    spec, p, data = _case(rng)
+    kp = unpack_kalman(spec, jnp.asarray(p))
+    Z = oracle.dns_loadings(p[spec.layout["gamma"][0]], np.asarray(MATS))
+    _, _, bf, Pf = oracle.rts_smoother(
+        Z, np.asarray(kp.Phi), np.asarray(kp.delta),
+        np.asarray(kp.Omega_state), float(kp.obs_var), data)
+    beta, P = bf[-1], Pf[-1]
+    Ms = spec.state_dim
+    shifts = jnp.stack([jnp.zeros(Ms), jnp.full((Ms,), jnp.nan)])
+    out = density_fan(spec, kp, jnp.asarray(beta), jnp.asarray(P),
+                      shifts, jnp.ones(2), 4)
+    codes = np.asarray(out["codes"])
+    assert codes.dtype == np.int32
+    assert codes[0] == tax.OK and codes[1] == tax.NAN_STATE
+    assert np.isnan(np.asarray(out["means"])[1]).all()
+    assert np.isnan(np.asarray(out["covs"])[1]).all()
+    # the finite row is untouched: the NumPy fan recursion, bit for bit
+    o_means, o_covs = oracle.fan_refresh(
+        Z, np.zeros(spec.N), np.asarray(kp.Phi), np.asarray(kp.delta),
+        np.asarray(kp.Omega_state), float(kp.obs_var), beta, P,
+        np.zeros((1, Ms)), np.ones(1), 4)
+    np.testing.assert_allclose(np.asarray(out["means"])[0], o_means[0],
+                               rtol=1e-9, atol=1e-12)
+    np.testing.assert_allclose(np.asarray(out["covs"])[0], o_covs[0],
+                               rtol=1e-9, atol=1e-12)
+    # a NaN covariance start reports NONPSD_COV, not NAN_STATE
+    badP = jnp.asarray(P).at[0, 0].set(jnp.nan)
+    out2 = density_fan(spec, kp, jnp.asarray(beta), badP,
+                       jnp.zeros((1, Ms)), jnp.ones(1), 4)
+    assert int(np.asarray(out2["codes"])[0]) == tax.NONPSD_COV

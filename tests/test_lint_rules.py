@@ -639,6 +639,54 @@ def test_yfm008_quiet_on_pure_tier_planning_with_batched_flush(tmp_path):
     assert not res.findings
 
 
+def test_yfm008_fires_on_host_gather_in_fan_refresh_routing(tmp_path):
+    """The DESIGN §23 subscription-routing rule: the hub's dirty-marking and
+    wave functions run on the accepted-update hot path — a host gather there
+    stalls every subscriber on one fan."""
+    res = lint(tmp_path, f"{PKG}/serving/extra.py", """\
+        import numpy as np
+
+        def _refresh_wave(self, block):
+            return np.asarray(block.means)       # gather mid-wave
+
+        def _stage_wave(self, block, lanes):
+            return np.asarray(block.refreshed)
+
+        def notify_updated(self, keys):
+            return np.array(keys)
+
+        def _mark_dirty(self, keys):
+            return np.asarray(self.versions)
+    """, ["YFM008"])
+    assert len(fired(res, "YFM008")) == 4
+
+
+def test_yfm008_quiet_on_device_side_fan_refresh(tmp_path):
+    # the sanctioned split: device-side staging in the wave, host
+    # materialization only at the answer boundary (fan())
+    res = lint(tmp_path, f"{PKG}/serving/extra.py", """\
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        def _refresh_wave(self, block, fn):
+            block.means, block.covs = fn(block.means, block.covs)
+
+        def _stage_wave(self, block, lanes):
+            mask = np.zeros((block.capacity,), dtype=bool)
+            mask[lanes] = True
+            return jnp.asarray(mask)
+
+        def notify_updated(self, keys):
+            for key in keys:
+                self.dirty[key] = True
+
+        def fan(self, key):
+            return np.asarray(self.means[..., 0])
+    """, ["YFM008"])
+    assert not res.findings
+
+
 def test_yfm008_scoped_to_serving(tmp_path):
     # the orchestrator's poll loop may sleep (chaos/test code likewise by
     # living outside serving/)

@@ -36,6 +36,9 @@ def test_units_and_tiny_configs_run():
     # the BENCH_LONGT TVλ dual-ratio denominator (iterated-SLR naive loop)
     w, d = naive_ref.unit_slr_pass(T=200, sweeps=2, chunk=64)
     assert w > 0 and "sweeps" in d
+    # the load-fan-bench denominator: per-update full-fan recomputes
+    w, d = naive_ref.unit_fan(subs=2, S=2, h=2)
+    assert w > 0 and "fan" in d
 
 
 def test_naive_pf_collapses_to_kalman_loglik():

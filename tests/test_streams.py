@@ -1,0 +1,341 @@
+"""Streaming scenario subscriptions (serving/streams.py, docs/DESIGN.md §23).
+
+Acceptance coverage for the delta-refresh tentpole:
+
+- subscription answers match BOTH the independent NumPy oracle
+  (``oracle.fan_refresh`` — straight float64 loops) and the full
+  ``stress_fan`` recompute from the same posterior, before and after online
+  updates (the delta chain is numerically the full recompute);
+- one compiled refresh program and zero donation warnings across whole
+  subscribe/update/answer lifecycles (two subscribers, several updates);
+- refilter/refit events fall back to the full-recompute path and the fan
+  tracks the rebuilt posterior;
+- the ``refresh_storm``/``fan_stale`` chaos seams: degraded answers from the
+  last promoted fan, healed by the next accepted update;
+- the ``YFM_FAN_STALE_MS`` staleness budget under an injected clock (stale
+  answers are served-and-flagged, never recomputed inline);
+- the sharded-gateway mode: per-key dirty marking through the pump, an
+  untouched key's fan stays bit-identical;
+- the shock grammar (``program.shocks``) and ``replay_episodes`` end-to-end,
+  plus the slot lifecycle (duplicate keys, unsubscribe/reuse, growth).
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import yieldfactormodels_jl_tpu as yfm
+from tests import oracle
+from yieldfactormodels_jl_tpu import serving
+from yieldfactormodels_jl_tpu.estimation import scenario as sc
+from yieldfactormodels_jl_tpu.models.params import unpack_kalman
+from yieldfactormodels_jl_tpu.orchestration import chaos
+from yieldfactormodels_jl_tpu.program import ShockRule, compile_shocks
+from yieldfactormodels_jl_tpu.robustness import taxonomy as tax
+from yieldfactormodels_jl_tpu.serving import streams
+
+MATS = tuple(np.array([3, 12, 24, 60, 120, 240, 360]) / 12.0)
+T_PANEL = 48
+T_ORIGIN = 40
+H = 4
+
+
+@pytest.fixture(autouse=True)
+def _chaos_clean():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+@pytest.fixture(scope="module")
+def dns_setup():
+    rng = np.random.default_rng(17)
+    spec, _ = yfm.create_model("1C", MATS, float_type="float64")
+    p = oracle.stable_1c_params(spec, np.float64)
+    data = oracle.simulate_dns_panel(rng, np.asarray(MATS), T=T_PANEL)
+    return spec, p, data
+
+
+@pytest.fixture()
+def service(dns_setup):
+    spec, p, data = dns_setup
+    snap = serving.freeze_snapshot(spec, p, data, end=T_ORIGIN)
+    return serving.YieldCurveService(snap)
+
+
+def _donation_warnings(w):
+    return [str(i.message) for i in w
+            if "donated" in str(i.message).lower()]
+
+
+def _oracle_fan(spec, p, snap, shocks, horizon):
+    """The independent NumPy fan from a snapshot's posterior."""
+    kp = unpack_kalman(spec, np.asarray(p))
+    Z = oracle.dns_loadings(float(np.asarray(p)[spec.layout["gamma"][0]]),
+                            np.asarray(MATS))
+    shifts, vols, _, _ = sc._shock_arrays(shocks, spec.state_dim, np.float64)
+    return oracle.fan_refresh(
+        Z, np.zeros(spec.N), np.asarray(kp.Phi), np.asarray(kp.delta),
+        np.asarray(kp.Omega_state), float(kp.obs_var),
+        np.asarray(snap.beta), np.asarray(snap.P),
+        np.asarray(shifts), np.asarray(vols), horizon)
+
+
+# ---------------------------------------------------------------------------
+# oracle + full-recompute parity
+# ---------------------------------------------------------------------------
+
+def test_subscribe_matches_oracle_and_stress_fan(dns_setup, service):
+    spec, p, _ = dns_setup
+    hub = serving.ScenarioStreamHub(service)
+    hub.subscribe("alice", horizon=H)
+    ans = hub.fan("alice")
+    # independent NumPy loops (CLAUDE.md parity rule)
+    o_means, o_covs = _oracle_fan(spec, p, service.snapshot,
+                                  sc.standard_fan(spec), H)
+    np.testing.assert_allclose(ans["means"], o_means, rtol=1e-9, atol=1e-12)
+    np.testing.assert_allclose(ans["covs"], o_covs, rtol=1e-9, atol=1e-12)
+    # and the full recompute from the same posterior
+    full = service.stress_fan(h=H)
+    np.testing.assert_allclose(ans["means"], full["means"], rtol=1e-12)
+    np.testing.assert_allclose(ans["covs"], full["covs"], rtol=1e-12)
+    assert ans["version"] == service.version == full["version"]
+    assert "computed_at" in full and full["computed_at"] is not None
+    assert not ans["degraded"] and not ans["stale"]
+    assert np.all(ans["codes"] == tax.OK)
+    assert ans["names"] == tuple(s.name for s in sc.standard_fan(spec))
+
+
+def test_delta_refresh_tracks_updates(dns_setup, service):
+    """After every accepted update the delta-refreshed fan equals the full
+    stress_fan recomputed from the CURRENT posterior — the delta chain
+    never drifts from the from-scratch answer."""
+    spec, p, data = dns_setup
+    hub = serving.ScenarioStreamHub(service)
+    hub.subscribe("alice", horizon=H)
+    for t in range(T_ORIGIN, T_ORIGIN + 4):
+        service.update(t, data[:, t])
+        ans = hub.fan("alice")
+        full = service.stress_fan(h=H)
+        np.testing.assert_allclose(ans["means"], full["means"], rtol=1e-12)
+        np.testing.assert_allclose(ans["covs"], full["covs"], rtol=1e-12)
+        assert ans["version"] == service.version
+        assert not ans["degraded"]
+        assert ans["age_ms"] is not None and ans["age_ms"] >= 0.0
+    o_means, _ = _oracle_fan(spec, p, service.snapshot,
+                             sc.standard_fan(spec), H)
+    np.testing.assert_allclose(ans["means"], o_means, rtol=1e-9, atol=1e-12)
+    assert hub.counters.refreshes >= 4 and hub.counters.full_recomputes == 0
+
+
+def test_one_program_zero_donation_warnings(dns_setup, service):
+    """Whole subscribe → update → answer lifecycles compile the refresh
+    program exactly ONCE, with zero buffer-not-donated warnings — two
+    subscribers share one block/wave."""
+    _, _, data = dns_setup
+    streams.reset_trace_counts()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        hub = serving.ScenarioStreamHub(service, capacity=4)
+        hub.subscribe("alice", horizon=H)
+        hub.subscribe("bob", horizon=H)
+        for t in range(T_ORIGIN, T_ORIGIN + 3):
+            service.update(t, data[:, t])
+            hub.fan("alice")
+            hub.fan("bob")
+    assert streams.trace_counts["fan_refresh"] == 1
+    assert not _donation_warnings(w)
+    a, b = hub.fan("alice"), hub.fan("bob")
+    np.testing.assert_allclose(a["means"], b["means"], rtol=1e-12)
+    assert hub.health()["blocks"][0]["subscribed"] == 2
+
+
+def test_refilter_falls_back_to_full_recompute(dns_setup, service):
+    """A rebuild event (refilter) breaks the delta chain: the hub recomputes
+    from scratch and the fan matches the rebuilt posterior."""
+    _, _, data = dns_setup
+    hub = serving.ScenarioStreamHub(service)
+    hub.subscribe("alice", horizon=H)
+    assert hub.counters.full_recomputes == 0
+    service.refilter(data[:, :T_ORIGIN + 3])
+    assert hub.counters.full_recomputes == 1
+    ans = hub.fan("alice")
+    full = service.stress_fan(h=H)
+    np.testing.assert_allclose(ans["means"], full["means"], rtol=1e-12)
+    assert ans["version"] == service.version
+    assert not ans["degraded"]
+
+
+# ---------------------------------------------------------------------------
+# chaos seams + staleness budget
+# ---------------------------------------------------------------------------
+
+def test_refresh_storm_degrades_then_heals(dns_setup, service):
+    """A dropped wave leaves the fan at the last promoted version, answers
+    degraded, and the NEXT accepted update heals it — the update path is
+    never blocked."""
+    _, _, data = dns_setup
+    hub = serving.ScenarioStreamHub(service)
+    hub.subscribe("alice", horizon=H)
+    v0 = hub.fan("alice")["version"]
+    chaos.configure("refresh_storm:@1")
+    service.update(T_ORIGIN, data[:, T_ORIGIN])
+    ans = hub.fan("alice")
+    assert ans["degraded"] and ans["version"] == v0
+    assert hub.counters.dropped_waves == 1
+    assert np.all(np.isfinite(ans["means"]))   # last fan, not garbage
+    service.update(T_ORIGIN + 1, data[:, T_ORIGIN + 1])
+    healed = hub.fan("alice")
+    full = service.stress_fan(h=H)
+    np.testing.assert_allclose(healed["means"], full["means"], rtol=1e-12)
+    assert not healed["degraded"] and healed["version"] == service.version
+
+
+def test_fan_stale_chaos_degrades_one_answer(dns_setup, service):
+    hub = serving.ScenarioStreamHub(service)
+    hub.subscribe("alice", horizon=H)
+    chaos.configure("fan_stale:@1")
+    bad = hub.fan("alice")
+    assert bad["degraded"] and np.all(np.isfinite(bad["means"]))
+    good = hub.fan("alice")
+    assert not good["degraded"]
+    assert hub.counters.degraded_answers == 1
+
+
+def test_stale_budget_flags_but_serves(dns_setup, service):
+    """Past the YFM_FAN_STALE_MS budget the answer is stale-flagged and
+    counted degraded but still served from the resident fan — never an
+    inline recompute (the injected clock proves no refresh ran)."""
+    now = [0.0]
+    hub = serving.ScenarioStreamHub(service, stale_ms=5.0,
+                                    clock=lambda: now[0])
+    hub.subscribe("alice", horizon=H)
+    fresh = hub.fan("alice")
+    assert not fresh["stale"]
+    now[0] += 1.0   # 1000 ms on a 5 ms budget
+    stale = hub.fan("alice")
+    assert stale["stale"] and stale["degraded"]
+    assert stale["age_ms"] == pytest.approx(1000.0)
+    np.testing.assert_allclose(stale["means"], fresh["means"], rtol=0)
+    assert hub.counters.full_recomputes == 0
+
+
+def test_stale_budget_reads_env(dns_setup, service, monkeypatch):
+    monkeypatch.setenv("YFM_FAN_STALE_MS", "250")
+    hub = serving.ScenarioStreamHub(service)
+    assert hub.stale_ms == 250.0
+
+
+# ---------------------------------------------------------------------------
+# sharded-gateway mode
+# ---------------------------------------------------------------------------
+
+def test_sharded_gateway_per_key_refresh(dns_setup):
+    import dataclasses
+
+    from yieldfactormodels_jl_tpu.parallel import mesh as pmesh
+
+    spec, p, data = dns_setup
+    snap = serving.freeze_snapshot(spec, p, data, end=T_ORIGIN)
+    lattice = serving.BucketLattice(horizons=(4,), batch_sizes=(1,),
+                                    scenario_counts=(4,),
+                                    update_batch_sizes=(1, 4))
+    store = serving.ShardedStateStore(spec, mesh=pmesh.make_mesh(8),
+                                      shard_capacity=4, lattice=lattice)
+    keys = store.register_many(
+        dataclasses.replace(snap, meta=dataclasses.replace(snap.meta,
+                                                           task_id=i))
+        for i in range(3))
+    gw = serving.ShardedGateway(store, queue_max=64, queue_age_ms=0.0)
+    hub = serving.ScenarioStreamHub(gw)
+    for k in keys:
+        hub.subscribe(k, horizon=H)
+    before = hub.fan(keys[1])
+    t = gw.submit_update(0, data[:, T_ORIGIN], key=keys[0])
+    assert gw.pump() == 1
+    assert np.isfinite(gw.poll(t)["ll"])
+    # the touched key tracks its NEW mesh-resident posterior...
+    s0 = store.snapshot_of(keys[0])
+    ref = sc.stress_fan(spec, np.asarray(s0.params), np.asarray(s0.beta),
+                        np.asarray(s0.P), sc.standard_fan(spec), H, 0)
+    touched = hub.fan(keys[0])
+    np.testing.assert_allclose(touched["means"], ref["means"], rtol=1e-12)
+    assert touched["version"] == s0.meta.version
+    assert not touched["degraded"]
+    # ...and the untouched key's fan is bit-identical to before
+    after = hub.fan(keys[1])
+    np.testing.assert_array_equal(after["means"], before["means"])
+    assert after["version"] == before["version"]
+
+
+# ---------------------------------------------------------------------------
+# shock grammar + replay + slot lifecycle
+# ---------------------------------------------------------------------------
+
+def test_shock_grammar_and_replay_subscriptions(dns_setup, service):
+    spec, p, data = dns_setup
+    hub = serving.ScenarioStreamHub(service)
+    rules = (ShockRule("steep", kind="factor", factor="slope", size=-0.5),
+             ShockRule("calm", kind="vol", vol_scale=0.5),
+             ShockRule("steep_calm", kind="combo",
+                       of=(("steep", 1.0), ("calm", 1.0))))
+    hub.subscribe("grammar", shocks=rules, horizon=H)
+    ans = hub.fan("grammar")
+    assert ans["names"] == ("steep", "calm", "steep_calm")
+    compiled = compile_shocks(rules, spec)
+    snap = service.snapshot
+    ref = sc.stress_fan(spec, snap.params, snap.beta, snap.P, compiled, H, 0)
+    np.testing.assert_allclose(ans["means"], ref["means"], rtol=1e-12)
+    # the combo is the sum of its parts' displacements
+    assert compiled[2].beta_shift == compiled[0].beta_shift
+    assert compiled[2].vol_scale == pytest.approx(0.5)
+    # replay episodes: shocks read from the panel's own filtered history
+    eps = sc.replay_episodes(spec, p, data, [(5, 12), (20, 30, "taper")])
+    assert [e.name for e in eps] == ["replay_5_12", "taper"]
+    hub.subscribe("replay", shocks=eps, horizon=H)
+    rep = hub.fan("replay")
+    assert rep["names"] == ("replay_5_12", "taper")
+    assert np.all(np.isfinite(rep["means"]))
+
+
+def test_shock_grammar_rejects_malformed(dns_setup, service):
+    spec, _, _ = dns_setup
+    hub = serving.ScenarioStreamHub(service)
+    with pytest.raises(serving.ServingError):
+        hub.subscribe("x", shocks="weird")
+    with pytest.raises(serving.ServingError):
+        hub.subscribe("x", shocks=())
+    with pytest.raises(serving.ServingError):   # mixed rule/spec tuple
+        hub.subscribe("x", shocks=(sc.standard_fan(spec)[0],
+                                   ShockRule("a", size=0.1)))
+    with pytest.raises(serving.ServingError):
+        hub.subscribe("x", horizon=0)
+    with pytest.raises(ValueError):   # combo referencing a LATER rule
+        compile_shocks((ShockRule("c", kind="combo", of=(("a", 1.0),)),
+                        ShockRule("a", size=0.1)), spec)
+    with pytest.raises(ValueError):   # unknown kind is loud, driver-layer
+        compile_shocks((ShockRule("z", kind="nope"),), spec)
+    assert hub.subscriptions() == ()
+
+
+def test_slot_lifecycle_reuse_and_growth(dns_setup, service):
+    _, _, data = dns_setup
+    hub = serving.ScenarioStreamHub(service, capacity=1)
+    hub.subscribe("a", horizon=H)
+    with pytest.raises(serving.ServingError):
+        hub.subscribe("a", horizon=H)   # duplicate key
+    hub.subscribe("b", horizon=H)       # overflow → block doubles
+    assert hub.health()["blocks"][0]["capacity"] == 2
+    assert set(hub.subscriptions()) == {"a", "b"}
+    hub.unsubscribe("a")
+    with pytest.raises(serving.ServingError):
+        hub.fan("a")
+    with pytest.raises(serving.ServingError):
+        hub.unsubscribe("a")
+    hub.subscribe("c", horizon=H)       # freed slot is reused, no growth
+    assert hub.health()["blocks"][0]["capacity"] == 2
+    service.update(T_ORIGIN, data[:, T_ORIGIN])
+    ans = hub.fan("c")
+    full = service.stress_fan(h=H)
+    np.testing.assert_allclose(ans["means"], full["means"], rtol=1e-12)

@@ -485,6 +485,41 @@ def _m_update_k():
                  boolean(kb))]
 
 
+@case("serving.streams._jitted_fan_refresh", donated=2, max_programs=1)
+def _m_fan_refresh():
+    from ..serving.streams import _jitted_fan_refresh, refresh_signature
+
+    sp = spec()
+    C = 8  # subscription lanes (batch-last, like the store slot axis)
+    fn = _jitted_fan_refresh(sp, shocks2(), H, C)
+    sig = refresh_signature(sp, len(shocks2()), H, C)
+    order = ("params", "beta", "P", "active", "means", "covs", "codes",
+             "refreshed")
+    args = tuple(sds(*sig[k]) for k in order)
+    # the same signature-derived avals TWICE with max_programs=1: the
+    # YFM105 retrace pin — the hub's buffers and this manifest share ONE
+    # shape recipe (refresh_signature), so a staging drift lowers as a
+    # second program here instead of a silent live retrace
+    return fn, [args, args]
+
+
+@case("serving.streams._jitted_fan_refresh", label="shared", donated=2,
+      max_programs=1)
+def _m_fan_refresh_shared():
+    # the service-mode variant: one live posterior, unbatched params/beta/P,
+    # lane broadcast in-kernel — same donation table and retrace pin
+    from ..serving.streams import _jitted_fan_refresh, refresh_signature
+
+    sp = spec()
+    C = 8
+    fn = _jitted_fan_refresh(sp, shocks2(), H, C, shared=True)
+    sig = refresh_signature(sp, len(shocks2()), H, C, shared=True)
+    order = ("params", "beta", "P", "active", "means", "covs", "codes",
+             "refreshed")
+    args = tuple(sds(*sig[k]) for k in order)
+    return fn, [args, args]
+
+
 def _shard_update_args(warmup: bool):
     """The store's two staging paths for the SAME program: hot path
     (``_launch_chunk``) and warm-up (``warmup``) — bit-identical avals or

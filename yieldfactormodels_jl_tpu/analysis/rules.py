@@ -567,7 +567,14 @@ _ROUTING_FUNCS = frozenset({"pump", "_pump_locked", "_dispatch_updates",
                             # planning work; the actual freeze/thaw transfer
                             # belongs in the batched flush boundaries only
                             "_prepare_batch", "_promote_plan", "_demote_plan",
-                            "prepare_reads", "_account"})
+                            "prepare_reads", "_account",
+                            # stream-hub refresh/dispatch (DESIGN §23): dirty
+                            # marking, wave staging and the donated refresh
+                            # launch run on the update pump path; the pending
+                            # → good promotion and the fan slices gather only
+                            # at the answer boundary (streams.fan)
+                            "_refresh_wave", "_stage_wave", "notify_updated",
+                            "_mark_dirty"})
 
 #: calls that move device values to host (or force a device sync)
 _HOST_TRANSFERS = ("jax.device_get", "device_get", "np.asarray", "np.array",

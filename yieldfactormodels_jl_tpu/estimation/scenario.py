@@ -157,7 +157,8 @@ def _fan_core(spec: ModelSpec, shocks: Tuple[ShockSpec, ...], horizon: int,
         out = density_fan(spec, kp, beta, P, shifts, vols, horizon)
         out = {"means": out["means"], "covs": out["covs"],
                "state_means": out["state_means"],
-               "state_covs": out["state_covs"]}
+               "state_covs": out["state_covs"],
+               "codes": out["codes"]}  # (S,) int32 per-shock taxonomy
         if n_paths > 0:
             def one_shock(shift, vol, phi_h, sig_h, k):
                 start = (beta + shift, P * (vol * vol))
@@ -227,8 +228,14 @@ def _jitted_lattice(static_spec: Optional[ModelSpec],
                 kalman_params, kp, beta, P, k_paths)
             nan = jnp.asarray(jnp.nan, dtype=beta.dtype)
             # failed filter pass → NaN-poisoned fan + state (sentinel; the
-            # driver layer owns the error policy, CLAUDE.md conventions)
+            # driver layer owns the error policy, CLAUDE.md conventions).
+            # The int32 per-shock codes can't carry NaN — they pick up the
+            # filter failure as a NAN_STATE bit instead.
+            codes = fan.pop("codes")
             out["fan"] = {k: jnp.where(ok, v, nan) for k, v in fan.items()}
+            from ..robustness import taxonomy as tax
+            out["fan"]["codes"] = jnp.where(ok, codes,
+                                            codes | jnp.int32(tax.NAN_STATE))
             out["state_beta"] = jnp.where(ok, beta, nan)
             out["state_P"] = jnp.where(ok, P, nan)
         return out
@@ -450,6 +457,57 @@ def stress_fan(spec: ModelSpec, params, beta, P,
     return fn(jnp.asarray(params, dtype=spec.dtype),
               jnp.asarray(beta, dtype=spec.dtype),
               jnp.asarray(P, dtype=spec.dtype), jnp.asarray(key))
+
+
+# ---------------------------------------------------------------------------
+# historical replay episodes: shocks read FROM a panel
+# ---------------------------------------------------------------------------
+
+def replay_episodes(spec: ModelSpec, params, panel, episodes, *,
+                    name_prefix: str = "replay", engine=None
+                    ) -> Tuple[ShockSpec, ...]:
+    """Compile historical stress episodes into :class:`ShockSpec`\\ s: for
+    each ``(start, end)`` column pair the panel is filtered once and the
+    episode's factor move ``β_{end|end} − β_{start|start}`` becomes that
+    shock's ``beta_shift`` — "replay the 2013 taper tantrum on today's
+    curve" as a first-class fan member (DESIGN §23).  ``episodes`` is an
+    iterable of ``(start, end)`` (0-based column indices, ``end``
+    inclusive) or ``(start, end, name)``; driver layer, so a failed filter
+    pass raises a loud ``ValueError`` (first-iteration structural failure)
+    rather than returning a poisoned shock dictionary."""
+    from ..ops.smoother import forward_moments
+
+    if not spec.is_kalman:
+        raise ValueError(
+            f"replay_episodes needs a Kalman family with a filtered state "
+            f"path; {spec.family!r} has none")
+    data = jnp.asarray(panel, dtype=spec.dtype)
+    T = int(data.shape[1])
+    _, outs = forward_moments(spec, jnp.asarray(params, dtype=spec.dtype),
+                              data, 0, T, engine)
+    if not bool(jnp.all(outs["ll"] > -jnp.inf)):
+        raise ValueError("replay_episodes: the filter pass over the episode "
+                         "panel failed — cannot read factor moves from a "
+                         "broken state path")
+    beta_path = np.asarray(outs["beta_upd"])  # (T, Ms)
+    shocks = []
+    for ep in episodes:
+        if len(ep) == 3:
+            start, end, name = ep
+        else:
+            (start, end), name = ep, None
+        start, end = int(start), int(end)
+        if not (0 <= start < end < T):
+            raise ValueError(
+                f"replay episode ({start}, {end}) out of range for a "
+                f"T={T} panel (need 0 <= start < end < T)")
+        shift = beta_path[end] - beta_path[start]
+        shocks.append(ShockSpec(
+            name or f"{name_prefix}_{start}_{end}",
+            beta_shift=tuple(float(v) for v in shift)))
+    if not shocks:
+        raise ValueError("replay_episodes: no episodes given")
+    return tuple(shocks)
 
 
 # ---------------------------------------------------------------------------

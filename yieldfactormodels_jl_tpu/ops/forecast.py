@@ -24,6 +24,7 @@ from jax import lax
 from ..models import kalman as K
 from ..models.kalman import state_measurement
 from ..models.specs import ModelSpec
+from ..robustness import taxonomy as tax
 
 
 def density_from_state(spec: ModelSpec, kp, beta, P, horizon: int):
@@ -67,13 +68,31 @@ def density_fan(spec: ModelSpec, kp, beta, P, shifts, vol_scales,
     shift, twist, vol regime) is ONE vmapped scan instead of S separate
     density programs.  ``shifts`` (S, Ms), ``vol_scales`` (S,); outputs gain
     a LEADING shock axis ((S, h, N) means etc — the per-cell (h, N[,N])
-    blocks stay contiguous for host consumption).  Like
-    ``density_from_state``: no failure gating here, callers own the
-    sentinel/poison policy."""
-    return jax.vmap(
-        lambda sh, vs: density_from_state(spec, kp, beta + sh,
-                                          P * (vs * vs), horizon)
-    )(shifts, vol_scales)
+    blocks stay contiguous for host consumption).
+
+    Unlike ``density_from_state`` this IS the sentinel boundary for the fan
+    axis (DESIGN §11): a shock whose displaced start (β + shift, P·vs²) is
+    non-finite, or whose recursion explodes, gets its whole fan row
+    NaN-poisoned and a per-shock taxonomy code in ``codes`` (S,) int32 —
+    never a silently propagated garbage density.  Finite rows are untouched,
+    so one poisoned shock fails alone."""
+    def one(sh, vs):
+        b0 = beta + sh
+        P0 = P * (vs * vs)
+        out = density_from_state(spec, kp, b0, P0, horizon)
+        start_ok = jnp.isfinite(b0).all() & jnp.isfinite(P0).all()
+        code = (tax.bit(~jnp.isfinite(b0).all(), tax.NAN_STATE)
+                | tax.bit(~jnp.isfinite(P0).all(), tax.NONPSD_COV)
+                | tax.bit(start_ok & ~(jnp.isfinite(out["means"]).all()
+                                       & jnp.isfinite(out["covs"]).all()),
+                          tax.STATE_EXPLODED))
+        bad = code != tax.OK
+        nan = jnp.asarray(jnp.nan, dtype=kp.Phi.dtype)
+        poisoned = {k: jnp.where(bad, nan, v) for k, v in out.items()}
+        poisoned["codes"] = code
+        return poisoned
+
+    return jax.vmap(one)(shifts, vol_scales)
 
 
 def forecast_density(spec: ModelSpec, params, data, horizon: int,

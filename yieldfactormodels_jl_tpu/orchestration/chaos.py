@@ -42,6 +42,14 @@ sheds), and ``poison_ticket`` marks one micro-batcher ticket degraded so
 the partial-failure isolation path is exercised without crafting NaN
 snapshots (serving/batcher.py).
 
+SUBSCRIPTION seams (serving/streams.py, docs/DESIGN.md §23) drill the
+streaming fan hub's refresh state machine: ``refresh_storm`` drops one
+whole delta-refresh wave — its fan lanes stay dirty and answer degraded
+from the last promoted fan until the next accepted update heals them —
+and ``fan_stale`` forces one fan answer to be served degraded, exercising
+the degrade-from-last-fan path without aging a real ``YFM_FAN_STALE_MS``
+budget.
+
 Tests and benchmarks arm programmatically via :func:`configure` /
 :func:`reset` (reset also re-reads the environment on the next hit).
 """

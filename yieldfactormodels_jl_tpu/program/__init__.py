@@ -13,12 +13,14 @@ from .compile import ProgramSpec, compile_program
 from .registry import (build_spec, lookup, register_program,
                        registered_codes, registered_programs,
                        unregister_program)
+from .shocks import ShockRule, compile_shocks
 from .spec import ModelProgram, ParamBlock
 
 from . import library  # noqa: E402,F401 — registers the shipped programs
 
 __all__ = [
-    "ModelProgram", "ParamBlock", "ProgramSpec", "compile_program",
+    "ModelProgram", "ParamBlock", "ProgramSpec", "ShockRule",
+    "compile_program", "compile_shocks",
     "register_program", "unregister_program", "registered_programs",
     "registered_codes", "lookup", "build_spec", "library",
 ]

@@ -1,0 +1,137 @@
+"""User-defined shock grammars, compiled onto the scenario engine.
+
+The PR-15 program layer (docs/DESIGN.md §22) lets users declare MODELS as
+data; this module gives SHOCKS the same treatment (DESIGN §23): a
+:class:`ShockRule` names a displacement in grammar terms — "level up 50bp",
+"this literal factor vector", "double the vol", "the sum of those two" —
+and :func:`compile_shocks` resolves the rules against a concrete
+:class:`~..models.specs.ModelSpec` into the frozen
+:class:`~..estimation.scenario.ShockSpec` tuples every fan engine
+(``scenario.stress_fan``, the fused lattice, the stream hub's delta
+refresh) already consumes.  Validation is loud and trace-free: a rule that
+names a factor the state doesn't have, or composes an unknown rule, is a
+``ValueError`` at compile time — never a silently zero-padded shock.
+
+Rule kinds:
+
+- ``factor``: displace ONE state factor by ``size`` (``factor`` is an index
+  or one of the DNS-ordering aliases ``"level"``/``"slope"``/``"curvature"``).
+- ``vector``: an explicit per-factor displacement (``vector``, length ≤
+  state dim; validated, then zero-padded).
+- ``vol``: pure covariance regime — ``vol_scale`` (with optional
+  ``sv_phi``/``sv_sigma`` for sampled-path SV, as in ``standard_fan``'s
+  vol_regime member).
+- ``combo``: the scaled sum of previously declared rules (``of`` =
+  ``((name, scale), ...)``); shifts add, vol scales multiply through their
+  scale exponents — "taper tantrum twist plus half a parallel shift" as one
+  declared scenario.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import numpy as np
+
+from ..models.specs import ModelSpec
+
+#: DNS/AFNS factor-ordering aliases (models/specs.py state layout)
+_FACTOR_ALIASES = {"level": 0, "slope": 1, "curvature": 2}
+
+
+@dataclasses.dataclass(frozen=True)
+class ShockRule:
+    """One declared scenario (frozen + hashable, like
+    :class:`~..estimation.scenario.ShockSpec` — rule tuples can key static
+    caches).  Fields are kind-specific; :func:`compile_shocks` rejects
+    mismatched ones loudly."""
+
+    name: str
+    kind: str = "factor"                      # factor | vector | vol | combo
+    factor: object = 0                        # index or alias (kind=factor)
+    size: float = 0.0                         # displacement (kind=factor)
+    vector: Tuple[float, ...] = ()            # displacement (kind=vector)
+    vol_scale: float = 1.0
+    sv_phi: float = 0.0
+    sv_sigma: float = 0.0
+    of: Tuple[Tuple[str, float], ...] = ()    # (rule name, scale) (combo)
+
+
+def _resolve_factor(rule: ShockRule, Ms: int) -> int:
+    f = rule.factor
+    if isinstance(f, str):
+        if f not in _FACTOR_ALIASES:
+            raise ValueError(
+                f"shock rule {rule.name!r}: unknown factor alias {f!r} — "
+                f"use {sorted(_FACTOR_ALIASES)} or an integer index")
+        f = _FACTOR_ALIASES[f]
+    f = int(f)
+    if not 0 <= f < Ms:
+        raise ValueError(
+            f"shock rule {rule.name!r}: factor {f} out of range for a "
+            f"{Ms}-factor state")
+    return f
+
+
+def compile_shocks(rules, spec: ModelSpec):
+    """Resolve a tuple of :class:`ShockRule` against ``spec`` into
+    :class:`~..estimation.scenario.ShockSpec` tuples (same order).  Combos
+    may only reference rules declared EARLIER in the tuple (no cycles by
+    construction); duplicate names are rejected."""
+    from ..estimation.scenario import ShockSpec
+
+    Ms = spec.state_dim
+    compiled = {}
+    out = []
+    for rule in rules:
+        if not isinstance(rule, ShockRule):
+            raise ValueError(f"compile_shocks needs ShockRule instances, "
+                             f"got {type(rule).__name__}")
+        if rule.name in compiled:
+            raise ValueError(f"duplicate shock rule name {rule.name!r}")
+        shift = np.zeros(Ms)
+        vol, phi, sig = float(rule.vol_scale), float(rule.sv_phi), \
+            float(rule.sv_sigma)
+        if rule.kind == "factor":
+            shift[_resolve_factor(rule, Ms)] = float(rule.size)
+        elif rule.kind == "vector":
+            vec = np.asarray(rule.vector, dtype=np.float64).reshape(-1)
+            if vec.shape[0] > Ms:
+                raise ValueError(
+                    f"shock rule {rule.name!r}: vector has {vec.shape[0]} "
+                    f"entries but the state has {Ms} factors")
+            shift[:vec.shape[0]] = vec
+        elif rule.kind == "vol":
+            if vol <= 0.0:
+                raise ValueError(f"shock rule {rule.name!r}: vol_scale must "
+                                 f"be > 0, got {vol}")
+        elif rule.kind == "combo":
+            if not rule.of:
+                raise ValueError(f"shock rule {rule.name!r}: a combo needs "
+                                 f"of=((name, scale), ...)")
+            vol = 1.0
+            for ref, scale in rule.of:
+                if ref not in compiled:
+                    raise ValueError(
+                        f"shock rule {rule.name!r}: combo references "
+                        f"{ref!r}, which is not declared earlier in the "
+                        f"tuple (known: {sorted(compiled)})")
+                base = compiled[ref]
+                shift += float(scale) * np.asarray(
+                    tuple(base.beta_shift) + (0.0,) * Ms)[:Ms]
+                vol *= float(base.vol_scale) ** float(scale)
+                phi = max(phi, float(base.sv_phi))
+                sig = max(sig, float(base.sv_sigma))
+        else:
+            raise ValueError(
+                f"shock rule {rule.name!r}: unknown kind {rule.kind!r} — "
+                f"use 'factor', 'vector', 'vol' or 'combo'")
+        shock = ShockSpec(rule.name,
+                          beta_shift=tuple(float(v) for v in shift),
+                          vol_scale=vol, sv_phi=phi, sv_sigma=sig)
+        compiled[rule.name] = shock
+        out.append(shock)
+    if not out:
+        raise ValueError("compile_shocks: no rules given")
+    return tuple(out)

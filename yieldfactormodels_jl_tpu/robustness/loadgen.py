@@ -291,3 +291,64 @@ def mesh_scaling(gateway_factory, curves, *,
         "scaling": round(caps[-1] / caps[0], 3) if caps and caps[0] else
         float("nan"),
     }
+
+
+@dataclasses.dataclass
+class FanLoadReport:
+    """One streaming-subscription load run (DESIGN §23): sustained fan
+    answers per second over a stream of accepted online updates, the
+    per-update refresh wall (update + delta wave, p50/p99), answer-time
+    staleness p99, and the degraded-answer rate."""
+
+    updates: int
+    subscriptions: int
+    fans: int               # fan answers collected (updates × subscriptions)
+    wall_s: float
+    fans_per_s: float
+    refresh_p50_ms: float   # accepted update + its delta-refresh wave
+    refresh_p99_ms: float
+    stale_p99_ms: float     # answer-time age of the promoted fan
+    degraded: int
+
+    @property
+    def degraded_rate(self) -> float:
+        return self.degraded / self.fans if self.fans else 0.0
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["degraded_rate"] = round(self.degraded_rate, 6)
+        return d
+
+
+def run_fan_load(hub, service, curves, dates) -> FanLoadReport:
+    """Drive a :class:`~..serving.streams.ScenarioStreamHub` over ``service``
+    with one accepted update per (date, curve) and collect EVERY
+    subscription's fan answer after each — closed loop, the caller's thread
+    is the update path, so the refresh wall includes exactly what a live
+    subscriber waits on.  The full-recompute baseline this is compared
+    against (``bench.py --load-fan-bench``) replaces the hub answers with
+    per-subscription ``stress_fan`` recomputes over the same stream."""
+    refresh_s, ages = [], []
+    fans = degraded = 0
+    keys = hub.subscriptions()
+    t_start = time.perf_counter()
+    for date, curve in zip(dates, curves):
+        t0 = time.perf_counter()
+        service.update(date, curve)
+        refresh_s.append(time.perf_counter() - t0)
+        for key in keys:
+            ans = hub.fan(key)
+            fans += 1
+            degraded += bool(ans["degraded"])
+            if ans["age_ms"] is not None:
+                ages.append(ans["age_ms"] / 1e3)
+    wall = time.perf_counter() - t_start
+    _, r99, _ = _percentiles_ms(refresh_s)
+    r50 = _percentiles_ms(refresh_s)[0]
+    _, a99, _ = _percentiles_ms(ages)
+    return FanLoadReport(
+        updates=len(refresh_s), subscriptions=len(keys), fans=fans,
+        wall_s=round(wall, 4),
+        fans_per_s=round(fans / wall, 2) if wall else 0.0,
+        refresh_p50_ms=round(r50, 3), refresh_p99_ms=round(r99, 3),
+        stale_p99_ms=round(a99, 3), degraded=degraded)

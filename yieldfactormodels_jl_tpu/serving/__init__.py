@@ -13,7 +13,10 @@ donated micro-batch updates (``store``, ``ShardedGateway``;
 docs/DESIGN.md §16) — extended past HBM by the tiered residency hierarchy:
 hot device slots / packed warm host records / cold snapshot registry with
 LRU promotion-on-miss, batched promotion waves, a capacity ledger, and the
-multi-store fleet seam (``tiers``; docs/DESIGN.md §21).
+multi-store fleet seam (``tiers``; docs/DESIGN.md §21) — and the streaming
+subscription layer on top: standing per-user stress-fan subscriptions,
+device-resident next to the filter state, delta-refreshed in one donated
+wave per accepted update (``streams``; docs/DESIGN.md §23).
 """
 
 from .batcher import (BucketLattice, DEFAULT_LATTICE, ForecastRequest,
@@ -26,10 +29,13 @@ from .snapshot import (ServingError, ServingSnapshot, SnapshotMeta,
                        SnapshotRegistry, freeze_snapshot,
                        freeze_snapshots_batch, load_snapshot)
 from .store import ShardedStateStore
+from .streams import FanCounters, ScenarioStreamHub
 from .tiers import StoreFleet, TieredStateStore, TierLedger, WarmTier
 
 __all__ = [
     "BucketLattice",
+    "FanCounters",
+    "ScenarioStreamHub",
     "ShardedGateway",
     "ShardedStateStore",
     "StoreFleet",

@@ -507,6 +507,16 @@ class ShardedGateway(ServingGateway):
     def __init__(self, store, **kwargs):
         super().__init__(store, **kwargs)
         self.store = store
+        self._hub = None
+
+    def attach_hub(self, hub) -> None:
+        """Wire a :class:`~.streams.ScenarioStreamHub` into the pump: every
+        cycle's ACCEPTED update keys are reported through
+        ``hub.notify_updated`` (one delta-refresh wave per touched fan
+        block) and a published refit through ``hub.notify_refit`` (full
+        recompute — the delta chain is not honest across a parameter
+        change).  ``ScenarioStreamHub(gateway)`` calls this itself."""
+        self._hub = hub
 
     # ---- key-addressed admission -----------------------------------------
 
@@ -544,6 +554,7 @@ class ShardedGateway(ServingGateway):
             outs = store.update_batch(
                 [(r.payload[0], r.payload[2]) for r in reqs],
                 dates=[r.payload[1] for r in reqs])
+        accepted = []
         for req, out in zip(reqs, outs):
             if "error" in out:
                 self.counters.errors += 1
@@ -554,6 +565,11 @@ class ShardedGateway(ServingGateway):
             else:
                 self.counters.completed += 1
                 self._finish(req.ticket, {"kind": "update", **out})
+                accepted.append(req.payload[0])
+        if self._hub is not None and accepted:
+            # one delta-refresh wave per touched fan block (streams.py) —
+            # key routing + a donated device launch, no host transfer here
+            self._hub.notify_updated(accepted)
 
     def _prepare_batch(self, run_updates: List[_Pending],
                        run_batched: List[_Pending]) -> None:
@@ -616,6 +632,10 @@ class ShardedGateway(ServingGateway):
                 self.counters.errors += 1
                 return {"error": e}
             self.counters.completed += 1
+            if self._hub is not None:
+                # the key's params moved: its standing fan must recompute
+                # from scratch (delta refresh is not honest across a refit)
+                self._hub.notify_refit([key])
             return {"kind": "refit", "key": key, "ll": float(ll), **out}
 
         req = _Pending(-1, "refit", (key, None), self._clock(), None)

@@ -36,6 +36,7 @@ updates-since-refresh, rebuild count, last decoded failure).
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Optional, Tuple
 
 import jax.numpy as jnp
@@ -127,6 +128,31 @@ class YieldCurveService:
         self._set_snapshot(snapshot)
         self._bank_last_good()
         self.last_update = None  # date of the last accepted update
+        # update-event listeners (serving/streams.py subscribes here): each
+        # accepted update / rebuild / refit fires every registered callback
+        self._listeners = []
+
+    # ---- update-event listeners (docs/DESIGN.md §23) ----------------------
+
+    def add_update_listener(self, fn) -> None:
+        """Register ``fn(event: str)`` to fire after every state change:
+        ``"update"`` (accepted online update — the delta-refresh trigger),
+        ``"rebuild"`` (re-filter or §11 heal — the state moved without a
+        parameter change) or ``"refit"`` (new parameters; standing consumers
+        must recompute from scratch).  The scenario stream hub
+        (:class:`~.streams.ScenarioStreamHub`) is the first consumer."""
+        self._listeners.append(fn)
+
+    def _notify(self, event: str) -> None:
+        """Fire the registered listeners; a listener failure must NEVER
+        break the update path (worker-isolation contract, DESIGN §12) — the
+        exception is swallowed, the listener's own health machinery owns
+        reporting it."""
+        for fn in self._listeners:
+            try:
+                fn(event)
+            except Exception:  # noqa: BLE001 — isolation: fail alone
+                pass
 
     # ---- state plumbing ---------------------------------------------------
 
@@ -244,6 +270,9 @@ class YieldCurveService:
             self._heal_state(force=force_restore)
         self.stale = True
         self._last_code = int(code)
+        # the state may have been rebuilt under the heal — standing consumers
+        # (stream hub fans) must not keep serving deltas off a moved base
+        self._notify("rebuild")
         if self.self_heal:
             return
         raise ServingError(stage, detail, code=tax.describe(code), **context)
@@ -358,6 +387,7 @@ class YieldCurveService:
         self._last_code = code
         self.last_update = date
         self._maybe_refresh()
+        self._notify("update")
         return float(ll)
 
     def update_many(self, date, curves) -> np.ndarray:
@@ -405,6 +435,7 @@ class YieldCurveService:
         self.stale = False
         self.last_update = date
         self._maybe_refresh(int(Y.shape[1]))  # k accepted steps count too
+        self._notify("update")
         return np.asarray(lls)
 
     def refilter(self, history, date=None) -> float:
@@ -489,6 +520,7 @@ class YieldCurveService:
         if date is not None:
             self.last_update = date
         self._updates_since_refresh = 0
+        self._notify("rebuild")
         return float(ll)
 
     def refit(self, history, *, amortizer=None, polish_iters: int = 1,
@@ -588,6 +620,7 @@ class YieldCurveService:
         if date is not None:
             self.last_update = date
         self._updates_since_refresh = 0
+        self._notify("refit")
         return float(ll)
 
     def forecast(self, h: int, quantiles: Optional[Tuple[float, ...]] = None
@@ -638,6 +671,10 @@ class YieldCurveService:
             "scenarios", out, "paths",
             lambda: self._run_again(ScenarioRequest(int(n), int(h),
                                                     int(seed))))
+        # cache-coherence metadata (DESIGN §23): which snapshot answered,
+        # and when — the stream hub's staleness stamps build on these
+        out["version"] = self.version
+        out["computed_at"] = time.time()
         return out
 
     def stress_fan(self, shocks="standard", n: int = 0, h: int = 12,
@@ -672,6 +709,7 @@ class YieldCurveService:
             res = {k: np.asarray(v) for k, v in out.items()}
             res["names"] = tuple(s.name for s in shocks)
             res["version"] = self.version
+            res["computed_at"] = time.time()
             return res
 
         with self.timer.stage("scenarios"):

@@ -1,0 +1,674 @@
+"""Streaming scenario subscriptions: resident stress fans with delta
+refresh (docs/DESIGN.md §23).
+
+Every stress fan before this module was recomputed from scratch per request
+(``YieldCurveService.stress_fan`` → one ``scenario._jitted_fan`` launch per
+call).  A :class:`ScenarioStreamHub` turns the fan into a STANDING product:
+``subscribe(key, shocks=...)`` allocates a fan slot whose density fan lives
+device-resident next to the filter state, and every ACCEPTED online update
+triggers a **delta refresh** — one donated, compile-once
+:func:`_jitted_fan_refresh` launch that re-runs the
+``ops/forecast.density_fan`` recursion from the NEW posterior for ALL of a
+block's dirty fans at once, the subscription (lane) axis riding the TPU lane
+dimension.  Refit/rebuild/version breaks fall back to a full
+``scenario.stress_fan`` recompute per subscription (the honest path when the
+parameters themselves moved).
+
+Fan-slot lifecycle (one ``_FanBlock`` per (spec, shocks, horizon) shape
+bucket, slot machinery generalized from ``serving/store.py``/``tiers.py``):
+
+    subscribe → slot allocated (free-list pop), lane marked DIRTY
+    update    → dirty lanes refreshed in ONE donated wave; each refreshed
+                lane records a PENDING (version, time) attempt
+    answer    → the pending attempt settles host-side: the kernel's
+                ``refreshed`` flag promotes it to the GOOD stamp, or parks
+                the lane DEGRADED (the kernel kept the old fan — in-kernel
+                degrade-from-last-fan, which is also what makes the donated
+                buffers aliasable); answers past the ``YFM_FAN_STALE_MS``
+                budget are stale-flagged and counted degraded instead of
+                ever blocking the update path (§12 discipline)
+    unsubscribe → slot back on the free list (buffer rows are inert)
+
+Donation table (the §14 value-use rule — every donated buffer's values flow
+into the same-shaped output that aliases it):
+
+    means (S, h, N, C)    → kept-or-refreshed means   (donated)
+    covs  (S, h, N, N, C) → kept-or-refreshed covs    (donated)
+    codes (S, C) / refreshed (C,) are small and NOT donated.
+
+Chaos seams (orchestration/chaos.py): ``refresh_storm`` drops one whole
+refresh wave — its lanes stay dirty and answer degraded until the next
+update heals them; ``fan_stale`` forces one answer to be served degraded
+from the last promoted fan.  Both are exercised by tests/test_streams.py
+and the ``load-fan-bench`` harness.
+
+Threading: ONE hub lock guards all slot metadata AND every device launch /
+answer materialization — the donated wave consumes the fan buffers, so an
+answer's slice must never race a wave's donation.  The hub subscribes to
+``YieldCurveService.add_update_listener`` (service mode) or is attached to
+a :class:`~.gateway.ShardedGateway` (``attach_hub`` — store mode, per-key
+dirty marking through :meth:`notify_updated`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from functools import lru_cache
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import make_trace_counter, register_engine_cache
+from ..models.specs import ModelSpec
+from ..orchestration import chaos
+from ..robustness import taxonomy as tax
+from .snapshot import ServingError
+
+# trace counters (config.make_trace_counter): incremented INSIDE traced
+# bodies — the no-recompile tests pin trace_counts["fan_refresh"] == 1
+# across whole subscribe/update/answer lifecycles
+trace_counts, note_trace, reset_trace_counts = make_trace_counter()
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "")
+    return float(raw) if raw else default
+
+
+# ---------------------------------------------------------------------------
+# the delta-refresh program
+# ---------------------------------------------------------------------------
+
+def refresh_signature(spec: ModelSpec, n_shocks: int, horizon: int,
+                      capacity: int, shared: bool = False) -> Dict[str, tuple]:
+    """The (shape, dtype) staging signature of one :func:`_jitted_fan_refresh`
+    launch — the SINGLE source both the hub's buffer allocation and the
+    IR-audit manifest avals build from (staging parity: a second shape
+    recipe is how warmup/live retrace mismatches are born).  ``shared`` is
+    the service-mode variant: every lane refreshes from the SAME posterior,
+    so params/beta/P stage unbatched and the lane broadcast lives inside
+    the kernel (zero staging dispatches on the per-update hot path)."""
+    dt = jnp.dtype(spec.dtype)
+    lane = () if shared else (capacity,)
+    return {
+        "params": ((spec.n_params,) + lane, dt),
+        "beta": ((spec.state_dim,) + lane, dt),
+        "P": ((spec.state_dim, spec.state_dim) + lane, dt),
+        "active": ((capacity,), jnp.dtype(bool)),
+        "means": ((n_shocks, horizon, spec.N, capacity), dt),
+        "covs": ((n_shocks, horizon, spec.N, spec.N, capacity), dt),
+        "codes": ((n_shocks, capacity), jnp.dtype(tax.CODE_DTYPE)),
+        "refreshed": ((capacity,), jnp.dtype(bool)),
+    }
+
+
+@register_engine_cache
+@lru_cache(maxsize=16)
+def _jitted_fan_refresh(spec: ModelSpec, shocks: tuple, horizon: int,
+                        capacity: int, shared: bool = False):
+    """ONE donated delta-refresh program for a whole fan block:
+
+        (params (P, C), beta (Ms, C), P (Ms, Ms, C), active (C,),
+         means (S, h, N, C) DONATED, covs (S, h, N, N, C) DONATED,
+         codes (S, C) int32, refreshed (C,) bool)
+            → (means', covs', codes', refreshed')
+
+    Per ACTIVE lane the ``density_fan`` recursion re-runs from that lane's
+    new posterior; a lane whose fan comes back poisoned (non-zero combined
+    taxonomy code) KEEPS its previous fan values in-kernel — the
+    degrade-from-last-fan policy is part of the program, which is exactly
+    what lets the big buffers be donated (kept-old values flow through to
+    the aliased outputs).  Inactive lanes pass everything through untouched.
+    ``refreshed`` reports, per lane, whether THIS wave's values were taken.
+    The subscription axis C rides the TPU lanes (batch-last rule).
+
+    ``shared=True`` is the service-mode program: ONE live posterior feeds
+    every lane, so params (P,) / beta (Ms,) / P (Ms, Ms) arrive unbatched
+    (zero staging dispatches per update — the service's snapshot leaves go
+    straight in) and the fan computes ONCE, broadcast across the lane axis
+    in-kernel."""
+    from ..estimation.scenario import _shock_arrays
+    from ..models.params import unpack_kalman
+    from ..ops.forecast import density_fan
+
+    def one_fan(params, beta, P):
+        kp = unpack_kalman(spec, params)
+        shifts, vols, _, _ = _shock_arrays(shocks, spec.state_dim,
+                                           beta.dtype)
+        return density_fan(spec, kp, beta, P, shifts, vols, horizon)
+
+    if shared:
+        def refresh(params, beta, P, active, means, covs, codes, refreshed):
+            note_trace("fan_refresh")
+            out = one_fan(params, beta, P)
+            use = active & (tax.combine(out["codes"]) == tax.OK)   # (C,)
+            m = jnp.where(use, out["means"][..., None], means)
+            c = jnp.where(use, out["covs"][..., None], covs)
+            new_codes = jnp.where(active, out["codes"][:, None], codes)
+            refr = jnp.where(active, use, refreshed)
+            return m, c, new_codes, refr
+
+        return jax.jit(refresh, donate_argnums=(4, 5))
+
+    def lane(params, beta, P, act, m_old, c_old, code_old, refr_old):
+        out = one_fan(params, beta, P)
+        use = act & (tax.combine(out["codes"]) == tax.OK)
+        m = jnp.where(use, out["means"], m_old)
+        c = jnp.where(use, out["covs"], c_old)
+        codes = jnp.where(act, out["codes"], code_old)
+        refr = jnp.where(act, use, refr_old)
+        return m, c, codes, refr
+
+    over_lanes = jax.vmap(lane, in_axes=(-1, -1, -1, 0, -1, -1, -1, 0),
+                          out_axes=(-1, -1, -1, 0))
+
+    def refresh(params, beta, P, active, means, covs, codes, refreshed):
+        note_trace("fan_refresh")
+        return over_lanes(params, beta, P, active, means, covs, codes,
+                          refreshed)
+
+    return jax.jit(refresh, donate_argnums=(4, 5))
+
+
+# ---------------------------------------------------------------------------
+# fan blocks: slot-addressed resident fan state
+# ---------------------------------------------------------------------------
+
+class _FanBlock:
+    """One (spec, shocks, horizon) shape bucket of resident fan slots —
+    device buffers in the refresh program's staging layout plus per-lane
+    host metadata.  All access runs under the hub lock."""
+
+    def __init__(self, spec: ModelSpec, shocks: tuple, horizon: int,
+                 capacity: int):
+        self.spec, self.shocks, self.horizon = spec, shocks, horizon
+        self.names = tuple(s.name for s in shocks)
+        self.capacity = 0
+        self.keys: List[object] = []
+        self.slot_of: Dict[object, int] = {}
+        self.free: List[int] = []
+        self.dirty: List[bool] = []
+        self.pending: List[Optional[tuple]] = []   # (version, attempt_time)
+        self.good: List[Optional[tuple]] = []      # (version, computed_at)
+        self.degraded: List[bool] = []
+        sig = refresh_signature(spec, len(shocks), horizon, capacity)
+        self.means = jnp.zeros(*sig["means"])
+        self.covs = jnp.zeros(*sig["covs"])
+        self.codes = jnp.zeros(*sig["codes"])
+        self.refreshed = jnp.zeros(*sig["refreshed"])
+        # host-side answer cache: ONE bulk materialization per wave (lazy,
+        # at the first answer — the response boundary), then every
+        # subscriber's answer is a NumPy slice.  None = invalidated by the
+        # last wave/recompute/grow.
+        self.host: Optional[dict] = None
+        # active-mask cache: the wave's (C,) lane mask is keyed on the
+        # dirty-lane tuple (usually "all subscribed"), so steady-state
+        # waves stage it with zero device dispatches
+        self._masks: Dict[tuple, object] = {}
+        self._grow_meta(capacity)
+
+    def _grow_meta(self, new_capacity: int) -> None:
+        pad = new_capacity - self.capacity
+        self.free.extend(reversed(range(self.capacity, new_capacity)))
+        self.keys.extend([None] * pad)
+        self.dirty.extend([False] * pad)
+        self.pending.extend([None] * pad)
+        self.good.extend([None] * pad)
+        self.degraded.extend([False] * pad)
+        self.capacity = new_capacity
+
+    def grow(self) -> None:
+        """Double the lane capacity: zero-pad every buffer on the lane axis.
+        The refresh program is keyed on capacity, so the NEXT wave retraces
+        once at the new width (documented cost of an overflowing block —
+        size the initial ``capacity`` at the expected subscriber count)."""
+        new_capacity = max(1, self.capacity) * 2
+        pad = new_capacity - self.capacity
+
+        def widen(buf):
+            return jnp.concatenate(
+                [buf, jnp.zeros(buf.shape[:-1] + (pad,), dtype=buf.dtype)],
+                axis=-1)
+
+        self.means = widen(self.means)
+        self.covs = widen(self.covs)
+        self.codes = widen(self.codes)
+        self.refreshed = widen(self.refreshed)
+        self.host = None
+        self._masks.clear()
+        self._grow_meta(new_capacity)
+
+    def active_dirty(self) -> List[int]:
+        return [i for i in range(self.capacity)
+                if self.keys[i] is not None and self.dirty[i]]
+
+
+@dataclasses.dataclass
+class FanCounters:
+    """Subscription-path outcome counters, surfaced by ``hub.health()``
+    (the §12 one-operator-report convention).  ``refreshes`` counts LANES
+    delta-refreshed (a wave of k dirty fans is one launch, k refreshes);
+    ``dropped_waves`` counts ``refresh_storm`` hits — their lanes answer
+    degraded until the next update heals them."""
+
+    subscribed: int = 0
+    waves: int = 0
+    refreshes: int = 0
+    full_recomputes: int = 0
+    dropped_waves: int = 0
+    answers: int = 0
+    degraded_answers: int = 0
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+# ---------------------------------------------------------------------------
+# the hub
+# ---------------------------------------------------------------------------
+
+class ScenarioStreamHub:
+    """Standing per-user scenario subscriptions over one serving source.
+
+    ``source`` is either a :class:`~.service.YieldCurveService` (the hub
+    registers itself as an update listener: every accepted update delta-
+    refreshes every subscription; re-filter/refit events trigger the full
+    recompute path) or a :class:`~.gateway.ShardedGateway` /
+    :class:`~.store.ShardedStateStore` (per-key dirty marking through
+    :meth:`notify_updated`, wired by ``ShardedGateway.attach_hub``).
+
+    ``stale_ms`` is the fan staleness budget (``YFM_FAN_STALE_MS`` when
+    None; 0 = no budget): an answer whose promoted fan is older is served
+    anyway — stale-flagged and counted degraded — never recomputed inline
+    on the answer path.  ``capacity`` sizes each fan block's initial lane
+    count (blocks double on overflow, one retrace per doubling).  ``clock``
+    is injectable (monotonic seconds) so staleness is testable without
+    wall-clock sleeps."""
+
+    def __init__(self, source, *, stale_ms: Optional[float] = None,
+                 capacity: int = 8, clock=time.monotonic):
+        self.stale_ms = float(
+            stale_ms if stale_ms is not None
+            else _env_float("YFM_FAN_STALE_MS", 0.0))
+        self.capacity = int(capacity)
+        if self.capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {self.capacity}")
+        self.clock = clock
+        self.counters = FanCounters()
+        self._lock = threading.Lock()
+        self._blocks: Dict[tuple, _FanBlock] = {}
+        self._sub_block: Dict[object, tuple] = {}   # key → block key
+        self.service = None
+        self.store = None
+        if hasattr(source, "add_update_listener"):
+            self.service = source
+            source.add_update_listener(self._on_service_event)
+        elif hasattr(source, "attach_hub"):
+            self.store = source.store
+            source.attach_hub(self)
+        elif hasattr(source, "snapshot_of"):
+            self.store = source
+        else:
+            raise ServingError(
+                "streams", f"unsupported subscription source "
+                f"{type(source).__name__} — need a YieldCurveService, a "
+                f"ShardedGateway or a sharded state store")
+
+    # ---- subscription lifecycle ------------------------------------------
+
+    def subscribe(self, key, shocks="standard", horizon: int = 12):
+        """Open a standing fan subscription for ``key``: allocate a lane in
+        the (spec, shocks, horizon) block and fill it with an initial
+        refresh wave (the same compile-once program every later delta
+        refresh uses).  ``shocks`` is ``"standard"``, a tuple of
+        :class:`~..estimation.scenario.ShockSpec` (including
+        ``replay_episodes`` output), or a tuple of
+        :class:`~..program.shocks.ShockRule` grammar rules (compiled via
+        ``program.shocks.compile_shocks``).  Returns ``key``."""
+        from ..estimation.scenario import ShockSpec, standard_fan
+
+        with self._lock:
+            if key in self._sub_block:
+                raise ServingError("streams", f"key {key!r} already has a "
+                                   f"subscription — unsubscribe first",
+                                   key=key)
+            spec = self._spec_for(key)
+            if isinstance(shocks, str):
+                if shocks != "standard":
+                    raise ServingError(
+                        "streams", f"unknown shock fan {shocks!r} — pass "
+                        f"'standard', ShockSpec tuples or ShockRule "
+                        f"grammar rules", key=key)
+                shocks = standard_fan(spec)
+            shocks = tuple(shocks)
+            if shocks and not all(isinstance(s, ShockSpec) for s in shocks):
+                from ..program.shocks import ShockRule, compile_shocks
+
+                if all(isinstance(s, ShockRule) for s in shocks):
+                    shocks = compile_shocks(shocks, spec)
+                else:
+                    raise ServingError(
+                        "streams", "shocks must be ShockSpec instances or "
+                        "ShockRule grammar rules (not a mix)", key=key)
+            if not shocks:
+                raise ServingError("streams", "a subscription needs at "
+                                   "least one shock", key=key)
+            if int(horizon) < 1:
+                raise ServingError("streams",
+                                   f"horizon must be >= 1, got {horizon}",
+                                   key=key)
+            bkey = (spec, shocks, int(horizon))
+            block = self._blocks.get(bkey)
+            if block is None:
+                block = _FanBlock(spec, shocks, int(horizon), self.capacity)
+                self._blocks[bkey] = block
+            if not block.free:
+                block.grow()
+            slot = block.free.pop()
+            block.keys[slot] = key
+            block.slot_of[key] = slot
+            block.dirty[slot] = True
+            block.pending[slot] = None
+            block.good[slot] = None
+            block.degraded[slot] = False
+            self._sub_block[key] = bkey
+            self.counters.subscribed += 1
+            self._refresh_wave(block)   # initial fill, same program
+        return key
+
+    def unsubscribe(self, key) -> None:
+        with self._lock:
+            bkey = self._sub_block.pop(key, None)
+            if bkey is None:
+                raise ServingError("streams", f"no subscription for {key!r}",
+                                   key=key)
+            block = self._blocks[bkey]
+            slot = block.slot_of.pop(key)
+            block.keys[slot] = None
+            block.dirty[slot] = False
+            block.pending[slot] = None
+            block.good[slot] = None
+            block.degraded[slot] = False
+            block.free.append(slot)   # buffer rows are inert until reuse
+            self.counters.subscribed -= 1
+
+    def subscriptions(self) -> tuple:
+        with self._lock:
+            return tuple(self._sub_block)
+
+    # ---- source plumbing --------------------------------------------------
+
+    def _spec_for(self, key) -> ModelSpec:
+        if self.service is not None:
+            return self.service.snapshot.spec
+        if hasattr(self.store, "spec_for"):
+            return self.store.spec_for(key)
+        return self.store.spec
+
+    def _snapshot_for(self, key):
+        """The key's CURRENT posterior — device leaves for the store path
+        (``snapshot_of``), the service's live snapshot otherwise."""
+        if self.service is not None:
+            return self.service.snapshot
+        return self.store.snapshot_of(key)
+
+    def _on_service_event(self, event: str) -> None:
+        """Service-mode listener: accepted updates delta-refresh every
+        subscription; rebuild/refit events invalidate the delta chain and
+        fall back to the full ``stress_fan`` recompute."""
+        with self._lock:
+            if event == "update":
+                for block in self._blocks.values():
+                    self._mark_dirty_block(block)
+                    self._refresh_wave(block)
+            else:   # "rebuild" | "refit": the base state/params moved
+                for block in self._blocks.values():
+                    lanes = [i for i in range(block.capacity)
+                             if block.keys[i] is not None]
+                    self._full_recompute(block, lanes)
+
+    def notify_updated(self, keys) -> None:
+        """Store-mode dirty marking: the gateway pump reports this cycle's
+        ACCEPTED update keys; their fans delta-refresh in one wave per
+        touched block.  Pure key routing + device launches — no host
+        transfer on this path (YFM008)."""
+        with self._lock:
+            touched = self._mark_dirty(keys)
+            for block in touched:
+                self._refresh_wave(block)
+
+    def notify_refit(self, keys) -> None:
+        """Store-mode refit/version-break notification: the named keys'
+        fans recompute from scratch (delta refresh is not an honest answer
+        when the parameters themselves moved)."""
+        with self._lock:
+            for key in keys:
+                bkey = self._sub_block.get(key)
+                if bkey is None:
+                    continue
+                block = self._blocks[bkey]
+                self._full_recompute(block, [block.slot_of[key]])
+
+    def _mark_dirty(self, keys) -> List[_FanBlock]:
+        touched: List[_FanBlock] = []
+        for key in keys:
+            bkey = self._sub_block.get(key)
+            if bkey is None:
+                continue
+            block = self._blocks[bkey]
+            block.dirty[block.slot_of[key]] = True
+            if block not in touched:
+                touched.append(block)
+        return touched
+
+    def _mark_dirty_block(self, block: _FanBlock) -> None:
+        for i in range(block.capacity):
+            if block.keys[i] is not None:
+                block.dirty[i] = True
+
+    # ---- the refresh state machine ----------------------------------------
+
+    def _refresh_wave(self, block: _FanBlock) -> int:
+        """Delta-refresh every dirty lane of ``block`` in ONE donated
+        launch.  Runs under the hub lock; device-side only (the pending →
+        good promotion reads device flags at ANSWER time, never here —
+        YFM008 routing hygiene).  A ``refresh_storm`` chaos hit drops the
+        whole wave: its lanes stay dirty and answer degraded until the
+        next update retries them."""
+        lanes = block.active_dirty()
+        if not lanes:
+            return 0
+        if chaos.should_inject("refresh_storm"):
+            self.counters.dropped_waves += 1
+            return 0
+        params, beta, P, active, versions = self._stage_wave(block, lanes)
+        fn = _jitted_fan_refresh(block.spec, block.shocks, block.horizon,
+                                 block.capacity,
+                                 shared=self.service is not None)
+        block.means, block.covs, block.codes, block.refreshed = fn(
+            params, beta, P, active, block.means, block.covs, block.codes,
+            block.refreshed)
+        block.host = None   # answers re-materialize at the next fan()
+        now = self.clock()
+        for i, v in zip(lanes, versions):
+            block.dirty[i] = False
+            block.pending[i] = (v, now)
+        self.counters.waves += 1
+        self.counters.refreshes += len(lanes)
+        return len(lanes)
+
+    def _stage_wave(self, block: _FanBlock, lanes: List[int]):
+        """Stage one wave's posterior inputs in the refresh program's
+        layout (``refresh_signature`` — lane axis LAST).  Device-side:
+        service mode hands the one live posterior's leaves straight to the
+        ``shared`` program (zero staging dispatches); store mode stacks
+        each key's mesh-resident ``snapshot_of`` leaves (device slices, no
+        host gather — YFM008)."""
+        C = block.capacity
+        active = block._masks.get(tuple(lanes))
+        if active is None:
+            mask = np.zeros((C,), dtype=bool)
+            mask[lanes] = True
+            active = block._masks[tuple(lanes)] = jnp.asarray(mask)
+        dt = block.spec.dtype
+        if self.service is not None:
+            # shared-posterior program: the snapshot's leaves go straight
+            # in, unbatched — the lane broadcast happens in-kernel
+            snap = self.service.snapshot
+            params = jnp.asarray(snap.params, dtype=dt)
+            beta = jnp.asarray(snap.beta, dtype=dt)
+            P = jnp.asarray(snap.P, dtype=dt)
+            versions = [snap.meta.version] * len(lanes)
+            return params, beta, P, active, versions
+        snaps = {i: self.store.snapshot_of(block.keys[i]) for i in lanes}
+        fill = snaps[lanes[0]]
+        cols = [snaps.get(i, fill) for i in range(C)]
+        # the store's snapshots are committed to their shard's device;
+        # re-pin the staged wave next to the block buffers (a device-side
+        # copy, not a host gather) so the donated launch sees one device
+        dev = next(iter(block.refreshed.devices()))
+        params = jax.device_put(
+            jnp.stack([jnp.asarray(s.params, dtype=dt) for s in cols],
+                      axis=-1), dev)
+        beta = jax.device_put(
+            jnp.stack([jnp.asarray(s.beta, dtype=dt) for s in cols],
+                      axis=-1), dev)
+        P = jax.device_put(
+            jnp.stack([jnp.asarray(s.P, dtype=dt) for s in cols], axis=-1),
+            dev)
+        versions = [snaps[i].meta.version for i in lanes]
+        return params, beta, P, active, versions
+
+    def _full_recompute(self, block: _FanBlock, lanes: List[int]) -> int:
+        """The fallback when the delta chain breaks (refit, §11 rebuild,
+        version break): a from-scratch ``scenario.stress_fan`` per lane,
+        written back into the block's resident buffers.  Deliberately the
+        expensive path — one driver launch per subscription — which is
+        exactly what the delta refresh exists to avoid on the per-update
+        hot path (the ``load-fan-bench`` ratio)."""
+        from ..estimation.scenario import stress_fan
+
+        done = 0
+        for i in lanes:
+            key = block.keys[i]
+            if key is None:
+                continue
+            snap = self._snapshot_for(key)
+            out = stress_fan(block.spec, snap.params, snap.beta, snap.P,
+                             block.shocks, block.horizon, 0)
+            codes = np.asarray(out["codes"])
+            ok = int(np.bitwise_or.reduce(codes)) == tax.OK
+            block.host = None
+            block.dirty[i] = False
+            block.pending[i] = None
+            if ok:
+                block.means = block.means.at[..., i].set(out["means"])
+                block.covs = block.covs.at[..., i].set(out["covs"])
+                block.codes = block.codes.at[:, i].set(out["codes"])
+                block.refreshed = block.refreshed.at[i].set(True)
+                block.good[i] = (snap.meta.version, self.clock())
+                block.degraded[i] = False
+            else:
+                # poisoned recompute: keep the last fan, answer degraded
+                block.codes = block.codes.at[:, i].set(out["codes"])
+                block.degraded[i] = True
+            done += 1
+        self.counters.full_recomputes += done
+        return done
+
+    # ---- answers ----------------------------------------------------------
+
+    def _materialize(self, block: _FanBlock) -> dict:
+        """The block's host-side answer cache: ONE bulk device→host
+        materialization per wave, built lazily at the first answer after the
+        wave invalidated it (this is the response boundary — the routing
+        functions above never transfer).  Every subscriber's answer then
+        costs a NumPy slice, not a device dispatch."""
+        if block.host is None:
+            block.host = {
+                "means": np.asarray(block.means),
+                "covs": np.asarray(block.covs),
+                "codes": np.asarray(block.codes),
+                "refreshed": np.asarray(block.refreshed),
+            }
+        return block.host
+
+    def fan(self, key) -> dict:
+        """The subscription's current fan answer: per-shock predictive
+        densities (``means`` (S, h, N), ``covs`` (S, h, N, N)), shock
+        ``names``, per-shock taxonomy ``codes``, and the coherence stamps —
+        ``version`` (the source snapshot the fan was computed from),
+        ``computed_at``/``age_ms``, ``stale`` (past the ``YFM_FAN_STALE_MS``
+        budget) and ``degraded`` (served from the last promoted fan: a
+        dropped/failed refresh, a poisoned recompute, or a ``fan_stale``
+        chaos hit).  This is the response boundary: the pending refresh
+        attempt settles here against the materialized ``refreshed`` flags,
+        and the whole block's buffers come host-side in ONE lazy bulk
+        transfer per wave (:meth:`_materialize`, under the hub lock so it
+        can never race a donating wave) — each answer is then a NumPy
+        slice, not a device dispatch."""
+        with self._lock:
+            bkey = self._sub_block.get(key)
+            if bkey is None:
+                raise ServingError("streams",
+                                   f"no subscription for {key!r}", key=key)
+            block = self._blocks[bkey]
+            slot = block.slot_of[key]
+            host = self._materialize(block)
+            if block.pending[slot] is not None:
+                if bool(host["refreshed"][slot]):
+                    block.good[slot] = block.pending[slot]
+                    block.degraded[slot] = False
+                else:
+                    # the wave ran but the kernel kept the old fan
+                    # (poisoned posterior) — degrade-from-last-fan
+                    block.degraded[slot] = True
+                block.pending[slot] = None
+            degraded = block.degraded[slot] or block.dirty[slot]
+            if chaos.should_inject("fan_stale"):
+                degraded = True
+            good = block.good[slot]
+            version, computed_at = good if good is not None else (-1, None)
+            age_ms = None if computed_at is None \
+                else (self.clock() - computed_at) * 1e3
+            stale = bool(self.stale_ms and age_ms is not None
+                         and age_ms > self.stale_ms)
+            out = {
+                "key": key,
+                "names": block.names,
+                "means": host["means"][..., slot].copy(),
+                "covs": host["covs"][..., slot].copy(),
+                "codes": host["codes"][:, slot].copy(),
+                "version": version,
+                "computed_at": computed_at,
+                "age_ms": age_ms,
+                "stale": stale,
+                "degraded": bool(degraded or stale),
+            }
+            self.counters.answers += 1
+            if out["degraded"]:
+                self.counters.degraded_answers += 1
+            return out
+
+    # ---- observability ----------------------------------------------------
+
+    def health(self) -> dict:
+        """The subscription-layer health report: outcome counters plus
+        per-block occupancy — one report next to ``service.health()``."""
+        with self._lock:
+            blocks = [{
+                "shocks": b.names,
+                "horizon": b.horizon,
+                "capacity": b.capacity,
+                "subscribed": len(b.slot_of),
+                "dirty": sum(1 for i in range(b.capacity)
+                             if b.keys[i] is not None and b.dirty[i]),
+            } for b in self._blocks.values()]
+            return {"stale_ms": self.stale_ms,
+                    "counters": self.counters.to_dict(),
+                    "blocks": blocks}
