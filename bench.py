@@ -491,6 +491,29 @@ def main():
                          f"{proc.returncode} ({tail[:200]})")
         except Exception as e:  # never kill the bench line
             load_ctx += f"; load-fan bench failed ({type(e).__name__}: {e})"
+        # recovery dimension (DESIGN §24): shard-loss fault domains — kill
+        # shards mid-sustained-load, measure detection→rebuilt MTTR p50/p99
+        # and the degraded-answer rate, and verify zero lost accepted
+        # updates against a fault-free twin.  Same CPU-pinned
+        # 8-virtual-device subprocess recipe as the other load columns.
+        try:
+            renv = {**os.environ, "JAX_PLATFORMS": "cpu"}
+            renv.pop("PALLAS_AXON_POOL_IPS", None)
+            renv.pop("JAX_COMPILATION_CACHE_DIR", None)
+            renv["XLA_FLAGS"] = (renv.get("XLA_FLAGS", "")
+                                 + " --xla_force_host_platform_device_"
+                                   "count=8").strip()
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--load-recovery-bench"],
+                env=renv, capture_output=True, text=True, timeout=900)
+            tail = (proc.stdout.strip().splitlines() or ["no output"])[-1]
+            load_ctx += ("; " + tail if "load-recovery-bench" in tail else
+                         f"; load-recovery-bench subprocess failed rc="
+                         f"{proc.returncode} ({tail[:200]})")
+        except Exception as e:  # never kill the bench line
+            load_ctx += (f"; load-recovery bench failed "
+                         f"({type(e).__name__}: {e})")
 
     # ---- long-panel engine split (opt-in: BENCH_LONGT=1) ----
     # sequential univariate scan vs the O(log T) associative-scan engine at
@@ -1511,6 +1534,79 @@ def _load_fan_bench():
     return 0
 
 
+def _load_recovery_bench():
+    """Subprocess mode (CPU, 8 virtual devices): the BENCH_LOAD RECOVERY
+    column — shard-loss fault domains under sustained keyed updates
+    (docs/DESIGN.md §24).  A full mesh of resident 1C states takes
+    ``BENCH_LOAD_RECOVERY_ROUNDS`` rounds (default 30) of one update per
+    key through a ShardedGateway while ``BENCH_LOAD_RECOVERY_KILLS`` shards
+    die mid-stream (default 2: explicit ``mark_shard_lost`` operator kills
+    plus one chaos-fired ``shard_lost`` dispatch loss) — each loss answers
+    its in-flight requests DEGRADED from the banked last-good, then the
+    rebuild wave re-registers the shard and replays journal suffixes.
+    Headline metrics: detection→rebuilt MTTR p50/p99, the degraded-answer
+    rate across the loss windows, and ``zero_lost_accepted`` — every
+    ungapped key bit-identical to a fault-free twin fed exactly the
+    accepted stream (the availability contract; no naive denominator —
+    see BASELINE.md)."""
+    import dataclasses
+
+    import jax
+
+    from yieldfactormodels_jl_tpu import serving
+    from yieldfactormodels_jl_tpu.parallel import mesh as pmesh
+    from yieldfactormodels_jl_tpu.robustness import loadgen
+
+    n_dev = len(jax.devices())
+    rounds = int(os.environ.get("BENCH_LOAD_RECOVERY_ROUNDS", "30"))
+    kills = max(1, int(os.environ.get("BENCH_LOAD_RECOVERY_KILLS", "2")))
+    spec, data, snap = _serving_fixture_1c()
+    cap_per = 16
+    n_keys = n_dev * cap_per // 2   # half-full: room for redistribution
+    lat = serving.BucketLattice(update_batch_sizes=(1, 4, 16))
+
+    def build():
+        st = serving.ShardedStateStore(spec, mesh=pmesh.make_mesh(n_dev),
+                                       shard_capacity=cap_per, lattice=lat)
+        st.register_many(
+            dataclasses.replace(snap,
+                                meta=dataclasses.replace(snap.meta,
+                                                         task_id=i))
+            for i in range(n_keys))
+        return st
+
+    store, twin = build(), build()
+    keys = store.keys()
+    store.warmup()      # twin shares the process-wide compiled programs
+    gw = serving.ShardedGateway(store, queue_max=4096, queue_age_ms=0.0)
+    # kills - 1 explicit operator kills at evenly spaced rounds, round-robin
+    # over the shards, plus ONE chaos-fired in-dispatch loss mid-run — both
+    # detection paths (health-sweep verb and launch failure) exercise
+    kill_at = [(max(1, (i + 1) * rounds // (kills + 1)), i % n_dev)
+               for i in range(kills - 1)]
+    rep = loadgen.run_recovery_load(
+        gw, store, twin, data[:, 64:], keys, rounds=rounds, kill_at=kill_at,
+        chaos_kill_rounds=[max(1, rounds // 2)])
+    out = rep.to_dict()
+    out.update({
+        "keys": len(keys), "mesh": n_dev,
+        "journal_cap": store.journal.capacity,
+        "lost_shards": store.recovery.lost_shards,
+        "rehomed_keys": store.recovery.rehomed_keys,
+        "zero_lost_accepted": rep.lost_accepted == 0 and rep.errors == 0
+        and rep.kills > 0,
+    })
+    plat = jax.devices()[0].platform
+    out["device_fallback"] = plat != "tpu"
+    out["fallback_reason"] = "" if plat == "tpu" else os.environ.get(
+        "BENCH_FALLBACK_REASON",
+        f"recovery sweep on the {n_dev}-virtual-device {plat} harness "
+        f"(the single-chip relay exposes no multi-device mesh)")
+    print(f"load-recovery-bench[1C f64, {len(keys)} keys on {n_dev} "
+          f"chips, {rep.kills} kills]: " + json.dumps(out))
+    return 0
+
+
 def _orch_bench():
     """2-worker in-process orchestration bench (CPU-pinned subprocess mode):
     tasks/sec on a clean RW rolling run through the leased queue, plus the
@@ -1730,6 +1826,8 @@ if __name__ == "__main__":
         sys.exit(_load_tier_bench())
     elif "--load-fan-bench" in sys.argv:
         sys.exit(_load_fan_bench())
+    elif "--load-recovery-bench" in sys.argv:
+        sys.exit(_load_recovery_bench())
     elif "--inner" in sys.argv:
         main()
     else:

@@ -1,0 +1,226 @@
+"""Per-shard accepted-update journal (serving/journal.py, DESIGN §24).
+
+The journal is the replay source a lost shard is rebuilt from, so its
+safety story is entirely host-side and jax-free: bounded rings whose
+eviction is a DETECTED gap (never a silent short replay), per-key version
+watermarks that catch dropped appends, contiguous-suffix extraction, the
+atomic tmp+``os.replace`` spill (YFM005), and lock-consistent snapshots
+under a concurrent append hammer (YFM010).
+"""
+
+import pickle
+import threading
+
+import numpy as np
+import pytest
+
+from yieldfactormodels_jl_tpu.serving.journal import (JournalRecord,
+                                                      UpdateJournal)
+
+K0 = ("1C", 0)
+K1 = ("1C", 1)
+
+
+def _curve(v, n=6):
+    return np.full(n, float(v))
+
+
+def _fill(j, shard, key, versions, base=None):
+    if base is not None:
+        j.note_base(key, base)
+    for v in versions:
+        j.append(shard, key, f"d{v}", _curve(v), v)
+
+
+# ---------------------------------------------------------------------------
+# construction + validation
+# ---------------------------------------------------------------------------
+
+def test_constructor_validation():
+    with pytest.raises(ValueError):
+        UpdateJournal(0)
+    with pytest.raises(ValueError):
+        UpdateJournal(2, capacity=0)
+    j = UpdateJournal(3, capacity=7)
+    assert j.n_shards == 3 and j.capacity == 7
+
+
+def test_env_capacity_constructor_wins(monkeypatch):
+    monkeypatch.setenv("YFM_JOURNAL_CAP", "5")
+    assert UpdateJournal(1).capacity == 5
+    assert UpdateJournal(1, capacity=9).capacity == 9
+    monkeypatch.setenv("YFM_JOURNAL_CAP", "0")
+    with pytest.raises(ValueError):
+        UpdateJournal(1)
+    monkeypatch.delenv("YFM_JOURNAL_CAP")
+    assert UpdateJournal(1).capacity == 1024
+
+
+# ---------------------------------------------------------------------------
+# watermarks + suffix contiguity
+# ---------------------------------------------------------------------------
+
+def test_clean_suffix_and_watermarks():
+    j = UpdateJournal(2, capacity=16)
+    _fill(j, 0, K0, [1, 2, 3, 4], base=0)
+    _fill(j, 1, K1, [11, 12], base=10)
+    assert j.watermark(K0) == 4 and j.watermark(K1) == 12
+    assert j.shard_seq(0) == 4 and j.shard_seq(1) == 2
+    recs, ok = j.suffix(K0, 1, 4)
+    assert ok and [r.version for r in recs] == [2, 3, 4]
+    # the records carry private float64 copies of the curves
+    assert all(r.curve.dtype == np.float64 for r in recs)
+    assert np.array_equal(recs[0].curve, _curve(2))
+    # empty needed range with an intact watermark is trivially ok
+    recs, ok = j.suffix(K0, 4, 4)
+    assert ok and recs == []
+    # a key the journal never saw: empty range ok, non-empty is a gap
+    recs, ok = j.suffix(("2C", 9), 3, 3)
+    assert ok and recs == []
+    recs, ok = j.suffix(("2C", 9), 3, 5)
+    assert not ok
+
+
+def test_append_curve_copy_is_private():
+    j = UpdateJournal(1, capacity=4)
+    y = _curve(1.0)
+    j.note_base(K0, 0)
+    j.append(0, K0, "d", y, 1)
+    y[:] = 99.0      # caller mutates after the accept
+    recs, ok = j.suffix(K0, 0, 1)
+    assert ok and np.array_equal(recs[0].curve, _curve(1.0))
+
+
+# ---------------------------------------------------------------------------
+# gap detection: dropped appends, trailing drops, ring eviction
+# ---------------------------------------------------------------------------
+
+def test_dropped_append_gaps_the_key():
+    j = UpdateJournal(1, capacity=16)
+    j.note_base(K0, 0)
+    j.append(0, K0, "d1", _curve(1), 1)
+    # version 2's append was dropped (the journal_gap seam); 3 arrives
+    j.append(0, K0, "d3", _curve(3), 3)
+    assert j.is_gapped(K0)
+    recs, ok = j.suffix(K0, 0, 3)
+    assert not ok and recs == []
+    # a re-base (refit/promotion installs a fresh record) heals the key
+    j.note_base(K0, 3)
+    assert not j.is_gapped(K0)
+    j.append(0, K0, "d4", _curve(4), 4)
+    recs, ok = j.suffix(K0, 3, 4)
+    assert ok and [r.version for r in recs] == [4]
+
+
+def test_trailing_drop_detected_by_watermark():
+    """A dropped LAST append leaves no version jump to catch — the suffix
+    check ``watermark < upto_version`` is what refuses the short replay."""
+    j = UpdateJournal(1, capacity=16)
+    _fill(j, 0, K0, [1, 2], base=0)
+    assert not j.is_gapped(K0)          # no jump observed...
+    recs, ok = j.suffix(K0, 0, 3)       # ...but the accepted stream is at 3
+    assert not ok and recs == []
+
+
+def test_dropped_first_append_caught_via_base():
+    j = UpdateJournal(1, capacity=16)
+    j.note_base(K0, 0)
+    j.append(0, K0, "d2", _curve(2), 2)   # v1's append was dropped
+    assert j.is_gapped(K0)
+
+
+def test_ring_eviction_is_a_gap_not_a_short_replay():
+    j = UpdateJournal(1, capacity=3)
+    _fill(j, 0, K0, [1, 2, 3, 4, 5], base=0)   # ring holds only 3,4,5
+    assert not j.is_gapped(K0)                 # eviction is not a key gap
+    recs, ok = j.suffix(K0, 0, 5)              # needs 1..5: 1,2 aged out
+    assert not ok and recs == []
+    recs, ok = j.suffix(K0, 2, 5)              # 3..5 still resident
+    assert ok and [r.version for r in recs] == [3, 4, 5]
+    assert j.shard_seq(0) == 5                 # seq survives eviction
+
+
+def test_forget_drops_watermark_and_gap_state():
+    j = UpdateJournal(1, capacity=8)
+    _fill(j, 0, K0, [1, 3], base=0)            # gapped
+    assert j.is_gapped(K0)
+    j.forget(K0)
+    assert j.watermark(K0) is None and not j.is_gapped(K0)
+    # non-empty suffix for a forgotten key is a gap (no watermark to trust)
+    _, ok = j.suffix(K0, 0, 3)
+    assert not ok
+
+
+# ---------------------------------------------------------------------------
+# spill / load (YFM005 atomic publish) round trip
+# ---------------------------------------------------------------------------
+
+def test_spill_load_round_trip(tmp_path):
+    j = UpdateJournal(2, capacity=8)
+    _fill(j, 0, K0, [1, 2, 3], base=0)
+    _fill(j, 1, K1, [11, 13], base=10)         # gapped on shard 1
+    path = str(tmp_path / "journal.pkl")
+    j.spill(path)
+    assert not list(tmp_path.glob("*.tmp.*"))  # tmp sibling replaced away
+    j2 = UpdateJournal.load(path)
+    assert j2.capacity == 8 and j2.n_shards == 2
+    assert j2.watermark(K0) == 3 and j2.shard_seq(0) == 3
+    assert j2.is_gapped(K1) and not j2.is_gapped(K0)
+    recs, ok = j2.suffix(K0, 0, 3)
+    assert ok and [r.version for r in recs] == [1, 2, 3]
+    assert all(isinstance(r, JournalRecord) for r in recs)
+    # spill again over the existing file: os.replace, not append
+    j2.append(0, K0, "d4", _curve(4), 4)
+    j2.spill(path)
+    with open(path, "rb") as fh:
+        assert pickle.load(fh)["last_ver"][K0] == 4
+
+
+# ---------------------------------------------------------------------------
+# threading: append hammer vs consistent snapshots (YFM010)
+# ---------------------------------------------------------------------------
+
+def test_two_thread_append_vs_snapshot_hammer():
+    """Two writer threads append disjoint per-key streams while the main
+    thread snapshots concurrently: every snapshot must be internally
+    consistent (per-key max ring version == watermark, no gaps — the
+    streams themselves are contiguous) and the final state exact."""
+    j = UpdateJournal(2, capacity=4096)
+    n = 300
+    keys = [("1C", 0), ("1C", 1)]
+    for k in keys:
+        j.note_base(k, 0)
+
+    def writer(shard, key):
+        for v in range(1, n + 1):
+            j.append(shard, key, v, _curve(v), v)
+
+    threads = [threading.Thread(target=writer, args=(s, k))
+               for s, k in enumerate(keys)]
+    for t in threads:
+        t.start()
+    snaps = []
+    while any(t.is_alive() for t in threads):
+        snaps.append(j.snapshot())
+    for t in threads:
+        t.join()
+    snaps.append(j.snapshot())
+
+    for snap in snaps:
+        assert not snap["gapped"]
+        for s, key in enumerate(keys):
+            ring_vers = [r.version for r in snap["rings"][s]
+                         if r.key == key]
+            assert ring_vers == sorted(ring_vers)
+            if ring_vers:
+                # the ring's high edge and the watermark agree in every
+                # consistent cut (the lock's whole job)
+                assert snap["last_ver"][key] == ring_vers[-1]
+                assert snap["seq"][s] == len(ring_vers)
+    final = snaps[-1]
+    for s, key in enumerate(keys):
+        assert final["last_ver"][key] == n
+        assert final["seq"][s] == n
+    for s, key in enumerate(keys):
+        recs, ok = j.suffix(key, 0, n)
+        assert ok and len(recs) == n

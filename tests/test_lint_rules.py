@@ -687,6 +687,36 @@ def test_yfm008_quiet_on_device_side_fan_refresh(tmp_path):
     assert not res.findings
 
 
+def test_yfm008_fires_on_host_gather_in_rebuild_planning(tmp_path):
+    """The DESIGN §24 rebuild-routing rule: deciding which keys lived on a
+    lost shard (and what each replays) is per-key dict routing — the array
+    work belongs in the rebuild flush, not the plan."""
+    res = lint(tmp_path, f"{PKG}/serving/extra.py", """\
+        import jax
+        import numpy as np
+
+        def _rebuild_plan(self, s):
+            return jax.device_get(np.asarray(self.bank[s]))
+    """, ["YFM008"])
+    assert len(fired(res, "YFM008")) == 2
+
+
+def test_yfm008_quiet_on_rebuild_flush_transfers(tmp_path):
+    # the sanctioned split: the plan is pure routing; fresh arrays, slot
+    # writes and journal replay transfer only inside the rebuild flush
+    res = lint(tmp_path, f"{PKG}/serving/extra.py", """\
+        import jax
+        import numpy as np
+
+        def _rebuild_plan(self, s):
+            return sorted(k for k, loc in self.slots.items() if loc[0] == s)
+
+        def _rebuild_shard(self, s, plan):
+            return np.asarray(jax.device_get(self.shards[s]))
+    """, ["YFM008"])
+    assert not res.findings
+
+
 def test_yfm008_scoped_to_serving(tmp_path):
     # the orchestrator's poll loop may sleep (chaos/test code likewise by
     # living outside serving/)

@@ -167,11 +167,11 @@ def test_chaos_count_and_probability_triggers():
 
     # probability triggers replay under a fixed seed
     def run(seed):
-        chaos.configure("s:0.5", seed=seed)
+        chaos.configure("merge:0.5", seed=seed)
         fired = []
         for _ in range(32):
             try:
-                chaos.maybe_fail("s")
+                chaos.maybe_fail("merge")
                 fired.append(0)
             except chaos.ChaosInjected:
                 fired.append(1)
@@ -179,6 +179,13 @@ def test_chaos_count_and_probability_triggers():
 
     assert run(7) == run(7)
     assert any(run(7))
+    # armed seam names are validated against the KNOWN_SEAMS registry: a
+    # typo'd seam must fail loudly at configure time, naming the valid set
+    with pytest.raises(ValueError, match="unknown seam 'estimat'"):
+        chaos.configure("estimat:@2")
+    with pytest.raises(ValueError, match="one of: "):
+        chaos.configure("merge:@1,typo_seam:0.5")
+    chaos.reset()
 
 
 def test_backoff_delay_grows_and_is_bounded():

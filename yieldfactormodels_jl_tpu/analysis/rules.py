@@ -574,7 +574,13 @@ _ROUTING_FUNCS = frozenset({"pump", "_pump_locked", "_dispatch_updates",
                             # → good promotion and the fan slices gather only
                             # at the answer boundary (streams.fan)
                             "_refresh_wave", "_stage_wave", "notify_updated",
-                            "_mark_dirty"})
+                            "_mark_dirty",
+                            # shard-loss rebuild planning (DESIGN §24): which
+                            # keys lived on the lost shard and what each
+                            # replays is per-key dict routing; the fresh
+                            # arrays, slot writes and journal replay happen
+                            # in the rebuild flush (_rebuild_shard)
+                            "_rebuild_plan"})
 
 #: calls that move device values to host (or force a device sync)
 _HOST_TRANSFERS = ("jax.device_get", "device_get", "np.asarray", "np.array",

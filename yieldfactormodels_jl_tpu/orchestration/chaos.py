@@ -50,6 +50,20 @@ and ``fan_stale`` forces one fan answer to be served degraded, exercising
 the degrade-from-last-fan path without aging a real ``YFM_FAN_STALE_MS``
 budget.
 
+SHARD-LOSS seams (serving/store.py + serving/journal.py,
+docs/DESIGN.md §24) drill the failure-domain recovery layer:
+``shard_lost`` drops one whole shard's resident device arrays at update
+dispatch — the loss-detection → degraded-from-bank → rebuild-wave →
+journal-replay path must bring every ungapped key back bit-identical to
+the never-lost run — and ``journal_gap`` drops one accepted-update
+journal append, which the journal's watermark gap detector must catch so
+the affected key is stale-flagged at rebuild instead of ever replaying to
+silently-wrong state.
+
+Armed seam names are validated against :data:`KNOWN_SEAMS` at configure
+time — a typo'd seam would otherwise arm nothing and silently never fire,
+which defeats the whole point of a chaos run.
+
 Tests and benchmarks arm programmatically via :func:`configure` /
 :func:`reset` (reset also re-reads the environment on the next hit).
 """
@@ -67,15 +81,40 @@ class ChaosInjected(RuntimeError):
     """Simulated worker death injected at an orchestration seam."""
 
 
+#: every seam a driver actually calls into — armed specs must name one of
+#: these (a typo'd seam would arm nothing and the chaos run would silently
+#: test nothing).  Grouped as in the module docstring.
+KNOWN_SEAMS = frozenset({
+    # worker-death seams (orchestration drivers)
+    "estimate", "shard_write", "merge",
+    # numeric seams (serving/service.py, serving/store.py)
+    "nan_curve", "nonpsd_cov",
+    # request-path seams (serving/gateway.py, serving/batcher.py)
+    "slow_update", "queue_stall", "poison_ticket",
+    # tier-boundary seams (serving/tiers.py)
+    "evict_corrupt", "promote_stall",
+    # subscription seams (serving/streams.py)
+    "refresh_storm", "fan_stale",
+    # shard-loss seams (serving/store.py, serving/journal.py)
+    "shard_lost", "journal_gap",
+})
+
+
 class _Config:
     def __init__(self, spec: str, seed: int):
         #: seam -> ("count", N) | ("prob", p)
         self.arms: Dict[str, Tuple[str, float]] = {}
+        #: seam -> the raw trigger text, for observability reports
+        self.raw: Dict[str, str] = {}
         for tok in filter(None, (t.strip() for t in spec.split(","))):
             seam, _, trig = tok.partition(":")
             if not trig:
                 raise ValueError(f"YFM_CHAOS entry {tok!r} lacks a trigger "
                                  f"(want 'seam:@N' or 'seam:prob')")
+            if seam not in KNOWN_SEAMS:
+                raise ValueError(
+                    f"YFM_CHAOS entry {tok!r} names unknown seam {seam!r} "
+                    f"(want one of: {', '.join(sorted(KNOWN_SEAMS))})")
             if trig.startswith("@"):
                 self.arms[seam] = ("count", int(trig[1:]))
             else:
@@ -83,6 +122,7 @@ class _Config:
                 if not 0.0 < p <= 1.0:
                     raise ValueError(f"YFM_CHAOS probability {p} not in (0, 1]")
                 self.arms[seam] = ("prob", p)
+            self.raw[seam] = trig
         self.rng = random.Random(seed)
 
 
@@ -90,16 +130,19 @@ _lock = threading.Lock()
 _config: Optional[_Config] = None
 _env_checked = False
 _hits: Dict[str, int] = {}
+_fired: Dict[str, int] = {}
 
 
 def configure(spec: Optional[str], seed: int = 0) -> None:
     """Arm chaos programmatically (``spec`` as in ``YFM_CHAOS``; ``None``
-    disarms).  Resets hit counters."""
+    disarms).  Validates seam names against :data:`KNOWN_SEAMS` and resets
+    the hit/fired counters."""
     global _config, _env_checked
     with _lock:
         _config = _Config(spec, seed) if spec else None
         _env_checked = True  # programmatic config overrides the environment
         _hits.clear()
+        _fired.clear()
 
 
 def reset() -> None:
@@ -109,12 +152,35 @@ def reset() -> None:
         _config = None
         _env_checked = False
         _hits.clear()
+        _fired.clear()
 
 
 def hits(seam: str) -> int:
     """How many times ``seam`` was reached since the last configure/reset."""
     with _lock:
         return _hits.get(seam, 0)
+
+
+def fired(seam: str) -> int:
+    """How many times ``seam`` actually FIRED (trigger decision true) since
+    the last configure/reset — ``hits`` counts the seam being reached,
+    ``fired`` the faults injected."""
+    with _lock:
+        return _fired.get(seam, 0)
+
+
+def observe() -> Dict[str, Dict[str, object]]:
+    """Per-ARMED-seam observability snapshot for health reports:
+    ``{seam: {"trigger", "hits", "fired"}}`` — empty when chaos is
+    disarmed, so a serving ``health()`` can always include it and a
+    chaos-armed run shows which seams actually fired."""
+    with _lock:
+        if _config is None:
+            return {}
+        return {seam: {"trigger": _config.raw.get(seam, ""),
+                       "hits": _hits.get(seam, 0),
+                       "fired": _fired.get(seam, 0)}
+                for seam in sorted(_config.arms)}
 
 
 def _fires(seam: str) -> bool:
@@ -135,8 +201,11 @@ def _fires(seam: str) -> bool:
         if arm is None:
             return False
         kind, val = arm
-        return (_hits[seam] == val) if kind == "count" \
+        decision = (_hits[seam] == val) if kind == "count" \
             else (_config.rng.random() < val)
+        if decision:
+            _fired[seam] = _fired.get(seam, 0) + 1
+        return decision
 
 
 def maybe_fail(seam: str) -> None:

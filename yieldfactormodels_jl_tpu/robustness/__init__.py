@@ -31,7 +31,9 @@ _EXPORTS = {
     "LadderTrace": "ladder",
     "escalation_enabled": "ladder",
     "LoadReport": "loadgen",
+    "RecoveryReport": "loadgen",
     "run_load": "loadgen",
+    "run_recovery_load": "loadgen",
     "measure_capacity": "loadgen",
 }
 

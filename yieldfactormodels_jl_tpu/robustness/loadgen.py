@@ -320,6 +320,147 @@ class FanLoadReport:
         return d
 
 
+@dataclasses.dataclass
+class RecoveryReport:
+    """One shard-kill recovery run under sustained keyed updates
+    (DESIGN §24): the request ledger across the loss window, the rebuild
+    ledger (kills, rebuild waves, journal replays, gapped keys), MTTR
+    percentiles (detection → rebuilt, from the store timer's ``recover``
+    stage), and the ZERO-LOST-ACCEPTED-UPDATES verdict — every ungapped
+    key's post-run resident state bit-identical to a fault-free twin fed
+    exactly the accepted stream."""
+
+    rounds: int
+    updates_offered: int
+    updates_accepted: int
+    updates_degraded: int
+    shed: int
+    errors: int
+    kills: int
+    rebuilds: int
+    replayed_updates: int
+    gapped_keys: int
+    wall_s: float
+    mttr_p50_s: float
+    mttr_p99_s: float
+    parity_checked: int     # ungapped keys bit-compared against the twin
+    lost_accepted: int      # ungapped keys whose bits diverged — MUST be 0
+
+    @property
+    def degraded_rate(self) -> float:
+        return self.updates_degraded / self.updates_offered \
+            if self.updates_offered else 0.0
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["degraded_rate"] = round(self.degraded_rate, 6)
+        return d
+
+
+def run_recovery_load(gateway, store, twin, curves, keys, *,
+                      rounds: int = 40, kill_at=(),
+                      chaos_kill_rounds=(),
+                      poll_rounds: int = 200) -> RecoveryReport:
+    """Drive ``rounds`` of one-update-per-key traffic through a sharded
+    ``gateway`` while shards die mid-stream, and verify the failure-domain
+    contract end to end (DESIGN §24).
+
+    ``kill_at`` is ``[(round, shard), ...]`` explicit kills
+    (``store.mark_shard_lost`` fired just before that round's submissions).
+    ``chaos_kill_rounds`` kills through the ``shard_lost`` chaos seam
+    instead: the harness arms ``shard_lost:@1`` for exactly that round's
+    store dispatch and disarms it before the twin feed — the seam's
+    counters are process-global, so leaving it armed across the round
+    boundary could fire inside the fault-free TWIN and poison the parity
+    baseline (the harness owns the seam during those rounds).  ``twin`` is
+    a fault-free store with the SAME keys registered from the SAME
+    snapshots: after each round the twin is fed exactly the updates the
+    gateway ACCEPTED, so at the end every ungapped key must be
+    bit-identical across the two stores — any divergence is a lost
+    accepted update (``lost_accepted``), the one number that must be zero.
+    Closed loop, single thread: every submitted ticket is pumped/polled to
+    an answer (bounded by ``poll_rounds``) — an unhandled exception
+    anywhere fails the harness."""
+    from ..orchestration import chaos
+
+    kill_at = {int(r): int(s) for r, s in kill_at}
+    chaos_kill_rounds = {int(r) for r in chaos_kill_rounds}
+    curves = np.asarray(curves)
+    T = curves.shape[1]
+    offered = accepted = degraded = shed = errors = kills = 0
+    t_start = time.perf_counter()
+    for r in range(rounds):
+        s = kill_at.get(r)
+        if s is not None:
+            store.mark_shard_lost(s, "load-harness kill")
+            kills += 1
+        armed = r in chaos_kill_rounds
+        if armed:
+            chaos.configure("shard_lost:@1")
+        y = curves[:, r % T]
+        tickets = []
+        for k in keys:
+            offered += 1
+            try:
+                tickets.append((k, gateway.submit_update(r, y, key=k)))
+            except ServingError:
+                shed += 1       # admission control, never a lost accept
+        outstanding = dict(tickets)
+        accepted_now = []
+        for _ in range(poll_rounds):
+            gateway.pump()
+            for k in list(outstanding):
+                try:
+                    out = gateway.poll(outstanding[k])
+                except ServingError:
+                    errors += 1
+                    del outstanding[k]
+                    continue
+                if out is None:
+                    continue
+                del outstanding[k]
+                if out.get("error") is not None:
+                    errors += 1
+                elif out.get("degraded"):
+                    degraded += 1
+                else:
+                    accepted += 1
+                    accepted_now.append(k)
+            if not outstanding:
+                break
+        errors += len(outstanding)      # permanently stalled = harness bug
+        if armed:
+            kills += chaos.fired("shard_lost")
+            chaos.reset()               # never leave the seam armed for the
+            # twin feed below — its counters are process-global
+        if accepted_now:
+            # mirror THIS round's accepted stream into the fault-free twin
+            # (per-key recursion order is all that matters for parity)
+            twin.update_batch([(k, y) for k in accepted_now])
+    wall = time.perf_counter() - t_start
+    checked = lost = 0
+    gapped = set(getattr(store, "_gapped_keys", ()))
+    for k in keys:
+        if k in gapped:
+            continue
+        a, b = store.snapshot_of(k), twin.snapshot_of(k)
+        checked += 1
+        same = (a.meta.version == b.meta.version
+                and np.array_equal(np.asarray(a.beta), np.asarray(b.beta))
+                and np.array_equal(np.asarray(a.P), np.asarray(b.P)))
+        lost += not same
+    rec = store.recovery
+    mttr = sorted(store.timer.samples.get("recover", ()))
+    return RecoveryReport(
+        rounds=rounds, updates_offered=offered, updates_accepted=accepted,
+        updates_degraded=degraded, shed=shed, errors=errors, kills=kills,
+        rebuilds=rec.rebuilt_shards, replayed_updates=rec.replayed_updates,
+        gapped_keys=len(gapped), wall_s=round(wall, 4),
+        mttr_p50_s=round(_nearest_rank(mttr, 0.50), 6) if mttr else 0.0,
+        mttr_p99_s=round(_nearest_rank(mttr, 0.99), 6) if mttr else 0.0,
+        parity_checked=checked, lost_accepted=lost)
+
+
 def run_fan_load(hub, service, curves, dates) -> FanLoadReport:
     """Drive a :class:`~..serving.streams.ScenarioStreamHub` over ``service``
     with one accepted update per (date, curve) and collect EVERY

@@ -16,7 +16,12 @@ LRU promotion-on-miss, batched promotion waves, a capacity ledger, and the
 multi-store fleet seam (``tiers``; docs/DESIGN.md §21) — and the streaming
 subscription layer on top: standing per-user stress-fan subscriptions,
 device-resident next to the filter state, delta-refreshed in one donated
-wave per accepted update (``streams``; docs/DESIGN.md §23).
+wave per accepted update (``streams``; docs/DESIGN.md §23) — all of it
+treating shard loss as a recoverable fault domain: a bounded per-shard ring
+journal of accepted updates with watermark gap detection (``journal``),
+degraded last-good answers while lost, and failover rebuild waves that
+replay each key's journal suffix to bit-identical post-replay state
+(docs/DESIGN.md §24).
 """
 
 from .batcher import (BucketLattice, DEFAULT_LATTICE, ForecastRequest,
@@ -28,13 +33,17 @@ from .service import RequestCounters, YieldCurveService
 from .snapshot import (ServingError, ServingSnapshot, SnapshotMeta,
                        SnapshotRegistry, freeze_snapshot,
                        freeze_snapshots_batch, load_snapshot)
-from .store import ShardedStateStore
+from .journal import JournalRecord, UpdateJournal
+from .store import RecoveryLedger, ShardedStateStore
 from .streams import FanCounters, ScenarioStreamHub
 from .tiers import StoreFleet, TieredStateStore, TierLedger, WarmTier
 
 __all__ = [
     "BucketLattice",
     "FanCounters",
+    "JournalRecord",
+    "RecoveryLedger",
+    "UpdateJournal",
     "ScenarioStreamHub",
     "ShardedGateway",
     "ShardedStateStore",

@@ -515,8 +515,17 @@ class ShardedGateway(ServingGateway):
         ``hub.notify_updated`` (one delta-refresh wave per touched fan
         block) and a published refit through ``hub.notify_refit`` (full
         recompute — the delta chain is not honest across a parameter
-        change).  ``ScenarioStreamHub(gateway)`` calls this itself."""
+        change).  ``ScenarioStreamHub(gateway)`` calls this itself.
+
+        Blast-radius wiring (DESIGN §24): a shard-loss rebuild wave also
+        breaks the affected keys' delta chains — the rebuilt state is
+        bit-identical for ungapped keys, but a gapped key's standing fan
+        would otherwise keep delta-refreshing off silently-wrong state, so
+        every affected key gets a full recompute."""
         self._hub = hub
+        add = getattr(self.store, "add_rebuild_listener", None)
+        if add is not None:
+            add(hub.notify_refit)
 
     # ---- key-addressed admission -----------------------------------------
 
@@ -579,7 +588,17 @@ class ShardedGateway(ServingGateway):
         burst against demoted state costs one device dispatch per shard —
         never one per request.  Update keys are handled inside
         ``store.update_batch``; stores without a tier seam have no
-        ``prepare_reads`` and skip.  Pure key routing (YFM008)."""
+        ``prepare_reads`` and skip.  Pure key routing (YFM008).
+
+        Recovery ordering (DESIGN §24): a store left with LOST shards (an
+        explicit ``mark_shard_lost`` between pumps — update-path losses
+        rebuild inside ``update_batch`` itself) is rebuilt HERE, before any
+        read resolves ``snapshot_of`` against a dead shard — the batched
+        rebuild wave is the read path's promotion analogue."""
+        if getattr(self.store, "rebuilding", False):
+            recover = getattr(self.store, "recover_lost_shards", None)
+            if recover is not None:
+                recover()
         prepare = getattr(self.store, "prepare_reads", None)
         if prepare is None or not run_batched:
             return

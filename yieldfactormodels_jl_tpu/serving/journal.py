@@ -1,0 +1,214 @@
+"""Per-shard journal of accepted online updates (docs/DESIGN.md §24).
+
+The resident mesh (serving/store.py) is the fast copy of every live filter
+state; a lost device shard — relay wedge, killed backend, a poisoned
+whole-shard launch — takes every state on it down at once.  The recovery
+contract is replay determinism: rebuild each key from its best surviving
+host-side source (last-good bank, warm record, cold registry snapshot) and
+re-drive the ACCEPTED updates it is missing through the exact same donated
+``_jitted_shard_update`` program, so the post-replay resident state is
+bit-identical to the never-lost run.  This module is the record of those
+accepted updates:
+
+- **Appends are free.**  Every update request already crosses the host
+  O(batch) on its way in (the curve arrives as a host buffer), so journaling
+  the accepted ones — ``(key, date, curve, post-update version)`` — adds one
+  host copy per accept and zero device traffic.
+- **Bounded ring per shard.**  Each shard keeps a ``deque(maxlen=capacity)``
+  of records (``YFM_JOURNAL_CAP``, constructor wins over env).  Eviction is
+  deliberate memory bounding: a replay suffix that has aged out of the ring
+  is reported as a GAP — the key is stale-flagged, never silently replayed
+  short.
+- **Watermarks detect gaps.**  The journal keeps a per-key high-water
+  version (scalar — survives ring eviction) and a per-shard append sequence.
+  An append whose version is not exactly ``last + 1`` marks the key GAPPED
+  (a dropped append — the ``journal_gap`` chaos seam simulates exactly
+  this); so does a rebuild-time suffix whose versions are not contiguous
+  from the source to the expected version.  A gapped key is *detected* as
+  unreplayable, which is the whole safety story: degrade loudly instead of
+  serving silently-wrong state.
+- **Optional spill for cross-process recovery.**  ``spill()`` publishes the
+  full journal state atomically (tmp + ``os.replace`` — the YFM005
+  discipline) so a successor process can ``load()`` it and replay on top of
+  the cold registry.
+
+Threading: one lock guards all tables (append/watermark/suffix/spill); the
+store appends from its response boundary while a health/ops thread may be
+snapshotting — the lock keeps every reader consistent (graftlint YFM010
+covers the class like the rest of the threaded host layer).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+from collections import deque
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+Key = Tuple[str, int]
+
+
+class JournalRecord(NamedTuple):
+    """One accepted update as it crossed the host: everything the donated
+    shard-update program needs to reproduce the accept bit-for-bit."""
+    key: Key
+    date: Optional[object]
+    curve: np.ndarray          # (N,) float64 host copy of the observed yields
+    version: int               # POST-update version (meta/resident agree)
+
+
+def _env_capacity() -> int:
+    """``YFM_JOURNAL_CAP`` (per-shard ring capacity in records; default
+    1024 — at one accept per key per pump cycle that is many full rebuild
+    windows of history for a 64-slot shard)."""
+    raw = os.environ.get("YFM_JOURNAL_CAP", "")
+    if not raw:
+        return 1024
+    cap = int(raw)
+    if cap < 1:
+        raise ValueError(f"YFM_JOURNAL_CAP must be >= 1, got {cap}")
+    return cap
+
+
+class UpdateJournal:
+    """Bounded per-shard ring journal of accepted updates + gap detector."""
+
+    def __init__(self, n_shards: int, capacity: Optional[int] = None):
+        if n_shards < 1:
+            raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+        self.capacity = int(capacity) if capacity is not None \
+            else _env_capacity()
+        if self.capacity < 1:
+            raise ValueError(f"journal capacity must be >= 1, "
+                             f"got {self.capacity}")
+        self.n_shards = int(n_shards)
+        self._lock = threading.Lock()
+        self._rings: List[deque] = [deque(maxlen=self.capacity)
+                                    for _ in range(self.n_shards)]
+        self._seq: List[int] = [0] * self.n_shards      # per-shard watermark
+        self._last_ver: Dict[Key, int] = {}             # per-key watermark
+        self._gapped: set = set()
+
+    # ---- write side -------------------------------------------------------
+
+    def note_base(self, key: Key, version: int) -> None:
+        """Seed a key's version watermark at registration/refit time (no
+        record — registration is not an update).  Without the base, a
+        dropped FIRST append would leave the gap detector blind."""
+        with self._lock:
+            self._last_ver[key] = int(version)
+            self._gapped.discard(key)
+
+    def append(self, shard: int, key: Key, date, curve,
+               version: int) -> None:
+        """Journal one ACCEPTED update.  Detects a version jump against the
+        key's watermark (a silently dropped earlier append — the
+        ``journal_gap`` failure) and marks the key gapped; the append itself
+        is still recorded so later contiguous suffixes stay usable after a
+        re-base."""
+        rec = JournalRecord(key, date,
+                            np.asarray(curve, dtype=np.float64).copy(),
+                            int(version))
+        with self._lock:
+            last = self._last_ver.get(key)
+            if last is not None and rec.version != last + 1:
+                self._gapped.add(key)
+            self._last_ver[key] = rec.version
+            self._rings[shard].append(rec)
+            self._seq[shard] += 1
+
+    def forget(self, key: Key) -> None:
+        """Drop a key's watermark/gap state (eviction); its ring records
+        become inert (a replay never consults a forgotten key)."""
+        with self._lock:
+            self._last_ver.pop(key, None)
+            self._gapped.discard(key)
+
+    # ---- read side --------------------------------------------------------
+
+    def watermark(self, key: Key) -> Optional[int]:
+        """The key's high-water journaled version (survives ring eviction);
+        ``None`` for a key the journal has never seen."""
+        with self._lock:
+            return self._last_ver.get(key)
+
+    def shard_seq(self, shard: int) -> int:
+        """Total appends ever made to ``shard``'s ring (the per-shard
+        watermark — monotonic, unaffected by ring eviction)."""
+        with self._lock:
+            return self._seq[shard]
+
+    def is_gapped(self, key: Key) -> bool:
+        with self._lock:
+            return key in self._gapped
+
+    def suffix(self, key: Key, after_version: int,
+               upto_version: int) -> Tuple[List[JournalRecord], bool]:
+        """The key's replay suffix: records with ``after_version < version
+        <= upto_version`` in version order, plus an ``ok`` verdict.  ``ok``
+        is False — a GAP — when the key was marked gapped by the append
+        detector, when its watermark is behind ``upto_version`` (the
+        dropped append was the last one), or when the ring has evicted part
+        of the needed range; an empty needed range with an intact watermark
+        is trivially ok.  A gapped suffix must NOT be replayed — the caller
+        stale-flags the key instead."""
+        with self._lock:
+            if key in self._gapped:
+                return [], False
+            last = self._last_ver.get(key)
+            if last is None or last < upto_version:
+                return [], upto_version <= after_version
+            need = {}
+            for ring in self._rings:
+                for rec in ring:
+                    if rec.key == key and \
+                            after_version < rec.version <= upto_version:
+                        need[rec.version] = rec
+            want = list(range(after_version + 1, upto_version + 1))
+            if sorted(need) != want:
+                return [], False        # ring evicted part of the suffix
+            return [need[v] for v in want], True
+
+    def snapshot(self) -> Dict[str, object]:
+        """A consistent host copy of the whole journal state (records,
+        watermarks, gap set) — what ``spill`` publishes and what the
+        append-vs-snapshot hammer test races against."""
+        with self._lock:
+            return {
+                "capacity": self.capacity,
+                "n_shards": self.n_shards,
+                "rings": [list(ring) for ring in self._rings],
+                "seq": list(self._seq),
+                "last_ver": dict(self._last_ver),
+                "gapped": set(self._gapped),
+            }
+
+    # ---- cross-process spill ---------------------------------------------
+
+    def spill(self, path: str) -> None:
+        """Publish the journal atomically for cross-process recovery: write
+        a tmp sibling, then ``os.replace`` — a crashed spill leaves the
+        previous file intact, never a torn one (YFM005)."""
+        payload = self.snapshot()
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as fh:
+            pickle.dump(payload, fh)
+        os.replace(tmp, path)
+
+    @classmethod
+    def load(cls, path: str) -> "UpdateJournal":
+        """Rehydrate a spilled journal (the successor process's replay
+        source on top of the cold registry)."""
+        with open(path, "rb") as fh:
+            payload = pickle.load(fh)
+        j = cls(payload["n_shards"], capacity=payload["capacity"])
+        with j._lock:
+            for s, recs in enumerate(payload["rings"]):
+                j._rings[s].extend(JournalRecord(*r) for r in recs)
+            j._seq = list(payload["seq"])
+            j._last_ver = dict(payload["last_ver"])
+            j._gapped = set(payload["gapped"])
+        return j

@@ -314,6 +314,10 @@ class YieldCurveService:
             "last_code": self._last_code,
             "last_code_names": tax.decode(self._last_code),
             "requests": self.counters.to_dict(),
+            # chaos observability: which armed seams fired ({} when
+            # disarmed) — a chaos run's health report shows the faults it
+            # actually injected, not just their consequences
+            "chaos": chaos.observe(),
         }
 
     # ---- the serving verbs ------------------------------------------------

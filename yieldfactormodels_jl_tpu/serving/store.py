@@ -34,6 +34,17 @@ device-scale replacement the ROADMAP's millions-of-users north star needs:
   returned moments, and a watch failure (or a fired ``nan_curve``/
   ``nonpsd_cov`` chaos seam) rebuilds the slot from the bank — the §11
   self-heal ladder at per-slot granularity.
+- **Shard loss is a recoverable fault domain (DESIGN §24).**  Every
+  accepted update is journaled host-side (`serving/journal.py`); a failed
+  shard launch (or an explicit :meth:`mark_shard_lost` from a health
+  sweep) marks the whole shard LOST — its keys answer degraded from the
+  banked last-good while the end-of-batch rebuild wave re-homes fresh
+  arrays on the reset device, re-registers every slot from its best
+  surviving host source and REPLAYS each key's journal suffix through the
+  same donated update program, so the post-replay resident state is
+  bit-identical to the never-lost run.  A journal gap stale-flags the key
+  instead of ever replaying to silently-wrong state; the ``shard_lost``
+  and ``journal_gap`` chaos seams drill both paths deterministically.
 
 Driver-layer error policy (CLAUDE.md): the kernels only sentinel; THIS
 module decodes per-request taxonomy codes, and raises structured
@@ -50,7 +61,9 @@ are single-writer — route all updates through one
 
 from __future__ import annotations
 
+import dataclasses
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -61,8 +74,9 @@ from ..orchestration import chaos
 from ..parallel import mesh as pmesh
 from ..robustness import health as rh
 from ..robustness import taxonomy as tax
-from ..utils.profiling import StageTimer
+from ..utils.profiling import StageTimer, _nearest_rank
 from .batcher import BucketLattice, MicroBatcher
+from .journal import UpdateJournal
 from .online import (_check_engine, _jitted_shard_update, _jitted_slot_write,
                      _jitted_slot_write_many, factor_cov)
 from .service import RequestCounters
@@ -70,6 +84,26 @@ from .snapshot import (ServingError, ServingSnapshot, SnapshotMeta,
                        SnapshotRegistry)
 
 Key = Tuple[str, int]
+
+
+@dataclasses.dataclass
+class RecoveryLedger:
+    """Shard-loss recovery accounting (DESIGN §24) — what the failure
+    domain cost and how it was repaid.  MTTR percentiles come from the
+    store timer's ``recover`` samples (one per rebuilt shard, detection →
+    rebuild complete); this ledger carries the counts."""
+    lost_shards: int = 0        # shards marked LOST (launch failure / sweep)
+    rebuilt_shards: int = 0     # rebuild waves completed
+    rehomed_keys: int = 0       # keys re-registered on the reset device
+    redistributed_keys: int = 0  # keys moved to surviving shards
+    parked_keys: int = 0        # overflow keys parked off-mesh (warm/cold)
+    replayed_updates: int = 0   # journal records re-driven through the mesh
+    gapped_keys: int = 0        # keys stale-flagged by the gap detector
+    degraded_answers: int = 0   # requests answered degraded during the window
+    listener_errors: int = 0    # rebuild-listener callbacks that raised
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
 
 
 def stage_request_arrays(spec, bucket: int):
@@ -155,7 +189,8 @@ class ShardedStateStore:
                  lattice: Optional[BucketLattice] = None,
                  registry: Optional[SnapshotRegistry] = None,
                  donate: bool = True, timer: Optional[StageTimer] = None,
-                 axis_name: str = "batch"):
+                 axis_name: str = "batch",
+                 journal_capacity: Optional[int] = None):
         _check_engine(engine)
         if shard_capacity < 1:
             raise ValueError(f"shard_capacity must be >= 1, "
@@ -184,6 +219,20 @@ class ShardedStateStore:
         self._meta: Dict[Key, SnapshotMeta] = {}
         self._bank: Dict[Key, Tuple[np.ndarray, np.ndarray]] = {}
         self._stale: set = set()
+        # shard-loss fault domain (DESIGN §24): the accepted-update journal,
+        # per-key bank versions/params for rebuild sources, lost-shard table
+        # (shard → detection timestamp), keys stale-flagged by a journal
+        # gap (they stay stale until a refit re-bases them), the recovery
+        # ledger, and the blast-radius listeners a rebuild must notify
+        self.journal = UpdateJournal(self.n_shards,
+                                     capacity=journal_capacity)
+        self.recovery = RecoveryLedger()
+        self._bank_ver: Dict[Key, int] = {}
+        self._bank_params: Dict[Key, np.ndarray] = {}
+        self._lost: Dict[int, Tuple[float, str]] = {}
+        self._gapped_keys: set = set()
+        self._rebuild_listeners: list = []
+        self._rebuilding = False
         dtype = spec.dtype
         Pn, Ms, Cs = spec.n_params, spec.state_dim, self.shard_capacity
         self._shards = []
@@ -316,6 +365,11 @@ class ShardedStateStore:
             self._meta[key] = snapshot.meta
             self._bank[key] = (np.asarray(snapshot.beta, dtype=np.float64),
                                np.asarray(cov, dtype=np.float64))
+            self._bank_ver[key] = snapshot.meta.version
+            self._bank_params[key] = np.asarray(
+                snapshot.params, dtype=np.float64).reshape(-1)
+            self._gapped_keys.discard(key)
+        self.journal.note_base(key, snapshot.meta.version)
         return key
 
     def register_many(self, snapshots) -> List[Key]:
@@ -379,6 +433,9 @@ class ShardedStateStore:
                     self._bank[key] = (
                         np.asarray(snap.beta, dtype=np.float64),
                         np.asarray(cov, dtype=np.float64))
+                    self._bank_ver[key] = snap.meta.version
+                    self._bank_params[key] = np.asarray(
+                        snap.params, dtype=np.float64).reshape(-1)
                     keys.append(key)
                 for s, (st, d) in enumerate(zip(staging, self._devices)):
                     taken = {sl for (sh, sl) in self._slot.values()
@@ -418,9 +475,14 @@ class ShardedStateStore:
                     self._bank[key] = (
                         np.asarray(snap.beta, dtype=np.float64),
                         np.asarray(cov, dtype=np.float64))
+                    self._bank_ver[key] = snap.meta.version
+                    self._bank_params[key] = np.asarray(
+                        snap.params, dtype=np.float64).reshape(-1)
                     keys.append(key)
                 for s in sorted(per_shard):
                     self._write_state_many(s, per_shard[s])
+        for key in keys:
+            self.journal.note_base(key, self._meta[key].version)
         return keys
 
     def evict(self, key: Key) -> None:
@@ -436,7 +498,11 @@ class ShardedStateStore:
             self._free[s].append(sl)
             self._meta.pop(key, None)
             self._bank.pop(key, None)
+            self._bank_ver.pop(key, None)
+            self._bank_params.pop(key, None)
             self._stale.discard(key)
+            self._gapped_keys.discard(key)
+        self.journal.forget(key)
 
     def publish_refit(self, key: Key, params, history=None, beta=None,
                       P=None) -> dict:
@@ -500,7 +566,13 @@ class ShardedStateStore:
                 self._write_state(s, sl, beta, cov, meta.version, params=p)
                 self._meta[key] = meta
                 self._bank[key] = (beta, cov)
+                self._bank_ver[key] = meta.version
+                self._bank_params[key] = p
                 self._stale.discard(key)
+                # a refit is a fresh authoritative state: it re-bases the
+                # journal watermark and heals a gap-stale key
+                self._gapped_keys.discard(key)
+        self.journal.note_base(key, meta.version)
         return {"key": key, "version": meta.version, "stale": False}
 
     def _rebuild_slot(self, key: Key, s: int, sl: int) -> None:
@@ -558,23 +630,48 @@ class ShardedStateStore:
                 for lo in range(0, len(group), bmax):
                     self._launch_chunk(s, group[lo:lo + bmax], staged, dates,
                                        res)
+        if self._lost:
+            # the rebuild wave runs at the batch boundary: the failing
+            # batch's requests were already answered degraded from the
+            # bank; the NEXT batch meets a healthy mesh (DESIGN §24)
+            self.recover_lost_shards()
         return res  # every position filled: staged ∪ shape-rejected
 
     def _launch_chunk(self, s: int, chunk, staged, dates, res) -> None:
         """One (shard, bucket) donated launch + host-side collection.  The
         padded request arrays go in as plain host buffers (jit stages them
         onto the owning shard's device alongside the committed resident
-        state — no per-input device_put dispatches on the hot path)."""
+        state — no per-input device_put dispatches on the hot path).
+
+        Shard-loss seam (DESIGN §24): a chunk routed to an already-LOST
+        shard answers degraded from the bank without launching; a fired
+        ``shard_lost`` chaos seam drops the shard's resident arrays right
+        here (the simulated whole-shard device loss), and ANY launch
+        failure marks the shard lost instead of raising out of the batch —
+        the worker-isolation contract holds at shard granularity too."""
+        if s in self._lost:
+            self._answer_lost(s, chunk, staged, res)
+            return
         bb = self.lattice.update_bucket(len(chunk))
         Y, slots, valid = stage_request_arrays(self.spec, bb)
         for j, (gpos, sl) in enumerate(chunk):
             Y[:, j] = staged[gpos][2]
             slots[j], valid[j] = sl, True
+        if chaos.should_inject("shard_lost"):
+            with self._lock:
+                self._shards[s] = None   # resident arrays genuinely gone
         sh = self._shards[s]
         runner = _jitted_shard_update(self.spec, self.engine,
                                       self.shard_capacity, bb, self._donate)
-        outs = runner(sh["params"], sh["beta"], sh["cov"], sh["ver"],
-                      Y, slots, valid)
+        try:
+            if sh is None:
+                raise RuntimeError(f"shard {s} resident arrays lost")
+            outs = runner(sh["params"], sh["beta"], sh["cov"], sh["ver"],
+                          Y, slots, valid)
+        except Exception as e:  # launch failure = the whole fault domain
+            self._note_lost(s, repr(e))
+            self._answer_lost(s, chunk, staged, res)
+            return
         sh["params"], sh["beta"], sh["cov"], sh["ver"] = outs[:4]
         self._collect(s, chunk, staged, dates, outs[4:], res)
 
@@ -609,15 +706,30 @@ class ShardedStateStore:
             if ok and not injected:
                 code |= int(watch[j])
             if ok and not injected and code == 0:
-                # accepted and healthy: bank host copies, sync the meta
+                # accepted and healthy: bank host copies, sync the meta,
+                # journal the accept (the replay source a lost shard is
+                # rebuilt from — the journal_gap seam drops one append,
+                # which the journal's watermark detector must catch)
                 with self._lock:
                     self._meta[key] = self._meta[key].bump()
                     self._bank[key] = (b_h, c_h)
-                    self._stale.discard(key)
+                    self._bank_ver[key] = int(vers[j])
+                    # a gap-stale key keeps its stale flag through later
+                    # accepts: its state diverged from the never-lost run
+                    # and only a refit re-bases it (DESIGN §24)
+                    gap_stale = key in self._gapped_keys
+                    if gap_stale:
+                        self._stale.add(key)
+                    else:
+                        self._stale.discard(key)
+                if not chaos.should_inject("journal_gap"):
+                    self.journal.append(
+                        s, key, dates[pos] if dates is not None else None,
+                        staged[gpos][2], int(vers[j]))
                 if dates is not None:
                     self.last_update = dates[pos]
                 res[pos] = {"ll": float(lls[j]),
-                            "version": int(vers[j]), "stale": False}
+                            "version": int(vers[j]), "stale": gap_stale}
                 continue
             # degraded: kernel reject (state untouched in-program) needs no
             # rebuild; an accepted-then-unhealthy/chaos-corrupted slot does
@@ -632,6 +744,306 @@ class ShardedStateStore:
                         "version": self._meta[key].version,
                         "code": tax.describe(code)}
 
+    # ---- shard-loss fault domain (DESIGN §24) -----------------------------
+
+    def _note_lost(self, s: int, reason: str) -> None:
+        """Transition shard ``s`` to LOST: drop its resident arrays, stamp
+        the detection time (the MTTR clock starts here) and ledger it.
+        Idempotent — a second detection of the same loss is a no-op."""
+        with self._lock:
+            if s in self._lost:
+                return
+            self._lost[s] = (time.perf_counter(), reason)
+            self._shards[s] = None
+            self.recovery.lost_shards += 1
+
+    def mark_shard_lost(self, s: int,
+                        reason: str = "whole-shard health sweep") -> None:
+        """Operator verb: declare shard ``s`` LOST (a failed whole-shard
+        health sweep, a wedged relay, an ops decision).  Its keys answer
+        degraded from the banked last-good until :meth:`recover_lost_shards`
+        — which the next ``update_batch`` runs automatically — rebuilds
+        it."""
+        if not 0 <= s < self.n_shards:
+            raise ServingError("store", f"no shard {s} on a "
+                               f"{self.n_shards}-shard mesh")
+        self._note_lost(s, reason)
+
+    def _answer_lost(self, s: int, chunk, staged, res) -> None:
+        """Degraded answers for a chunk routed to a LOST shard: the banked
+        last-good version is what the caller can still read
+        (``last_good_snapshot_of``), the update itself is NOT applied — it
+        was never accepted, so the zero-lost-ACCEPTED-updates invariant is
+        untouched."""
+        del s
+        for gpos, _sl in chunk:
+            pos, key, _ = staged[gpos]
+            with self._lock:
+                self._stale.add(key)
+                self.recovery.degraded_answers += 1
+                ver = self._meta[key].version
+            res[pos] = {"ll": float("nan"), "degraded": True, "stale": True,
+                        "version": ver,
+                        "reason": "shard lost — serving last-good until "
+                                  "the rebuild wave lands"}
+
+    @property
+    def rebuilding(self) -> bool:
+        """True while a shard is LOST or a rebuild wave is in flight — the
+        fleet seam (``tiers.StoreFleet``) routes around a rebuilding
+        member."""
+        with self._lock:
+            return bool(self._lost) or self._rebuilding
+
+    def add_rebuild_listener(self, fn) -> None:
+        """Blast-radius hook: ``fn(keys)`` is called after a rebuild wave
+        with every affected key — the streaming hub breaks those keys'
+        delta chains (full ``stress_fan`` recompute, serving/streams.py)."""
+        self._rebuild_listeners.append(fn)
+
+    def _rebuild_plan(self, s: int):
+        """Which keys lived on the LOST shard and what each needs: slot,
+        expected (meta) version, and the bank's version — the replay
+        window.  Pure host dict/list routing (graftlint YFM008's
+        routing-path scan): no host transfer may happen while planning;
+        the array work lives in :meth:`_rebuild_shard`'s flush."""
+        with self._lock:
+            keys = sorted(k for k, loc in self._slot.items() if loc[0] == s)
+            return [(k, self._slot[k][1], self._meta[k].version,
+                     self._bank_ver.get(k, self._meta[k].version))
+                    for k in keys]
+
+    def _rebuild_source(self, key: Key):
+        """Best surviving host-side source for a key's rebuild: the banked
+        last-good (freshest), falling back to the frozen registry entry
+        when the bank fails the health watch — the §11 ladder applied at
+        rebuild scope.  Returns ``(params, beta, cov, version, healthy)``;
+        the tiered store interposes its warm records (serving/tiers.py)."""
+        with self._lock:
+            banked = self._bank.get(key)
+            ver = self._bank_ver.get(key, self._meta[key].version)
+            params = self._bank_params.get(key)
+        if banked is not None and params is not None:
+            beta, cov = banked
+            if rh.state_health(beta, cov, self.engine)["code"] == tax.OK:
+                return params, beta, cov, ver, True
+        else:
+            beta = cov = None
+        if self.registry is not None:
+            try:
+                snap = self.registry.get(*key)
+                cov2 = np.asarray(factor_cov(snap.P, self.engine,
+                                             self.spec.dtype),
+                                  dtype=np.float64)
+                beta2 = np.asarray(snap.beta, dtype=np.float64)
+                p2 = np.asarray(snap.params, dtype=np.float64).reshape(-1)
+                return p2, beta2, cov2, int(snap.meta.version), True
+            except (ServingError, ValueError):
+                pass  # bank is still the best available source
+        if beta is None:
+            raise ServingError(
+                "store", f"no surviving rebuild source for {key} — no bank, "
+                "no registry entry", key=key)
+        return params, beta, cov, ver, False
+
+    def _rebuild_overflow(self, key: Key, params, beta, cov, ver: int,
+                          stale: bool) -> bool:
+        """Absorb a key that found no free slot during a redistributing
+        rebuild.  The base store has no off-mesh tier, so it cannot — the
+        caller falls back to re-homing the key on the reset device.  The
+        tiered store overrides this to park the key warm (DESIGN §21
+        spill discipline)."""
+        del key, params, beta, cov, ver, stale
+        return False
+
+    def recover_lost_shards(self, redistribute: bool = False) -> List[int]:
+        """The failover rebuild wave (DESIGN §24) for every LOST shard:
+        fresh resident arrays, every affected slot re-registered from its
+        best surviving host source, each key's journal suffix replayed in
+        version order through the same donated update program — post-replay
+        state bit-identical to the never-lost run for every ungapped key;
+        a journal gap stale-flags the key instead.  ``redistribute=True``
+        spreads the keys over the SURVIVING shards' free slots (overflow
+        handled by :meth:`_rebuild_overflow`) instead of re-homing on the
+        reset device.  Returns the rebuilt shard ids; one MTTR sample per
+        shard (detection → rebuilt) lands in the timer's ``recover``
+        stage."""
+        with self._lock:
+            lost = sorted(self._lost)
+            if not lost:
+                return []
+            self._rebuilding = True
+        affected: List[Key] = []
+        try:
+            for s in lost:
+                with self.timer.stage("rebuild_wave"):
+                    affected.extend(self._rebuild_shard(s, redistribute))
+                with self._lock:
+                    t0, _reason = self._lost.pop(s)
+                    self.recovery.rebuilt_shards += 1
+                self.timer.record("recover", time.perf_counter() - t0)
+        finally:
+            with self._lock:
+                self._rebuilding = False
+        if affected:
+            self._notify_rebuilt(affected)
+        return lost
+
+    def _rebuild_shard(self, s: int, redistribute: bool) -> List[Key]:
+        """One shard's rebuild flush: allocate fresh arrays on the reset
+        device, route every affected key to its rebuild slot, write the
+        source states in batched donated scatters, then replay the journal
+        suffixes.  Returns the affected keys (the blast radius)."""
+        plan = self._rebuild_plan(s)
+        dtype = self.spec.dtype
+        Pn, Ms, Cs = self.spec.n_params, self.spec.state_dim, \
+            self.shard_capacity
+        d = self._devices[s]
+        fresh = {
+            "params": jax.device_put(jnp.zeros((Pn, Cs), dtype=dtype), d),
+            "beta": jax.device_put(jnp.zeros((Ms, Cs), dtype=dtype), d),
+            "cov": jax.device_put(jnp.zeros((Ms, Ms, Cs), dtype=dtype), d),
+            "ver": jax.device_put(jnp.zeros((Cs,), dtype=jnp.int32), d),
+        }
+        with self._lock:
+            self._shards[s] = fresh
+            if redistribute:
+                for key, _sl, _exp, _bv in plan:
+                    self._slot.pop(key, None)
+                self._free[s] = list(range(Cs))
+        entries: Dict[int, list] = {}           # shard → slot-write entries
+        replay: Dict[int, list] = {}            # shard → (key, slot, recs)
+        for key, sl, expected, _bank_hint in plan:
+            try:
+                params, beta, cov, src_ver, healthy = \
+                    self._rebuild_source(key)
+            except ServingError:
+                # nothing survives anywhere for this key: drop it from
+                # residency (a later update meets the structural unknown-key
+                # error — loud, not silently-wrong) and ledger the loss
+                with self._lock:
+                    self._slot.pop(key, None)
+                    if not redistribute:
+                        self._free[s].append(sl)
+                    self._stale.add(key)
+                    self.recovery.gapped_keys += 1
+                continue
+            recs, ok = self.journal.suffix(key, src_ver, expected)
+            target = None
+            if redistribute:
+                with self._lock:
+                    frees = [len(f) if t != s and t not in self._lost else -1
+                             for t, f in enumerate(self._free)]
+                    t_best = int(np.argmax(frees))
+                    if frees[t_best] > 0:
+                        target = (t_best, self._free[t_best].pop())
+                        self._slot[key] = target
+                        self.recovery.redistributed_keys += 1
+                if target is None:
+                    # a parked key never replays: if its suffix is gapped OR
+                    # non-empty, the parked record is behind the accepted
+                    # stream — park it stale, never silently regressed
+                    if self._rebuild_overflow(key, params, beta, cov,
+                                              src_ver,
+                                              stale=(not ok) or bool(recs)):
+                        with self._lock:
+                            self.recovery.parked_keys += 1
+                        continue
+            if target is None:      # re-home on the reset device
+                with self._lock:
+                    if redistribute:
+                        sl = self._free[s].pop()
+                    self._slot[key] = (s, sl)
+                    self.recovery.rehomed_keys += 1
+                target = (s, sl)
+            entries.setdefault(target[0], []).append(
+                (target[1], params, beta, cov, src_ver))
+            with self._lock:
+                self._bank[key] = (np.asarray(beta, dtype=np.float64),
+                                   np.asarray(cov, dtype=np.float64))
+                self._bank_ver[key] = src_ver
+                if not ok:
+                    # gap detector verdict: the suffix cannot be trusted —
+                    # stale-flag forever (until a refit re-bases), never
+                    # replay to silently-wrong state
+                    self._stale.add(key)
+                    self._gapped_keys.add(key)
+                    self.recovery.gapped_keys += 1
+                elif not healthy:
+                    self._stale.add(key)
+            if ok and recs:
+                replay.setdefault(target[0], []).append(
+                    (key, target[1], recs))
+        for t in sorted(entries):
+            self._write_state_many(t, entries[t])
+        for t in sorted(replay):
+            self._replay_suffixes(t, replay[t])
+        return [key for key, _sl, _exp, _bv in plan]
+
+    def _replay_suffixes(self, s: int, items) -> int:
+        """Re-drive journal records through the SAME donated shard-update
+        program the live path uses, in version order per key — a
+        deterministic program on identical inputs gives bit-identical
+        post-replay state (each slot's recursion sees only its own state
+        and curve; the padding-invariance pin in tests/test_store.py is the
+        same property).  ``items`` is ``[(key, slot, records), ...]`` on
+        shard ``s``; one wave per record rank keeps one write per slot per
+        launch.  A replayed accept that fails to re-accept (impossible
+        unless the journal lied) stale-flags the key."""
+        bmax = self.lattice.update_batch_sizes[-1]
+        rank, replayed = 0, 0
+        dead: set = set()
+        while True:
+            wave = [(key, sl, recs[rank]) for key, sl, recs in items
+                    if rank < len(recs) and key not in dead]
+            if not wave:
+                break
+            for lo in range(0, len(wave), bmax):
+                chunk = wave[lo:lo + bmax]
+                bb = self.lattice.update_bucket(len(chunk))
+                Y, slots, valid = stage_request_arrays(self.spec, bb)
+                for j, (_key, sl, rec) in enumerate(chunk):
+                    Y[:, j] = rec.curve
+                    slots[j], valid[j] = sl, True
+                sh = self._shards[s]
+                runner = _jitted_shard_update(self.spec, self.engine,
+                                              self.shard_capacity, bb,
+                                              self._donate)
+                outs = runner(sh["params"], sh["beta"], sh["cov"],
+                              sh["ver"], Y, slots, valid)
+                sh["params"], sh["beta"], sh["cov"], sh["ver"] = outs[:4]
+                _lls, oks, _codes, vers, betas, covs = \
+                    jax.device_get(outs[4:])
+                for j, (key, _sl, rec) in enumerate(chunk):
+                    if bool(oks[j]) and int(vers[j]) == rec.version:
+                        with self._lock:
+                            self._bank[key] = (
+                                np.asarray(betas[:, j], dtype=np.float64),
+                                np.asarray(covs[:, :, j], dtype=np.float64))
+                            self._bank_ver[key] = rec.version
+                            self.recovery.replayed_updates += 1
+                        replayed += 1
+                    else:
+                        dead.add(key)
+                        with self._lock:
+                            self._stale.add(key)
+                            self._gapped_keys.add(key)
+                            self.recovery.gapped_keys += 1
+            rank += 1
+        return replayed
+
+    def _notify_rebuilt(self, keys: List[Key]) -> None:
+        """Blast-radius fan-out after a rebuild wave: standing scenario
+        fans over the affected keys must break their delta chains (the hub
+        recomputes them from the rebuilt state).  A listener failure never
+        breaks the store — it is ledgered instead."""
+        for fn in list(self._rebuild_listeners):
+            try:
+                fn(list(keys))
+            except Exception:
+                with self._lock:
+                    self.recovery.listener_errors += 1
+
     # ---- read-side snapshots ---------------------------------------------
 
     def _snapshot_of_locked(self, key: Key) -> ServingSnapshot:
@@ -642,6 +1054,11 @@ class ShardedStateStore:
         s, sl = self._slot[key]
         meta = self._meta[key]
         sh = self._shards[s]
+        if sh is None:
+            raise ServingError(
+                "store", f"shard {s} is LOST — rebuild pending "
+                f"(recover_lost_shards()); serve last_good_snapshot_of",
+                key=key)
         c = sh["cov"][:, :, sl]
         P = c @ c.T if self.engine == "sqrt" else c
         return ServingSnapshot(self.spec, sh["params"][:, sl],
@@ -680,8 +1097,18 @@ class ShardedStateStore:
         with self._lock:
             live, stale = len(self._slot), len(self._stale)
             free = sum(len(f) for f in self._free)
+            lost_now = {s: reason for s, (_t, reason) in self._lost.items()}
+            recovery = self.recovery.to_dict()
+        mttr = sorted(self.timer.samples.get("recover", ()))
+        recovery.update({
+            "lost_now": lost_now,
+            "mttr_p50_s": _nearest_rank(mttr, 0.50) if mttr else 0.0,
+            "mttr_p99_s": _nearest_rank(mttr, 0.99) if mttr else 0.0,
+        })
+        status = "rebuilding" if lost_now else \
+            ("stale" if stale else "ok")
         return {
-            "status": "stale" if stale else "ok",
+            "status": status,
             "engine": self.engine,
             "shards": self.n_shards,
             "shard_capacity": self.shard_capacity,
@@ -692,6 +1119,8 @@ class ShardedStateStore:
             "last_code": self._last_code,
             "last_code_names": tax.decode(self._last_code),
             "requests": self.counters.to_dict(),
+            "recovery": recovery,
+            "chaos": chaos.observe(),
         }
 
     def latency_summary(self) -> dict:
@@ -716,6 +1145,8 @@ class ShardedStateStore:
                 # it on the hot path
                 Y, slots, valid = stage_request_arrays(self.spec, bb)
                 for sh in self._shards:
+                    if sh is None:      # LOST shard awaiting its rebuild
+                        continue
                     outs = runner(sh["params"], sh["beta"], sh["cov"],
                                   sh["ver"], Y, slots, valid)
                     sh["params"], sh["beta"], sh["cov"], sh["ver"] = outs[:4]

@@ -313,6 +313,12 @@ class ScenarioStreamHub:
             source.attach_hub(self)
         elif hasattr(source, "snapshot_of"):
             self.store = source
+            # blast-radius wiring (DESIGN §24): a rebuild wave breaks the
+            # affected keys' delta chains — full recompute from the rebuilt
+            # state (the gateway path wires this through attach_hub)
+            add = getattr(source, "add_rebuild_listener", None)
+            if add is not None:
+                add(self.notify_refit)
         else:
             raise ServingError(
                 "streams", f"unsupported subscription source "

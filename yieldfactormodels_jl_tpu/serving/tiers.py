@@ -403,6 +403,7 @@ class TieredStateStore(ShardedStateStore):
             return
         if not self.warm.discard(key):
             raise ServingError("store", f"no state registered for {key}")
+        self.journal.forget(key)
 
     # ---- demotion (hot → warm → cold) --------------------------------------
 
@@ -475,6 +476,8 @@ class TieredStateStore(ShardedStateStore):
                     self._free[s].append(sl)
                     self._meta.pop(key, None)
                     self._bank.pop(key, None)
+                    self._bank_ver.pop(key, None)
+                    self._bank_params.pop(key, None)
                     self._stale.discard(key)
                     self._access.pop(key, None)
                     self.ledger.demotions += 1
@@ -615,6 +618,14 @@ class TieredStateStore(ShardedStateStore):
             self._meta[key] = rec.meta
             self._bank[key] = (np.asarray(rec.beta, dtype=np.float64),
                                np.asarray(rec.cov, dtype=np.float64))
+            self._bank_ver[key] = int(rec.ver)
+            self._bank_params[key] = np.asarray(
+                rec.params, dtype=np.float64).reshape(-1)
+            # promotion RE-BASES the key's journal: replay determinism is
+            # measured from the freshly installed record (a cold promote is
+            # moment-exact — the pre-demotion journaled history no longer
+            # applies to this base)
+            self.journal.note_base(key, int(rec.ver))
             if rec.stale:
                 self._stale.add(key)
             else:
@@ -640,6 +651,67 @@ class TieredStateStore(ShardedStateStore):
             else np.zeros(self.spec.n_params)
         return WarmRecord(np.asarray(params), np.asarray(snap.beta), cov,
                           snap.meta.version, snap.meta, False, 0)
+
+    # ---- shard-loss recovery across tiers (DESIGN §24) ---------------------
+
+    def _rebuild_source(self, key: Key):
+        """The base rebuild ladder (bank → cold registry) with the WARM
+        tier interposed: a frozen warm record is engine-exact (bit-for-bit,
+        the §21 freeze/thaw invariant) where the cold snapshot is only
+        moment-exact, so a healthy warm record outranks both an unhealthy
+        bank and the registry as the rebuild source."""
+        try:
+            src = super()._rebuild_source(key)
+            if src[4]:
+                return src
+        except ServingError:
+            src = None
+        rec = self.warm.peek(key)
+        if rec is not None and rh.state_health(
+                rec.beta, rec.cov, self.engine)["code"] == tax.OK:
+            return (np.asarray(rec.params, dtype=np.float64).reshape(-1),
+                    np.asarray(rec.beta, dtype=np.float64),
+                    np.asarray(rec.cov, dtype=np.float64),
+                    int(rec.ver), True)
+        if src is None:
+            raise ServingError(
+                "store", f"no surviving rebuild source for {key} — no "
+                "bank, no warm record, no registry entry", key=key)
+        return src
+
+    def _rebuild_overflow(self, key: Key, params, beta, cov, ver: int,
+                          stale: bool) -> bool:
+        """Park a key that found no hot slot during a redistributing
+        rebuild into the warm tier (the §21 spill discipline): servable
+        immediately from its host record, promoted back on its next miss.
+        ``stale`` means the parked record is BEHIND the accepted stream
+        (gapped or unreplayed suffix) — it parks stale-flagged and the key
+        joins the gap set so only a refit/re-register heals it; the meta
+        version is rolled back to the parked record so the served version
+        is never a lie."""
+        with self._lock:
+            meta = self._meta.get(key)
+            stamp = self._access.get(key, 0)
+        if meta is None:
+            return False
+        meta = dataclasses.replace(meta, version=int(ver))
+        dt = self.spec.dtype
+        self._warm_put_with_spill(
+            key, np.asarray(params, dtype=dt).reshape(-1),
+            np.asarray(beta, dtype=dt), np.asarray(cov, dtype=dt),
+            int(ver), meta, stale=stale, stamp=stamp)
+        with self._lock:
+            self._meta.pop(key, None)
+            self._bank.pop(key, None)
+            self._bank_ver.pop(key, None)
+            self._bank_params.pop(key, None)
+            self._stale.discard(key)
+            self._access.pop(key, None)
+            if stale:
+                self._gapped_keys.add(key)
+                self.recovery.gapped_keys += 1
+        self.journal.note_base(key, int(ver))
+        return True
 
     # ---- the tier-aware request path ---------------------------------------
 
@@ -835,10 +907,52 @@ class StoreFleet:
                 prep(groups[ms])
 
     def snapshot_of(self, key: Key) -> ServingSnapshot:
-        return self._route(key).snapshot_of(key)
+        """Tier-transparent member read — ROUTING AROUND a rebuilding
+        member (DESIGN §24): a read that lands on a LOST shard answers from
+        the member's banked last-good instead of failing, so one member's
+        fault domain never takes the fleet's read path down."""
+        st = self._route(key)
+        try:
+            return st.snapshot_of(key)
+        except ServingError:
+            if getattr(st, "rebuilding", False):
+                return st.last_good_snapshot_of(key)
+            raise
 
     def last_good_snapshot_of(self, key: Key) -> ServingSnapshot:
         return self._route(key).last_good_snapshot_of(key)
+
+    # ---- shard-loss fault domains across members (DESIGN §24) ---------------
+
+    @property
+    def rebuilding(self) -> bool:
+        """True while ANY member has a lost shard or a rebuild in flight —
+        the gateway pump's pre-batch recovery hook reads this through the
+        same duck-typed surface as a single store."""
+        return any(getattr(st, "rebuilding", False)
+                   for st in self._stores.values())
+
+    def recover_lost_shards(self, redistribute: bool = False) -> dict:
+        """Run every member's rebuild wave; returns
+        ``{model_string: [rebuilt shard ids]}`` for the members that had
+        losses (empty dict when none did)."""
+        out = {}
+        for ms in sorted(self._stores):
+            recover = getattr(self._stores[ms], "recover_lost_shards", None)
+            if recover is None:
+                continue
+            rebuilt = recover(redistribute=redistribute)
+            if rebuilt:
+                out[ms] = rebuilt
+        return out
+
+    def add_rebuild_listener(self, fn) -> None:
+        """Fan the blast-radius hook out to every member (the streaming hub
+        attaches once and hears every member's rebuild waves)."""
+        for ms in sorted(self._stores):
+            add = getattr(self._stores[ms], "add_rebuild_listener", None)
+            if add is not None:
+                add(fn)
 
     def publish_refit(self, key: Key, params, history=None, beta=None,
                       P=None) -> dict:
@@ -849,8 +963,12 @@ class StoreFleet:
 
     def health(self) -> dict:
         members = {ms: st.health() for ms, st in self._stores.items()}
-        status = "stale" if any(h["status"] != "ok"
-                                for h in members.values()) else "ok"
+        if any(h["status"] == "rebuilding" for h in members.values()):
+            status = "rebuilding"
+        elif any(h["status"] != "ok" for h in members.values()):
+            status = "stale"
+        else:
+            status = "ok"
         return {"status": status, "models": sorted(self._stores),
                 "stores": members, "requests": self.counters.to_dict()}
 
